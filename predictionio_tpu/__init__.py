@@ -1,0 +1,24 @@
+"""predictionio_tpu — a TPU-native machine-learning server framework.
+
+A ground-up rebuild of the capabilities of PredictionIO (reference:
+/root/reference, Scala/Spark) designed for TPU hardware: the DASE
+controller pipeline (DataSource -> Preparator -> Algorithm(s) -> Serving,
+plus Evaluation) runs its compute path on JAX/XLA over a device mesh
+instead of Spark RDDs, and the surrounding server framework (event
+collection, metadata, model persistence, REST serving, CLI) is native
+Python.
+
+Layer map (mirrors SURVEY.md §1 of the reference):
+
+  tools/      CLI & ops                 (ref: tools/.../console/Console.scala)
+  serving/    Event + Engine HTTP APIs  (ref: data/.../api/EventAPI.scala,
+                                              core/.../workflow/CreateServer.scala)
+  workflow/   train/eval orchestration  (ref: core/.../workflow/CoreWorkflow.scala)
+  core/       DASE controller framework (ref: core/.../controller/)
+  models/     algorithm library         (ref: e2/ + examples/ templates)
+  data/       events + metadata + storage backends (ref: data/)
+  ops/        JAX/Pallas numeric kernels (ref: Spark/MLlib internals)
+  parallel/   mesh / sharding / collectives (ref: Spark's distributed runtime)
+"""
+
+__version__ = "0.1.0"

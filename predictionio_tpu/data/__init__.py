@@ -1,0 +1,16 @@
+"""Event, metadata and storage layer (ref: data/src/main/scala/io/prediction/data/)."""
+
+from predictionio_tpu.data.event import Event, EventValidationError, validate_event
+from predictionio_tpu.data.datamap import DataMap, PropertyMap
+from predictionio_tpu.data.aggregation import aggregate_properties_from_events
+from predictionio_tpu.data.bimap import BiMap
+
+__all__ = [
+    "Event",
+    "EventValidationError",
+    "validate_event",
+    "DataMap",
+    "PropertyMap",
+    "aggregate_properties_from_events",
+    "BiMap",
+]

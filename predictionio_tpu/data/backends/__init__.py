@@ -1,0 +1,13 @@
+"""Pluggable storage backends (ref: data/.../storage/{hbase,elasticsearch,localfs,hdfs}/).
+
+The reference ships HBase (events), Elasticsearch (metadata) and
+localfs/HDFS (model blobs). The TPU build ships:
+
+  - ``memory``  — in-process, for tests and embedded use (the reference
+                  has no such backend; its tests require live HBase)
+  - ``localfs`` — JSONL event logs + JSON metadata + model-blob files,
+                  the single-host default
+
+Scale-out backends can be registered by third parties via
+``predictionio_tpu.data.storage.register_backend``.
+"""

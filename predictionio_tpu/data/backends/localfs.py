@@ -1,0 +1,248 @@
+"""Local-filesystem storage backend — the single-host default.
+
+Maps the reference's three default backends onto one directory tree:
+
+  - events   -> append-only JSONL logs ``events/events_<app>[_<ch>].jsonl``
+                (ref: hbase tables ``events_<appId>[_<channelId>]``,
+                 hbase/HBEventsUtil.scala:51)
+  - metadata -> one JSON document ``metadata.json``
+                (ref: elasticsearch indices, data/.../storage/elasticsearch/)
+  - models   -> blob files ``models/pio_<id>``
+                (ref: localfs/LocalFSModels.scala:29)
+
+Writes go through the in-memory DAOs and are persisted with
+atomic-rename JSON snapshots (metadata) or appends (events), so a
+process restart replays to the same state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.metadata import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    Model,
+    dict_to_record,
+    record_to_dict,
+)
+from predictionio_tpu.data import storage as S
+from predictionio_tpu.data.backends import memory as M
+
+
+def _atomic_write(path: str, data: str) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+class LocalFSEventStore(M.MemoryEventStore):
+    """JSONL event log with an in-memory replay cache."""
+
+    def __init__(self, basedir: str):
+        super().__init__()
+        self._dir = os.path.join(basedir, "events")
+        os.makedirs(self._dir, exist_ok=True)
+        self._loaded: set = set()
+
+    def _path(self, app_id: int, channel_id: Optional[int]) -> str:
+        name = f"events_{int(app_id)}"
+        if channel_id is not None:
+            name += f"_{int(channel_id)}"
+        return os.path.join(self._dir, name + ".jsonl")
+
+    def _ensure_loaded(self, app_id: int, channel_id: Optional[int]) -> None:
+        key = (int(app_id), channel_id if channel_id is None else int(channel_id))
+        if key in self._loaded:
+            return
+        self._loaded.add(key)
+        path = self._path(app_id, channel_id)
+        if not os.path.exists(path):
+            return
+        tbl = super()._table(app_id, channel_id, create=True)
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                d = json.loads(line)
+                if "__tombstone__" in d:
+                    tbl.pop(d["__tombstone__"], None)
+                else:
+                    e = Event.from_dict(d)
+                    tbl[e.event_id] = e
+
+    def _append(self, app_id, channel_id, record: dict) -> None:
+        with open(self._path(app_id, channel_id), "a") as f:
+            f.write(json.dumps(record, sort_keys=True) + "\n")
+
+    # -- overrides ----------------------------------------------------------
+    def init(self, app_id, channel_id=None):
+        with self._lock:
+            self._ensure_loaded(app_id, channel_id)
+            super().init(app_id, channel_id)
+            path = self._path(app_id, channel_id)
+            if not os.path.exists(path):
+                open(path, "a").close()
+
+    def remove(self, app_id, channel_id=None):
+        with self._lock:
+            super().remove(app_id, channel_id)
+            self._loaded.discard(
+                (int(app_id), channel_id if channel_id is None else int(channel_id))
+            )
+            try:
+                os.remove(self._path(app_id, channel_id))
+            except FileNotFoundError:
+                pass
+
+    def insert(self, event, app_id, channel_id=None) -> str:
+        with self._lock:
+            self._ensure_loaded(app_id, channel_id)
+            event_id = super().insert(event, app_id, channel_id)
+            stored = super().get(event_id, app_id, channel_id)
+            self._append(app_id, channel_id, stored.to_dict(api_format=False))
+            return event_id
+
+    def get(self, event_id, app_id, channel_id=None):
+        with self._lock:
+            self._ensure_loaded(app_id, channel_id)
+            return super().get(event_id, app_id, channel_id)
+
+    def delete(self, event_id, app_id, channel_id=None) -> bool:
+        with self._lock:
+            self._ensure_loaded(app_id, channel_id)
+            found = super().delete(event_id, app_id, channel_id)
+            if found:
+                self._append(app_id, channel_id, {"__tombstone__": event_id})
+            return found
+
+    def find(self, app_id, channel_id=None, **kwargs):
+        with self._lock:
+            self._ensure_loaded(app_id, channel_id)
+        return super().find(app_id, channel_id=channel_id, **kwargs)
+
+
+class LocalFSModelsRepo(S.ModelsRepo):
+    """ref: localfs/LocalFSModels.scala:29 — blob per model id."""
+
+    def __init__(self, basedir: str):
+        self._dir = os.path.join(basedir, "models")
+        os.makedirs(self._dir, exist_ok=True)
+
+    def _path(self, id: str) -> str:
+        return os.path.join(self._dir, f"pio_{id}")
+
+    def insert(self, model: Model) -> None:
+        with open(self._path(model.id), "wb") as f:
+            f.write(model.models)
+
+    def get(self, id: str) -> Optional[Model]:
+        try:
+            with open(self._path(id), "rb") as f:
+                return Model(id=id, models=f.read())
+        except FileNotFoundError:
+            return None
+
+    def delete(self, id: str) -> None:
+        try:
+            os.remove(self._path(id))
+        except FileNotFoundError:
+            pass
+
+
+_META_RECORDS = {
+    "apps": (App, "_apps", lambda r: r.id),
+    "access_keys": (AccessKey, "_keys", lambda r: r.key),
+    "channels": (Channel, "_channels", lambda r: r.id),
+    "engine_manifests": (EngineManifest, "_manifests", lambda r: (r.id, r.version)),
+    "engine_instances": (EngineInstance, "_instances", lambda r: r.id),
+    "evaluation_instances": (EvaluationInstance, "_instances", lambda r: r.id),
+}
+
+
+class LocalFSStorageClient(S.StorageClient):
+    """Directory-rooted storage source; ``PATH`` config key sets the root."""
+
+    def __init__(self, config: Dict[str, str]):
+        super().__init__(config)
+        basedir = os.path.expanduser(config.get("PATH") or "~/.pio_store")
+        os.makedirs(basedir, exist_ok=True)
+        self._basedir = basedir
+        self._meta_path = os.path.join(basedir, "metadata.json")
+        self._lock = threading.RLock()
+        self._sequences = M._Sequences()
+        save = self._save_metadata
+        self._events = LocalFSEventStore(basedir)
+        self._apps = M.MemoryAppsRepo(self._sequences, self._lock, save)
+        self._access_keys = M.MemoryAccessKeysRepo(self._lock, save)
+        self._channels = M.MemoryChannelsRepo(self._sequences, self._lock, save)
+        self._engine_manifests = M.MemoryEngineManifestsRepo(self._lock, save)
+        self._engine_instances = M.MemoryEngineInstancesRepo(self._lock, save)
+        self._evaluation_instances = M.MemoryEvaluationInstancesRepo(self._lock, save)
+        self._models = LocalFSModelsRepo(basedir)
+        self._loading = False
+        self._load_metadata()
+
+    # -- persistence --------------------------------------------------------
+    def _repos(self):
+        return {
+            "apps": self._apps,
+            "access_keys": self._access_keys,
+            "channels": self._channels,
+            "engine_manifests": self._engine_manifests,
+            "engine_instances": self._engine_instances,
+            "evaluation_instances": self._evaluation_instances,
+        }
+
+    def _save_metadata(self) -> None:
+        if self._loading:
+            return
+        with self._lock:
+            doc = {"sequences": self._sequences.state()}
+            for name, (cls, attr, _key) in _META_RECORDS.items():
+                repo = self._repos()[name]
+                records = list(getattr(repo, attr).values())
+                doc[name] = [record_to_dict(r) for r in records]
+            _atomic_write(self._meta_path, json.dumps(doc, indent=1, sort_keys=True))
+
+    def _load_metadata(self) -> None:
+        if not os.path.exists(self._meta_path):
+            return
+        with open(self._meta_path) as f:
+            doc = json.load(f)
+        self._loading = True
+        try:
+            with self._lock:
+                self._sequences.restore(doc.get("sequences", {}))
+                for name, (cls, attr, key) in _META_RECORDS.items():
+                    repo = self._repos()[name]
+                    store = getattr(repo, attr)
+                    store.clear()
+                    for rd in doc.get(name, []):
+                        rec = dict_to_record(cls, rd)
+                        store[key(rec)] = rec
+        finally:
+            self._loading = False
+
+    # -- accessors ----------------------------------------------------------
+    def events(self): return self._events
+    def apps(self): return self._apps
+    def access_keys(self): return self._access_keys
+    def channels(self): return self._channels
+    def engine_manifests(self): return self._engine_manifests
+    def engine_instances(self): return self._engine_instances
+    def evaluation_instances(self): return self._evaluation_instances
+    def models(self): return self._models
+
+
+S.register_backend("localfs", LocalFSStorageClient)

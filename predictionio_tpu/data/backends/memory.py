@@ -1,0 +1,420 @@
+"""In-memory storage backend — the test/embedded substrate.
+
+Implements every DAO contract from predictionio_tpu.data.storage with
+plain dicts under one RLock. This backend is what makes the whole
+framework testable in-process (the reference's storage tests need a live
+HBase + Elasticsearch; see SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import itertools
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.metadata import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    Model,
+)
+from predictionio_tpu.data import storage as S
+
+
+class MemoryEventStore(S.EventStore):
+    def __init__(self):
+        self._lock = threading.RLock()
+        # (app_id, channel_id) -> {event_id: Event}
+        self._tables: Dict[Tuple[int, Optional[int]], Dict[str, Event]] = {}
+
+    def _table(self, app_id: int, channel_id: Optional[int], create: bool = False):
+        key = (int(app_id), channel_id if channel_id is None else int(channel_id))
+        if create:
+            return self._tables.setdefault(key, {})
+        tbl = self._tables.get(key)
+        if tbl is None:
+            raise S.StorageError(f"event table for app {app_id} channel {channel_id} not initialized")
+        return tbl
+
+    def init(self, app_id, channel_id=None):
+        with self._lock:
+            self._table(app_id, channel_id, create=True)
+
+    def remove(self, app_id, channel_id=None):
+        with self._lock:
+            self._tables.pop((int(app_id), channel_id if channel_id is None else int(channel_id)), None)
+
+    def insert(self, event: Event, app_id, channel_id=None) -> str:
+        with self._lock:
+            tbl = self._table(app_id, channel_id, create=True)
+            e = event if event.event_id else event.with_id()
+            tbl[e.event_id] = e
+            return e.event_id
+
+    def get(self, event_id, app_id, channel_id=None):
+        with self._lock:
+            return self._table(app_id, channel_id, create=True).get(event_id)
+
+    def delete(self, event_id, app_id, channel_id=None) -> bool:
+        with self._lock:
+            return self._table(app_id, channel_id, create=True).pop(event_id, None) is not None
+
+    def find(
+        self,
+        app_id,
+        channel_id=None,
+        start_time=None,
+        until_time=None,
+        entity_type=None,
+        entity_id=None,
+        event_names=None,
+        target_entity_type=S.UNSET,
+        target_entity_id=S.UNSET,
+        limit=None,
+        reversed=False,
+    ) -> List[Event]:
+        with self._lock:
+            events = list(self._table(app_id, channel_id, create=True).values())
+        out = [
+            e
+            for e in events
+            if _matches(
+                e, start_time, until_time, entity_type, entity_id, event_names,
+                target_entity_type, target_entity_id,
+            )
+        ]
+        out.sort(key=lambda e: (e.event_time, e.creation_time), reverse=reversed)
+        if limit is not None and limit >= 0:
+            out = out[:limit]
+        return out
+
+
+def _matches(
+    e: Event,
+    start_time,
+    until_time,
+    entity_type,
+    entity_id,
+    event_names,
+    target_entity_type,
+    target_entity_id,
+) -> bool:
+    """Filter semantics of PEvents.find (ref: PEvents.scala:70):
+    [start_time, until_time) half-open window; target filters use the
+    UNSET sentinel so callers can ask for "no target entity"."""
+    if start_time is not None and e.event_time < start_time:
+        return False
+    if until_time is not None and e.event_time >= until_time:
+        return False
+    if entity_type is not None and e.entity_type != entity_type:
+        return False
+    if entity_id is not None and e.entity_id != entity_id:
+        return False
+    if event_names is not None and e.event not in event_names:
+        return False
+    if target_entity_type is not S.UNSET and e.target_entity_type != target_entity_type:
+        return False
+    if target_entity_id is not S.UNSET and e.target_entity_id != target_entity_id:
+        return False
+    return True
+
+
+class _Sequences:
+    """Auto-increment ids (ref: elasticsearch/ESSequences.scala)."""
+
+    def __init__(self):
+        self._counters: Dict[str, int] = {}
+
+    def next(self, name: str) -> int:
+        self._counters[name] = self._counters.get(name, 0) + 1
+        return self._counters[name]
+
+    def state(self) -> Dict[str, int]:
+        return dict(self._counters)
+
+    def restore(self, state: Dict[str, int]) -> None:
+        self._counters = dict(state)
+
+
+class MemoryAppsRepo(S.AppsRepo):
+    def __init__(self, sequences: _Sequences, lock: threading.RLock, on_change=None):
+        self._apps: Dict[int, App] = {}
+        self._seq = sequences
+        self._lock = lock
+        self._on_change = on_change or (lambda: None)
+
+    def insert(self, name, description=None) -> App:
+        with self._lock:
+            if self.get_by_name(name) is not None:
+                raise S.StorageError(f"app name {name!r} already exists")
+            app = App(id=self._seq.next("apps"), name=name, description=description)
+            self._apps[app.id] = app
+            self._on_change()
+            return app
+
+    def get(self, app_id):
+        with self._lock:
+            return self._apps.get(int(app_id))
+
+    def get_by_name(self, name):
+        with self._lock:
+            return next((a for a in self._apps.values() if a.name == name), None)
+
+    def get_all(self):
+        with self._lock:
+            return sorted(self._apps.values(), key=lambda a: a.id)
+
+    def update(self, app):
+        with self._lock:
+            self._apps[app.id] = app
+            self._on_change()
+
+    def delete(self, app_id):
+        with self._lock:
+            self._apps.pop(int(app_id), None)
+            self._on_change()
+
+
+class MemoryAccessKeysRepo(S.AccessKeysRepo):
+    def __init__(self, lock: threading.RLock, on_change=None):
+        self._keys: Dict[str, AccessKey] = {}
+        self._lock = lock
+        self._on_change = on_change or (lambda: None)
+
+    def insert(self, access_key: AccessKey) -> str:
+        with self._lock:
+            if not access_key.key:
+                access_key = AccessKey.generate(access_key.appid, access_key.events)
+            self._keys[access_key.key] = access_key
+            self._on_change()
+            return access_key.key
+
+    def get(self, key):
+        with self._lock:
+            return self._keys.get(key)
+
+    def get_all(self):
+        with self._lock:
+            return list(self._keys.values())
+
+    def get_by_app_id(self, app_id):
+        with self._lock:
+            return [k for k in self._keys.values() if k.appid == int(app_id)]
+
+    def update(self, access_key):
+        with self._lock:
+            self._keys[access_key.key] = access_key
+            self._on_change()
+
+    def delete(self, key):
+        with self._lock:
+            self._keys.pop(key, None)
+            self._on_change()
+
+
+class MemoryChannelsRepo(S.ChannelsRepo):
+    def __init__(self, sequences: _Sequences, lock: threading.RLock, on_change=None):
+        self._channels: Dict[int, Channel] = {}
+        self._seq = sequences
+        self._lock = lock
+        self._on_change = on_change or (lambda: None)
+
+    def insert(self, name, app_id) -> Channel:
+        with self._lock:
+            if not Channel.is_valid_name(name):
+                raise S.StorageError(
+                    f"invalid channel name {name!r} (must match [a-zA-Z0-9-]{{1,16}})"
+                )
+            if any(c.name == name and c.appid == int(app_id) for c in self._channels.values()):
+                raise S.StorageError(f"channel {name!r} already exists for app {app_id}")
+            ch = Channel(id=self._seq.next("channels"), name=name, appid=int(app_id))
+            self._channels[ch.id] = ch
+            self._on_change()
+            return ch
+
+    def get(self, channel_id):
+        with self._lock:
+            return self._channels.get(int(channel_id))
+
+    def get_by_app_id(self, app_id):
+        with self._lock:
+            return sorted(
+                (c for c in self._channels.values() if c.appid == int(app_id)),
+                key=lambda c: c.id,
+            )
+
+    def delete(self, channel_id):
+        with self._lock:
+            self._channels.pop(int(channel_id), None)
+            self._on_change()
+
+
+class MemoryEngineManifestsRepo(S.EngineManifestsRepo):
+    def __init__(self, lock: threading.RLock, on_change=None):
+        self._manifests: Dict[Tuple[str, str], EngineManifest] = {}
+        self._lock = lock
+        self._on_change = on_change or (lambda: None)
+
+    def insert(self, manifest):
+        with self._lock:
+            self._manifests[(manifest.id, manifest.version)] = manifest
+            self._on_change()
+
+    def get(self, id, version):
+        with self._lock:
+            return self._manifests.get((id, version))
+
+    def get_all(self):
+        with self._lock:
+            return list(self._manifests.values())
+
+    def update(self, manifest):
+        self.insert(manifest)
+
+    def delete(self, id, version):
+        with self._lock:
+            self._manifests.pop((id, version), None)
+            self._on_change()
+
+
+class MemoryEngineInstancesRepo(S.EngineInstancesRepo):
+    def __init__(self, lock: threading.RLock, on_change=None):
+        self._instances: Dict[str, EngineInstance] = {}
+        self._lock = lock
+        self._on_change = on_change or (lambda: None)
+
+    def insert(self, instance) -> str:
+        with self._lock:
+            if not instance.id:
+                instance.id = uuid.uuid4().hex
+            self._instances[instance.id] = instance
+            self._on_change()
+            return instance.id
+
+    def get(self, id):
+        with self._lock:
+            return self._instances.get(id)
+
+    def get_all(self):
+        with self._lock:
+            return list(self._instances.values())
+
+    def get_completed(self, engine_id, engine_version, engine_variant):
+        # ref: EngineInstances.getCompleted — newest first
+        with self._lock:
+            out = [
+                i
+                for i in self._instances.values()
+                if i.status == "COMPLETED"
+                and i.engine_id == engine_id
+                and i.engine_version == engine_version
+                and i.engine_variant == engine_variant
+            ]
+            out.sort(key=lambda i: i.start_time, reverse=True)
+            return out
+
+    def get_latest_completed(self, engine_id, engine_version, engine_variant):
+        completed = self.get_completed(engine_id, engine_version, engine_variant)
+        return completed[0] if completed else None
+
+    def update(self, instance):
+        with self._lock:
+            self._instances[instance.id] = instance
+            self._on_change()
+
+    def delete(self, id):
+        with self._lock:
+            self._instances.pop(id, None)
+            self._on_change()
+
+
+class MemoryEvaluationInstancesRepo(S.EvaluationInstancesRepo):
+    def __init__(self, lock: threading.RLock, on_change=None):
+        self._instances: Dict[str, EvaluationInstance] = {}
+        self._lock = lock
+        self._on_change = on_change or (lambda: None)
+
+    def insert(self, instance) -> str:
+        with self._lock:
+            if not instance.id:
+                instance.id = uuid.uuid4().hex
+            self._instances[instance.id] = instance
+            self._on_change()
+            return instance.id
+
+    def get(self, id):
+        with self._lock:
+            return self._instances.get(id)
+
+    def get_all(self):
+        with self._lock:
+            return list(self._instances.values())
+
+    def get_completed(self):
+        with self._lock:
+            out = [i for i in self._instances.values() if i.status == "EVALCOMPLETED"]
+            out.sort(key=lambda i: i.start_time, reverse=True)
+            return out
+
+    def update(self, instance):
+        with self._lock:
+            self._instances[instance.id] = instance
+            self._on_change()
+
+    def delete(self, id):
+        with self._lock:
+            self._instances.pop(id, None)
+            self._on_change()
+
+
+class MemoryModelsRepo(S.ModelsRepo):
+    def __init__(self, lock: threading.RLock):
+        self._models: Dict[str, Model] = {}
+        self._lock = lock
+
+    def insert(self, model):
+        with self._lock:
+            self._models[model.id] = model
+
+    def get(self, id):
+        with self._lock:
+            return self._models.get(id)
+
+    def delete(self, id):
+        with self._lock:
+            self._models.pop(id, None)
+
+
+class MemoryStorageClient(S.StorageClient):
+    """ref: a StorageClient per source (Storage.scala:151-166)."""
+
+    def __init__(self, config: Dict[str, str]):
+        super().__init__(config)
+        self._lock = threading.RLock()
+        self._sequences = _Sequences()
+        self._events = MemoryEventStore()
+        self._apps = MemoryAppsRepo(self._sequences, self._lock)
+        self._access_keys = MemoryAccessKeysRepo(self._lock)
+        self._channels = MemoryChannelsRepo(self._sequences, self._lock)
+        self._engine_manifests = MemoryEngineManifestsRepo(self._lock)
+        self._engine_instances = MemoryEngineInstancesRepo(self._lock)
+        self._evaluation_instances = MemoryEvaluationInstancesRepo(self._lock)
+        self._models = MemoryModelsRepo(self._lock)
+
+    def events(self): return self._events
+    def apps(self): return self._apps
+    def access_keys(self): return self._access_keys
+    def channels(self): return self._channels
+    def engine_manifests(self): return self._engine_manifests
+    def engine_instances(self): return self._engine_instances
+    def evaluation_instances(self): return self._evaluation_instances
+    def models(self): return self._models
+
+
+S.register_backend("memory", MemoryStorageClient)

@@ -1,0 +1,404 @@
+"""Storage abstraction + env-configured registry.
+
+Behavior contract from the reference's Storage factory
+(data/.../storage/Storage.scala:40,151,183): storage *sources* are
+declared via ``PIO_STORAGE_SOURCES_<NAME>_TYPE`` (+ per-type config) and
+the three *repositories* — METADATA, EVENTDATA, MODELDATA — are mapped
+onto sources via ``PIO_STORAGE_REPOSITORIES_<REPO>_{NAME,SOURCE}``.
+Backends register a ``StorageClient`` class per type; entity DAOs are
+resolved per backend. The TPU build keeps the same env-var contract but
+resolves backends from a Python registry instead of JVM reflection.
+
+Unlike the reference (whose tests require a live HBase), an in-memory
+backend ships first-class so the whole framework is testable in-process
+(SURVEY.md §4 lesson).
+"""
+
+from __future__ import annotations
+
+import abc
+import datetime as _dt
+import os
+import re
+import threading
+from typing import Any, Dict, Iterable, List, Optional
+
+from predictionio_tpu.data.datamap import PropertyMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.metadata import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    Model,
+)
+
+#: sentinel distinguishing "don't filter" from "filter for None"
+#: (ref: PEvents.find targetEntityType: Option[Option[String]])
+UNSET = object()
+
+
+class StorageError(RuntimeError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Abstract DAOs
+# ---------------------------------------------------------------------------
+
+class EventStore(abc.ABC):
+    """Unified event DAO.
+
+    The reference splits this into LEvents (single-record async CRUD,
+    data/.../storage/LEvents.scala:30) and PEvents (Spark RDD bulk
+    reads, storage/PEvents.scala:30). Without Spark the split is
+    unnecessary: one store serves both the server CRUD path and the
+    bulk training-read path (which feeds host numpy buffers).
+    """
+
+    @abc.abstractmethod
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> None:
+        """Create the event table/log for an app (ref: LEvents.init)."""
+
+    @abc.abstractmethod
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> None:
+        """Drop the event table/log (ref: LEvents.remove)."""
+
+    @abc.abstractmethod
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        """Append one event, returning its assigned eventId."""
+
+    @abc.abstractmethod
+    def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:
+        ...
+
+    @abc.abstractmethod
+    def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[List[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> List[Event]:
+        """Filtered scan ordered by event time (ref: PEvents.find:70).
+
+        ``limit=-1``/``None`` means all. ``reversed=True`` returns newest
+        first (ref: GET /events.json ``reversed`` param).
+        """
+
+    # -- derived ------------------------------------------------------------
+    def aggregate_properties(
+        self,
+        app_id: int,
+        entity_type: str,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[List[str]] = None,
+    ) -> Dict[str, PropertyMap]:
+        """Materialize entity properties (ref: PEvents.aggregateProperties:95)."""
+        from predictionio_tpu.data.aggregation import aggregate_properties_from_events
+
+        events = self.find(
+            app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            event_names=["$set", "$unset", "$delete"],
+        )
+        return aggregate_properties_from_events(events, required=required)
+
+
+class AppsRepo(abc.ABC):
+    """ref: Apps.scala"""
+
+    @abc.abstractmethod
+    def insert(self, name: str, description: Optional[str] = None) -> App: ...
+    @abc.abstractmethod
+    def get(self, app_id: int) -> Optional[App]: ...
+    @abc.abstractmethod
+    def get_by_name(self, name: str) -> Optional[App]: ...
+    @abc.abstractmethod
+    def get_all(self) -> List[App]: ...
+    @abc.abstractmethod
+    def update(self, app: App) -> None: ...
+    @abc.abstractmethod
+    def delete(self, app_id: int) -> None: ...
+
+
+class AccessKeysRepo(abc.ABC):
+    """ref: AccessKeys.scala"""
+
+    @abc.abstractmethod
+    def insert(self, access_key: AccessKey) -> str: ...
+    @abc.abstractmethod
+    def get(self, key: str) -> Optional[AccessKey]: ...
+    @abc.abstractmethod
+    def get_all(self) -> List[AccessKey]: ...
+    @abc.abstractmethod
+    def get_by_app_id(self, app_id: int) -> List[AccessKey]: ...
+    @abc.abstractmethod
+    def update(self, access_key: AccessKey) -> None: ...
+    @abc.abstractmethod
+    def delete(self, key: str) -> None: ...
+
+
+class ChannelsRepo(abc.ABC):
+    """ref: Channels.scala"""
+
+    @abc.abstractmethod
+    def insert(self, name: str, app_id: int) -> Channel: ...
+    @abc.abstractmethod
+    def get(self, channel_id: int) -> Optional[Channel]: ...
+    @abc.abstractmethod
+    def get_by_app_id(self, app_id: int) -> List[Channel]: ...
+    @abc.abstractmethod
+    def delete(self, channel_id: int) -> None: ...
+
+
+class EngineManifestsRepo(abc.ABC):
+    """ref: EngineManifests.scala"""
+
+    @abc.abstractmethod
+    def insert(self, manifest: EngineManifest) -> None: ...
+    @abc.abstractmethod
+    def get(self, id: str, version: str) -> Optional[EngineManifest]: ...
+    @abc.abstractmethod
+    def get_all(self) -> List[EngineManifest]: ...
+    @abc.abstractmethod
+    def update(self, manifest: EngineManifest) -> None: ...
+    @abc.abstractmethod
+    def delete(self, id: str, version: str) -> None: ...
+
+
+class EngineInstancesRepo(abc.ABC):
+    """ref: EngineInstances.scala"""
+
+    @abc.abstractmethod
+    def insert(self, instance: EngineInstance) -> str: ...
+    @abc.abstractmethod
+    def get(self, id: str) -> Optional[EngineInstance]: ...
+    @abc.abstractmethod
+    def get_all(self) -> List[EngineInstance]: ...
+    @abc.abstractmethod
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> Optional[EngineInstance]: ...
+    @abc.abstractmethod
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> List[EngineInstance]: ...
+    @abc.abstractmethod
+    def update(self, instance: EngineInstance) -> None: ...
+    @abc.abstractmethod
+    def delete(self, id: str) -> None: ...
+
+
+class EvaluationInstancesRepo(abc.ABC):
+    """ref: EvaluationInstances.scala"""
+
+    @abc.abstractmethod
+    def insert(self, instance: EvaluationInstance) -> str: ...
+    @abc.abstractmethod
+    def get(self, id: str) -> Optional[EvaluationInstance]: ...
+    @abc.abstractmethod
+    def get_all(self) -> List[EvaluationInstance]: ...
+    @abc.abstractmethod
+    def get_completed(self) -> List[EvaluationInstance]: ...
+    @abc.abstractmethod
+    def update(self, instance: EvaluationInstance) -> None: ...
+    @abc.abstractmethod
+    def delete(self, id: str) -> None: ...
+
+
+class ModelsRepo(abc.ABC):
+    """ref: Models.scala — model blobs keyed by engine-instance id."""
+
+    @abc.abstractmethod
+    def insert(self, model: Model) -> None: ...
+    @abc.abstractmethod
+    def get(self, id: str) -> Optional[Model]: ...
+    @abc.abstractmethod
+    def delete(self, id: str) -> None: ...
+
+
+class StorageClient(abc.ABC):
+    """One configured storage source (ref: BaseStorageClient, Storage.scala:298)."""
+
+    def __init__(self, config: Dict[str, str]):
+        self.config = config
+
+    @abc.abstractmethod
+    def events(self) -> EventStore: ...
+    @abc.abstractmethod
+    def apps(self) -> AppsRepo: ...
+    @abc.abstractmethod
+    def access_keys(self) -> AccessKeysRepo: ...
+    @abc.abstractmethod
+    def channels(self) -> ChannelsRepo: ...
+    @abc.abstractmethod
+    def engine_manifests(self) -> EngineManifestsRepo: ...
+    @abc.abstractmethod
+    def engine_instances(self) -> EngineInstancesRepo: ...
+    @abc.abstractmethod
+    def evaluation_instances(self) -> EvaluationInstancesRepo: ...
+    @abc.abstractmethod
+    def models(self) -> ModelsRepo: ...
+
+
+# ---------------------------------------------------------------------------
+# Registry + env config
+# ---------------------------------------------------------------------------
+
+_BACKENDS: Dict[str, type] = {}
+
+
+def register_backend(type_name: str, client_cls: type) -> None:
+    _BACKENDS[type_name] = client_cls
+
+
+def _load_backends() -> None:
+    # import side-effect registers the built-in backends
+    from predictionio_tpu.data.backends import memory, localfs  # noqa: F401
+
+
+_SOURCE_RE = re.compile(r"^PIO_STORAGE_SOURCES_([^_]+)_(.+)$")
+_REPO_RE = re.compile(r"^PIO_STORAGE_REPOSITORIES_([^_]+)_(NAME|SOURCE)$")
+
+REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
+
+
+class Storage:
+    """Resolved storage: repositories mapped to live StorageClients.
+
+    ref: Storage.scala:40-166 — sourcesToClientMeta + repositoriesToDataObjectMeta.
+    """
+
+    def __init__(self, clients: Dict[str, StorageClient], repo_to_source: Dict[str, str]):
+        self._clients = clients
+        self._repo_to_source = repo_to_source
+
+    def client_for(self, repo: str) -> StorageClient:
+        source = self._repo_to_source.get(repo.upper())
+        if source is None or source not in self._clients:
+            raise StorageError(f"repository {repo} has no configured source")
+        return self._clients[source]
+
+    # -- the accessors every layer uses (ref: Storage.getMetaData*/getLEvents/...) --
+    def events(self) -> EventStore:
+        return self.client_for("EVENTDATA").events()
+
+    def apps(self) -> AppsRepo:
+        return self.client_for("METADATA").apps()
+
+    def access_keys(self) -> AccessKeysRepo:
+        return self.client_for("METADATA").access_keys()
+
+    def channels(self) -> ChannelsRepo:
+        return self.client_for("METADATA").channels()
+
+    def engine_manifests(self) -> EngineManifestsRepo:
+        return self.client_for("METADATA").engine_manifests()
+
+    def engine_instances(self) -> EngineInstancesRepo:
+        return self.client_for("METADATA").engine_instances()
+
+    def evaluation_instances(self) -> EvaluationInstancesRepo:
+        return self.client_for("METADATA").evaluation_instances()
+
+    def models(self) -> ModelsRepo:
+        return self.client_for("MODELDATA").models()
+
+    def verify_all_data_objects(self) -> Dict[str, bool]:
+        """ref: Storage.verifyAllDataObjects:237 — used by `pio status`."""
+        results: Dict[str, bool] = {}
+        for repo in REPOSITORIES:
+            try:
+                self.client_for(repo)
+                results[repo] = True
+            except Exception:
+                results[repo] = False
+        return results
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def from_env(env: Optional[Dict[str, str]] = None) -> "Storage":
+        """Parse PIO_STORAGE_* env vars (ref: Storage.scala:45-128).
+
+        With no storage vars at all, defaults to a single localfs source
+        rooted at ``$PIO_FS_BASEDIR`` (default ``~/.pio_store``) serving
+        all three repositories.
+        """
+        _load_backends()
+        env = dict(env if env is not None else os.environ)
+        sources: Dict[str, Dict[str, str]] = {}
+        repos: Dict[str, Dict[str, str]] = {}
+        for key, value in env.items():
+            m = _SOURCE_RE.match(key)
+            if m:
+                sources.setdefault(m.group(1), {})[m.group(2)] = value
+                continue
+            m = _REPO_RE.match(key)
+            if m:
+                repos.setdefault(m.group(1), {})[m.group(2)] = value
+
+        if not sources:
+            basedir = env.get("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store"))
+            sources = {"LOCALFS": {"TYPE": "localfs", "PATH": basedir}}
+            repos = {r: {"NAME": r.lower(), "SOURCE": "LOCALFS"} for r in REPOSITORIES}
+
+        clients: Dict[str, StorageClient] = {}
+        for name, cfg in sources.items():
+            type_name = cfg.get("TYPE")
+            if type_name not in _BACKENDS:
+                raise StorageError(
+                    f"storage source {name}: unknown TYPE {type_name!r} "
+                    f"(known: {sorted(_BACKENDS)})"
+                )
+            clients[name] = _BACKENDS[type_name](cfg)
+
+        repo_to_source: Dict[str, str] = {}
+        for repo in REPOSITORIES:
+            cfg = repos.get(repo)
+            if cfg and cfg.get("SOURCE"):
+                repo_to_source[repo] = cfg["SOURCE"]
+            elif len(clients) == 1:
+                repo_to_source[repo] = next(iter(clients))
+        return Storage(clients, repo_to_source)
+
+
+# ---------------------------------------------------------------------------
+# Process-wide singleton (overridable for tests / embedding)
+# ---------------------------------------------------------------------------
+
+_storage_lock = threading.Lock()
+_storage: Optional[Storage] = None
+
+
+def get_storage() -> Storage:
+    global _storage
+    with _storage_lock:
+        if _storage is None:
+            _storage = Storage.from_env()
+        return _storage
+
+
+def set_storage(storage: Optional[Storage]) -> None:
+    """Install/replace (or with None, reset) the process-wide storage."""
+    global _storage
+    with _storage_lock:
+        _storage = storage

@@ -1,0 +1,130 @@
+"""Public engine-facing event-store API.
+
+Behavior contract from the reference's PEventStore / LEventStore
+(data/.../store/PEventStore.scala:30, store/LEventStore.scala:32,
+store/Common.scala:28): engines address data by *app name* (+ optional
+channel name); the store resolves the (appId, channelId) pair from
+metadata and raises if the app or channel does not exist. ``find`` /
+``aggregate_properties`` are the training-read path; ``find_by_entity``
+is the low-latency serve-time lookup.
+
+Without Spark there is a single API: results are Python lists of Event
+(converted to numpy/JAX buffers by DataSources in ``predictionio_tpu.ops``).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Any, Dict, List, Optional
+
+from predictionio_tpu.data.datamap import PropertyMap
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.storage import UNSET, Storage, StorageError, get_storage
+
+
+def resolve_app(
+    app_name: str,
+    channel_name: Optional[str] = None,
+    storage: Optional[Storage] = None,
+):
+    """app name (+channel name) -> (app_id, channel_id).
+
+    ref: store/Common.scala:28 — errors mirror the reference's messages.
+    """
+    storage = storage or get_storage()
+    app = storage.apps().get_by_name(app_name)
+    if app is None:
+        raise StorageError(f"App name {app_name} is not valid.")
+    channel_id = None
+    if channel_name is not None:
+        channels = storage.channels().get_by_app_id(app.id)
+        ch = next((c for c in channels if c.name == channel_name), None)
+        if ch is None:
+            raise StorageError(f"Channel name {channel_name} is not valid.")
+        channel_id = ch.id
+    return app.id, channel_id
+
+
+def find(
+    app_name: str,
+    channel_name: Optional[str] = None,
+    start_time: Optional[_dt.datetime] = None,
+    until_time: Optional[_dt.datetime] = None,
+    entity_type: Optional[str] = None,
+    entity_id: Optional[str] = None,
+    event_names: Optional[List[str]] = None,
+    target_entity_type: Any = UNSET,
+    target_entity_id: Any = UNSET,
+    limit: Optional[int] = None,
+    reversed: bool = False,
+    storage: Optional[Storage] = None,
+) -> List[Event]:
+    """ref: PEventStore.find:30."""
+    storage = storage or get_storage()
+    app_id, channel_id = resolve_app(app_name, channel_name, storage)
+    return storage.events().find(
+        app_id,
+        channel_id=channel_id,
+        start_time=start_time,
+        until_time=until_time,
+        entity_type=entity_type,
+        entity_id=entity_id,
+        event_names=event_names,
+        target_entity_type=target_entity_type,
+        target_entity_id=target_entity_id,
+        limit=limit,
+        reversed=reversed,
+    )
+
+
+def aggregate_properties(
+    app_name: str,
+    entity_type: str,
+    channel_name: Optional[str] = None,
+    start_time: Optional[_dt.datetime] = None,
+    until_time: Optional[_dt.datetime] = None,
+    required: Optional[List[str]] = None,
+    storage: Optional[Storage] = None,
+) -> Dict[str, PropertyMap]:
+    """ref: PEventStore.aggregateProperties."""
+    storage = storage or get_storage()
+    app_id, channel_id = resolve_app(app_name, channel_name, storage)
+    return storage.events().aggregate_properties(
+        app_id,
+        entity_type,
+        channel_id=channel_id,
+        start_time=start_time,
+        until_time=until_time,
+        required=required,
+    )
+
+
+def find_by_entity(
+    app_name: str,
+    entity_type: str,
+    entity_id: str,
+    channel_name: Optional[str] = None,
+    event_names: Optional[List[str]] = None,
+    target_entity_type: Any = UNSET,
+    target_entity_id: Any = UNSET,
+    start_time: Optional[_dt.datetime] = None,
+    until_time: Optional[_dt.datetime] = None,
+    limit: Optional[int] = None,
+    latest: bool = True,
+    storage: Optional[Storage] = None,
+) -> List[Event]:
+    """Serve-time entity lookup (ref: LEventStore.findByEntity:60)."""
+    return find(
+        app_name,
+        channel_name=channel_name,
+        start_time=start_time,
+        until_time=until_time,
+        entity_type=entity_type,
+        entity_id=entity_id,
+        event_names=event_names,
+        target_entity_type=target_entity_type,
+        target_entity_id=target_entity_id,
+        limit=limit,
+        reversed=latest,
+        storage=storage,
+    )

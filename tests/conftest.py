@@ -1,0 +1,37 @@
+"""Test harness configuration.
+
+Multi-device testing without TPUs (SURVEY.md §4 lesson — the reference
+can only test Spark logic in local[4] mode): force an 8-device CPU mesh
+so all pjit/shard_map code paths run in-process.  Must happen before the
+first ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest
+
+from predictionio_tpu.data.storage import Storage, set_storage
+
+
+@pytest.fixture()
+def memory_storage():
+    """Fresh in-memory storage installed as the process singleton."""
+    storage = Storage.from_env(
+        {
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "meta",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "events",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "models",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        }
+    )
+    set_storage(storage)
+    yield storage
+    set_storage(None)

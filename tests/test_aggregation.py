@@ -1,0 +1,90 @@
+"""$set/$unset/$delete aggregation semantics
+(ref specs: LEventAggregatorSpec.scala / PEventAggregatorSpec.scala)."""
+
+import datetime as dt
+
+from predictionio_tpu.data.aggregation import aggregate_properties_from_events
+from predictionio_tpu.data.event import Event
+
+UTC = dt.timezone.utc
+
+
+def ev(event, entity_id, props, minute):
+    return Event(
+        event=event,
+        entity_type="user",
+        entity_id=entity_id,
+        properties=props,
+        event_time=dt.datetime(2026, 1, 1, 0, minute, tzinfo=UTC),
+    )
+
+
+def test_set_merge_latest_wins():
+    events = [
+        ev("$set", "u1", {"a": 1, "b": 1}, 0),
+        ev("$set", "u1", {"b": 2, "c": 3}, 1),
+    ]
+    result = aggregate_properties_from_events(events)
+    assert result["u1"].to_dict() == {"a": 1, "b": 2, "c": 3}
+    assert result["u1"].first_updated == events[0].event_time
+    assert result["u1"].last_updated == events[1].event_time
+
+
+def test_out_of_order_set_does_not_clobber():
+    # older $set arriving later must not overwrite a newer value
+    events = [
+        ev("$set", "u1", {"a": "new"}, 5),
+        ev("$set", "u1", {"a": "old", "b": "old"}, 1),
+    ]
+    result = aggregate_properties_from_events(events)
+    assert result["u1"].to_dict() == {"a": "new", "b": "old"}
+
+
+def test_unset_removes_keys():
+    events = [
+        ev("$set", "u1", {"a": 1, "b": 2}, 0),
+        ev("$unset", "u1", {"a": None}, 1),
+    ]
+    result = aggregate_properties_from_events(events)
+    assert result["u1"].to_dict() == {"b": 2}
+
+
+def test_unset_then_newer_set_restores():
+    events = [
+        ev("$set", "u1", {"a": 1}, 0),
+        ev("$unset", "u1", {"a": None}, 1),
+        ev("$set", "u1", {"a": 9}, 2),
+    ]
+    result = aggregate_properties_from_events(events)
+    assert result["u1"].to_dict() == {"a": 9}
+
+
+def test_delete_removes_entity():
+    events = [
+        ev("$set", "u1", {"a": 1}, 0),
+        ev("$delete", "u1", {}, 1),
+    ]
+    assert aggregate_properties_from_events(events) == {}
+
+
+def test_delete_then_set_recreates():
+    events = [
+        ev("$set", "u1", {"a": 1, "b": 2}, 0),
+        ev("$delete", "u1", {}, 1),
+        ev("$set", "u1", {"c": 3}, 2),
+    ]
+    result = aggregate_properties_from_events(events)
+    assert result["u1"].to_dict() == {"c": 3}
+    assert result["u1"].first_updated == events[2].event_time
+
+
+def test_multiple_entities_and_required_filter():
+    events = [
+        ev("$set", "u1", {"a": 1, "b": 2}, 0),
+        ev("$set", "u2", {"a": 5}, 0),
+        ev("rate", "u3", {"a": 9}, 0),  # non-special events ignored
+    ]
+    result = aggregate_properties_from_events(events)
+    assert set(result) == {"u1", "u2"}
+    filtered = aggregate_properties_from_events(events, required=["b"])
+    assert set(filtered) == {"u1"}

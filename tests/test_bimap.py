@@ -1,0 +1,46 @@
+"""BiMap behavior (ref spec: data/.../storage/BiMapSpec.scala)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.bimap import BiMap
+
+
+def test_forward_and_inverse():
+    m = BiMap({"a": 1, "b": 2})
+    assert m["a"] == 1
+    assert m.inverse()[2] == "b"
+    assert m.inverse().inverse()["a"] == 1
+
+
+def test_values_must_be_unique():
+    with pytest.raises(ValueError):
+        BiMap({"a": 1, "b": 1})
+
+
+def test_string_int_indexing():
+    m = BiMap.string_int(["u3", "u1", "u3", "u2", "u1"])
+    assert len(m) == 3
+    assert m["u3"] == 0 and m["u1"] == 1 and m["u2"] == 2
+    inv = m.inverse()
+    assert [inv[i] for i in range(3)] == ["u3", "u1", "u2"]
+
+
+def test_take_submap():
+    m = BiMap.string_int(["a", "b", "c"])
+    sub = m.take(["a", "c", "zzz"])
+    assert sub.to_dict() == {"a": 0, "c": 2}
+
+
+def test_vectorized_index_array():
+    m = BiMap.string_int(["x", "y"])
+    arr = m.to_index_array(["y", "x", "y"])
+    assert arr.dtype == np.int64
+    np.testing.assert_array_equal(arr, [1, 0, 1])
+
+
+def test_get_and_contains():
+    m = BiMap.string_int(["a"])
+    assert "a" in m
+    assert m.get("missing") is None
+    assert m.contains_value(0)

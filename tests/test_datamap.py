@@ -1,0 +1,66 @@
+"""DataMap / PropertyMap behavior (ref spec: data/.../storage/DataMapSpec.scala)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap, DataMapError, PropertyMap
+
+UTC = dt.timezone.utc
+
+
+def test_typed_get():
+    d = DataMap({"a": 1, "b": "x", "c": 2.5, "d": True, "arr": [1, 2], "obj": {"k": 1}})
+    assert d.get("a", int) == 1
+    assert d.get("b", str) == "x"
+    assert d.get("c", float) == 2.5
+    assert d.get("a", float) == 1.0  # int widens to float
+    assert d.get("d", bool) is True
+    assert d.get("arr", list) == [1, 2]
+    assert d.get("obj", dict) == {"k": 1}
+
+
+def test_get_missing_raises():
+    d = DataMap({"a": 1})
+    with pytest.raises(DataMapError):
+        d.get("nope")
+
+
+def test_get_opt_and_or_else():
+    d = DataMap({"a": 1})
+    assert d.get_opt("a", int) == 1
+    assert d.get_opt("missing") is None
+    assert d.get_opt("missing", default=7) == 7
+    assert d.get_or_else("missing", "x") == "x"
+
+
+def test_type_mismatch():
+    d = DataMap({"a": "str"})
+    with pytest.raises(TypeError):
+        d.get("a", int)
+
+
+def test_merge_right_biased():
+    a = DataMap({"x": 1, "y": 2})
+    b = DataMap({"y": 3, "z": 4})
+    assert a.merge(b).to_dict() == {"x": 1, "y": 3, "z": 4}
+
+
+def test_remove_and_keyset():
+    d = DataMap({"x": 1, "y": 2, "z": 3})
+    assert d.remove(["y"]).keyset() == {"x", "z"}
+    assert d.keyset() == {"x", "y", "z"}  # immutable
+
+
+def test_json_roundtrip():
+    d = DataMap({"a": 1, "b": [1, "two"], "c": {"n": None}})
+    assert DataMap.from_json(d.to_json()) == d
+
+
+def test_property_map_carries_times():
+    t0 = dt.datetime(2026, 1, 1, tzinfo=UTC)
+    t1 = dt.datetime(2026, 1, 2, tzinfo=UTC)
+    pm = PropertyMap({"a": 1}, first_updated=t0, last_updated=t1)
+    assert pm.get("a", int) == 1
+    assert pm.first_updated == t0
+    assert pm.last_updated == t1

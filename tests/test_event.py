@@ -1,0 +1,113 @@
+"""Event model + validation (ref: data/.../storage/Event.scala:37,57
+and TestEvents.scala timezone cases)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import (
+    Event,
+    EventValidationError,
+    validate_event,
+)
+
+UTC = dt.timezone.utc
+
+
+def make(**kw):
+    base = dict(event="rate", entity_type="user", entity_id="u1")
+    base.update(kw)
+    return Event(**base)
+
+
+def test_basic_event_fields():
+    e = make(
+        target_entity_type="item",
+        target_entity_id="i1",
+        properties={"rating": 4.5},
+        tags=["a", "b"],
+        pr_id="pr1",
+    )
+    validate_event(e)
+    assert e.properties.get("rating", float) == 4.5
+    assert e.tags == ("a", "b")
+
+
+def test_json_roundtrip_preserves_timezone():
+    # ref: TestEvents.scala — events carry non-UTC zone offsets
+    tz = dt.timezone(dt.timedelta(hours=12, minutes=45))  # Pacific/Chatham-like
+    t = dt.datetime(2026, 12, 27, 11, 5, 1, 342000, tzinfo=tz)
+    e = make(event_time=t, properties={"a": 1})
+    d = e.to_dict(api_format=False)
+    e2 = Event.from_dict(d)
+    assert e2.event_time == t  # same instant
+    assert e2.properties == e.properties
+
+
+def test_millis_timestamp_parse():
+    e = Event.from_dict(
+        {"event": "buy", "entityType": "user", "entityId": "u1", "eventTime": 1735689600000}
+    )
+    assert e.event_time == dt.datetime(2025, 1, 1, tzinfo=UTC)
+
+
+def test_missing_required_field():
+    with pytest.raises(EventValidationError):
+        Event.from_dict({"event": "rate", "entityId": "u1"})
+
+
+@pytest.mark.parametrize("name", ["$set", "$unset", "$delete"])
+def test_special_events_allowed(name):
+    props = {"a": 1} if name != "$delete" else {}
+    e = make(event=name, properties=props)
+    validate_event(e)
+
+
+def test_unknown_dollar_event_rejected():
+    with pytest.raises(EventValidationError):
+        validate_event(make(event="$bogus"))
+
+
+def test_unset_requires_properties():
+    with pytest.raises(EventValidationError):
+        validate_event(make(event="$unset", properties={}))
+
+
+def test_special_event_cannot_have_target():
+    with pytest.raises(EventValidationError):
+        validate_event(
+            make(event="$set", properties={"a": 1}, target_entity_type="item", target_entity_id="i")
+        )
+
+
+def test_empty_entity_rejected():
+    with pytest.raises(EventValidationError):
+        validate_event(make(entity_id=""))
+    with pytest.raises(EventValidationError):
+        validate_event(make(entity_type=""))
+
+
+def test_target_fields_must_pair():
+    with pytest.raises(EventValidationError):
+        validate_event(make(target_entity_type="item"))
+
+
+def test_reserved_prefixes():
+    # ref: Event.scala:62 isReservedPrefix — both "$" and "pio_" prefixes
+    with pytest.raises(EventValidationError):
+        validate_event(make(entity_type="pio_custom"))
+    with pytest.raises(EventValidationError):
+        validate_event(make(entity_type="$custom"))
+    with pytest.raises(EventValidationError):
+        validate_event(make(properties={"pio_x": 1}))
+    with pytest.raises(EventValidationError):
+        validate_event(make(properties={"$x": 1}))
+    with pytest.raises(EventValidationError):
+        validate_event(make(event="pio_custom_event"))
+    with pytest.raises(EventValidationError):
+        validate_event(
+            make(target_entity_type="pio_custom", target_entity_id="t1")
+        )
+    # the only builtin entity type (ref: Event.scala:104)
+    validate_event(make(entity_type="pio_pr"))

@@ -1,0 +1,202 @@
+"""Event store + metadata DAO behavior across backends
+(ref specs: LEventsSpec.scala:21, PEventsSpec.scala:25 — but runnable
+in-process, no HBase needed)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.metadata import AccessKey, EngineInstance, Model
+from predictionio_tpu.data.storage import UNSET, Storage, StorageError, set_storage
+from predictionio_tpu.data import store
+
+UTC = dt.timezone.utc
+
+
+def make_storage(kind, tmp_path):
+    if kind == "memory":
+        env = {"PIO_STORAGE_SOURCES_S_TYPE": "memory"}
+    else:
+        env = {
+            "PIO_STORAGE_SOURCES_S_TYPE": "localfs",
+            "PIO_STORAGE_SOURCES_S_PATH": str(tmp_path / "store"),
+        }
+    env.update(
+        {
+            "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "meta",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "S",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "events",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "S",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "models",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "S",
+        }
+    )
+    return Storage.from_env(env)
+
+
+@pytest.fixture(params=["memory", "localfs"])
+def storage(request, tmp_path):
+    return make_storage(request.param, tmp_path)
+
+
+def ev(name="rate", uid="u1", iid="i1", minute=0, props=None):
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=uid,
+        target_entity_type="item" if iid else None,
+        target_entity_id=iid,
+        properties=props or {},
+        event_time=dt.datetime(2026, 1, 1, 0, minute, tzinfo=UTC),
+    )
+
+
+def test_event_crud(storage):
+    es = storage.events()
+    es.init(1)
+    eid = es.insert(ev(props={"rating": 5}), 1)
+    got = es.get(eid, 1)
+    assert got.event == "rate"
+    assert got.properties.get("rating", int) == 5
+    assert es.delete(eid, 1) is True
+    assert es.get(eid, 1) is None
+    assert es.delete(eid, 1) is False
+
+
+def test_find_filters(storage):
+    es = storage.events()
+    es.init(1)
+    es.insert(ev("rate", "u1", "i1", 0), 1)
+    es.insert(ev("rate", "u2", "i2", 1), 1)
+    es.insert(ev("buy", "u1", "i2", 2), 1)
+    es.insert(ev("$set", "u1", None, 3, {"a": 1}), 1)
+
+    assert len(es.find(1)) == 4
+    assert [e.entity_id for e in es.find(1, event_names=["rate"])] == ["u1", "u2"]
+    assert len(es.find(1, entity_id="u1")) == 3
+    assert len(es.find(1, target_entity_id="i2")) == 2
+    # target_entity_type=None means "no target entity" (UNSET = don't care)
+    assert [e.event for e in es.find(1, target_entity_type=None)] == ["$set"]
+    # time window is half-open [start, until)
+    t1 = dt.datetime(2026, 1, 1, 0, 1, tzinfo=UTC)
+    t2 = dt.datetime(2026, 1, 1, 0, 2, tzinfo=UTC)
+    window = es.find(1, start_time=t1, until_time=t2)
+    assert [e.event for e in window] == ["rate"]
+    # limit + reversed
+    newest = es.find(1, limit=1, reversed=True)
+    assert newest[0].event == "$set"
+
+
+def test_channel_isolation(storage):
+    es = storage.events()
+    es.init(1)
+    es.init(1, channel_id=2)
+    es.insert(ev("rate", "u1"), 1)
+    es.insert(ev("buy", "u2"), 1, channel_id=2)
+    assert [e.event for e in es.find(1)] == ["rate"]
+    assert [e.event for e in es.find(1, channel_id=2)] == ["buy"]
+    es.remove(1, channel_id=2)
+    es.init(1, channel_id=2)
+    assert es.find(1, channel_id=2) == []
+
+
+def test_aggregate_properties_via_store(storage):
+    es = storage.events()
+    es.init(1)
+    es.insert(ev("$set", "u1", None, 0, {"a": 1, "b": 2}), 1)
+    es.insert(ev("$unset", "u1", None, 1, {"b": None}), 1)
+    es.insert(ev("$set", "u2", None, 0, {"a": 9}), 1)
+    es.insert(ev("$delete", "u2", None, 1), 1)
+    props = es.aggregate_properties(1, "user")
+    assert set(props) == {"u1"}
+    assert props["u1"].to_dict() == {"a": 1}
+
+
+def test_metadata_repos(storage):
+    apps = storage.apps()
+    app = apps.insert("myapp", "desc")
+    assert app.id >= 1
+    assert apps.get_by_name("myapp").id == app.id
+    with pytest.raises(StorageError):
+        apps.insert("myapp")
+
+    keys = storage.access_keys()
+    k = AccessKey.generate(app.id, events=["rate"])
+    keys.insert(k)
+    assert keys.get(k.key).appid == app.id
+    assert len(k.key) == 64
+
+    channels = storage.channels()
+    ch = channels.insert("live", app.id)
+    assert channels.get_by_app_id(app.id)[0].name == "live"
+    with pytest.raises(StorageError):
+        channels.insert("bad name!", app.id)
+
+
+def test_engine_instances_latest_completed(storage):
+    repo = storage.engine_instances()
+    t0 = dt.datetime(2026, 1, 1, tzinfo=UTC)
+
+    def mk(i, status):
+        return EngineInstance(
+            id=f"id{i}", status=status,
+            start_time=t0 + dt.timedelta(hours=i), end_time=t0 + dt.timedelta(hours=i + 1),
+            engine_id="e", engine_version="1", engine_variant="v", engine_factory="f",
+        )
+
+    repo.insert(mk(0, "COMPLETED"))
+    repo.insert(mk(1, "FAILED"))
+    repo.insert(mk(2, "COMPLETED"))
+    latest = repo.get_latest_completed("e", "1", "v")
+    assert latest.id == "id2"
+    assert repo.get_latest_completed("other", "1", "v") is None
+
+
+def test_models_blob_roundtrip(storage):
+    models = storage.models()
+    models.insert(Model(id="m1", models=b"\x00\x01binary"))
+    assert models.get("m1").models == b"\x00\x01binary"
+    models.delete("m1")
+    assert models.get("m1") is None
+
+
+def test_localfs_survives_restart(tmp_path):
+    s1 = make_storage("localfs", tmp_path)
+    app = s1.apps().insert("persisted")
+    s1.events().init(app.id)
+    eid = s1.events().insert(ev(props={"x": 1}), app.id)
+    deleted = s1.events().insert(ev("buy", "u9"), app.id)
+    s1.events().delete(deleted, app.id)
+    s1.models().insert(Model(id="m", models=b"blob"))
+
+    # fresh client over the same directory replays to identical state
+    s2 = make_storage("localfs", tmp_path)
+    assert s2.apps().get_by_name("persisted").id == app.id
+    events = s2.events().find(app.id)
+    assert [e.event_id for e in events] == [eid]
+    assert s2.models().get("m").models == b"blob"
+    # sequence counter continues, no id reuse
+    assert s2.apps().insert("second").id == app.id + 1
+
+
+def test_public_store_api(memory_storage):
+    app = memory_storage.apps().insert("shop")
+    memory_storage.events().init(app.id)
+    memory_storage.events().insert(ev("$set", "u1", None, 0, {"vip": True}), app.id)
+    memory_storage.events().insert(ev("rate", "u1", "i1", 1, {"rating": 5}), app.id)
+
+    assert len(store.find("shop")) == 2
+    assert store.aggregate_properties("shop", "user")["u1"].get("vip", bool) is True
+    latest = store.find_by_entity("shop", "user", "u1", event_names=["rate"], limit=1)
+    assert latest[0].properties.get("rating", int) == 5
+    with pytest.raises(StorageError):
+        store.find("no-such-app")
+    with pytest.raises(StorageError):
+        store.find("shop", channel_name="nope")
+
+
+def test_verify_all_data_objects(storage):
+    assert storage.verify_all_data_objects() == {
+        "METADATA": True, "EVENTDATA": True, "MODELDATA": True,
+    }
