@@ -1,0 +1,40 @@
+"""DASE controller framework (ref: core/src/main/scala/io/prediction/{core,controller}/).
+
+The reference splits every DASE role into P* (Spark RDD) and L* (local)
+class families (PDataSource/LDataSource, PAlgorithm/P2LAlgorithm/
+LAlgorithm, ...). Without Spark that split disappears: one class per
+role, and "parallel vs local" becomes a property of the *data* — a
+TrainingData that is a pytree of (possibly mesh-sharded) arrays runs on
+the mesh; one that is plain Python runs on the host (SURVEY.md §7.3).
+"""
+
+from predictionio_tpu.core.params import Params, EmptyParams, EngineParams
+from predictionio_tpu.core.controller import (
+    Algorithm,
+    AverageServing,
+    DataSource,
+    FirstServing,
+    IdentityPreparator,
+    Preparator,
+    SanityCheck,
+    Serving,
+)
+from predictionio_tpu.core.engine import Engine, EngineFactory, SimpleEngine, TrainResult
+
+__all__ = [
+    "Params",
+    "EmptyParams",
+    "EngineParams",
+    "DataSource",
+    "Preparator",
+    "IdentityPreparator",
+    "Algorithm",
+    "Serving",
+    "FirstServing",
+    "AverageServing",
+    "SanityCheck",
+    "Engine",
+    "EngineFactory",
+    "SimpleEngine",
+    "TrainResult",
+]

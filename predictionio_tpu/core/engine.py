@@ -1,0 +1,266 @@
+"""The Engine: named DASE component maps + train/eval orchestration.
+
+Behavior contract from the reference (controller/Engine.scala):
+
+  - an Engine holds *maps* of named component classes per DASE slot
+    (Engine.scala:78); an EngineParams picks one name per slot (plus a
+    list for algorithms) — together they define a trainable/deployable
+    pipeline
+  - `train` (object Engine.train:583): read -> sanity-check ->
+    [stop-after-read] -> prepare -> sanity-check -> [stop-after-prepare]
+    -> train each algorithm -> sanity-check models
+  - `eval` (object Engine.eval:688): per fold from readEval, prepare +
+    train all algorithms, batch-predict each algorithm over indexed
+    queries, regroup per query, serve -> (query, prediction, actual)
+  - engine.json variant JSON -> EngineParams
+    (Engine.scala jValueToEngineParams:328)
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Type, Union
+
+from predictionio_tpu.core.controller import (
+    Algorithm,
+    DataSource,
+    Preparator,
+    SanityCheck,
+    Serving,
+)
+from predictionio_tpu.core.params import (
+    EmptyParams,
+    EngineParams,
+    Params,
+    params_from_dict,
+)
+from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.workflow.config import WorkflowParams
+
+log = logging.getLogger(__name__)
+
+ClassMap = Union[type, Dict[str, type]]
+
+
+def _as_map(classes: ClassMap) -> Dict[str, type]:
+    if isinstance(classes, dict):
+        return dict(classes)
+    return {"": classes}
+
+
+def _declared_params_class(cls: type) -> Optional[Type[Params]]:
+    """The params dataclass a component declares.
+
+    Resolution order: explicit ``params_class`` attribute, then the type
+    annotation of the ctor's ``params`` argument (the analogue of the
+    reference reflecting ctor signatures, AbstractDoer.scala:24).
+    """
+    pc = getattr(cls, "params_class", None)
+    if pc is not None:
+        return pc
+    import typing
+
+    try:
+        hints = typing.get_type_hints(cls.__init__)
+    except Exception:
+        return None
+    ann = hints.get("params")
+    return ann if isinstance(ann, type) else None
+
+
+def _sanity(obj: Any, wp: WorkflowParams, stage: str) -> None:
+    """ref: Engine.scala:610-666 — check TD/PD/models implementing SanityCheck."""
+    if wp.skip_sanity_check:
+        return
+    if isinstance(obj, SanityCheck):
+        log.info("sanity check %s", stage)
+        obj.sanity_check()
+
+
+@dataclass
+class TrainResult:
+    """Outcome of Engine.train — models plus debug-interruption state."""
+
+    models: Optional[List[Any]] = None
+    stopped_after: Optional[str] = None  # None | "read" | "prepare"
+    training_data: Any = None
+    prepared_data: Any = None
+
+
+class Engine:
+    """ref: controller/Engine.scala:78."""
+
+    def __init__(
+        self,
+        data_source_classes: ClassMap,
+        preparator_classes: ClassMap,
+        algorithm_classes: ClassMap,
+        serving_classes: ClassMap,
+    ):
+        self.data_source_classes = _as_map(data_source_classes)
+        self.preparator_classes = _as_map(preparator_classes)
+        self.algorithm_classes = _as_map(algorithm_classes)
+        self.serving_classes = _as_map(serving_classes)
+
+    # -- component instantiation (ref: Doer(…) calls in Engine.scala:140-150) --
+    def _make(self, classes: Dict[str, type], slot: Tuple[str, Params], role: str):
+        name, params = slot
+        if name not in classes:
+            raise KeyError(
+                f"{role} {name!r} not found (available: {sorted(classes)})"
+            )
+        return classes[name].create(params)
+
+    def make_data_source(self, ep: EngineParams) -> DataSource:
+        return self._make(self.data_source_classes, ep.data_source_params, "DataSource")
+
+    def make_preparator(self, ep: EngineParams) -> Preparator:
+        return self._make(self.preparator_classes, ep.preparator_params, "Preparator")
+
+    def make_algorithms(self, ep: EngineParams) -> List[Algorithm]:
+        if not ep.algorithm_params_list:
+            raise ValueError("EngineParams.algorithm_params_list must not be empty")
+        return [
+            self._make(self.algorithm_classes, slot, "Algorithm")
+            for slot in ep.algorithm_params_list
+        ]
+
+    def make_serving(self, ep: EngineParams) -> Serving:
+        return self._make(self.serving_classes, ep.serving_params, "Serving")
+
+    # -- training (ref: object Engine.train:583) ----------------------------
+    def train(
+        self,
+        ctx: MeshContext,
+        engine_params: EngineParams,
+        workflow_params: Optional[WorkflowParams] = None,
+    ) -> TrainResult:
+        wp = workflow_params or WorkflowParams()
+        data_source = self.make_data_source(engine_params)
+        td = data_source.read_training(ctx)
+        _sanity(td, wp, "training data")
+        if wp.stop_after_read:
+            return TrainResult(stopped_after="read", training_data=td)
+
+        preparator = self.make_preparator(engine_params)
+        pd = preparator.prepare(ctx, td)
+        _sanity(pd, wp, "prepared data")
+        if wp.stop_after_prepare:
+            return TrainResult(stopped_after="prepare", training_data=td, prepared_data=pd)
+
+        algorithms = self.make_algorithms(engine_params)
+        models = []
+        for i, algo in enumerate(algorithms):
+            model = algo.train(ctx, pd)  # HOT LOOP (ref: Engine.scala:650)
+            _sanity(model, wp, f"model {i}")
+            models.append(model)
+        return TrainResult(models=models, training_data=td, prepared_data=pd)
+
+    # -- evaluation (ref: object Engine.eval:688) ---------------------------
+    def eval(
+        self,
+        ctx: MeshContext,
+        engine_params: EngineParams,
+        workflow_params: Optional[WorkflowParams] = None,
+    ) -> List[Tuple[Any, List[Tuple[Any, Any, Any]]]]:
+        """Returns per fold: (eval info, [(query, prediction, actual)])."""
+        wp = workflow_params or WorkflowParams()
+        data_source = self.make_data_source(engine_params)
+        preparator = self.make_preparator(engine_params)
+        algorithms = self.make_algorithms(engine_params)
+        serving = self.make_serving(engine_params)
+
+        eval_data = data_source.read_eval(ctx)
+        results = []
+        for td, ei, qa_pairs in eval_data:
+            pd = preparator.prepare(ctx, td)
+            models = [algo.train(ctx, pd) for algo in algorithms]
+            indexed_queries = [(i, q) for i, (q, _a) in enumerate(qa_pairs)]
+            # per-algo batch predict, regrouped per query index
+            # (ref: Engine.scala:737-750 union + groupByKey)
+            per_query: Dict[int, List[Any]] = {i: [] for i, _ in indexed_queries}
+            for algo, model in zip(algorithms, models):
+                for i, p in algo.batch_predict(model, indexed_queries):
+                    per_query[i].append(p)
+            qpa = [
+                (q, serving.serve(q, per_query[i]), a)
+                for i, (q, a) in enumerate(qa_pairs)
+            ]
+            results.append((ei, qpa))
+        return results
+
+    # -- variant JSON -> EngineParams (ref: Engine.jValueToEngineParams:328) --
+    def engine_params_from_variant(self, variant: Dict[str, Any]) -> EngineParams:
+        def slot(key: str, classes: Dict[str, type]) -> Tuple[str, Params]:
+            block = variant.get(key)
+            if block is None:
+                name = "" if "" in classes else next(iter(sorted(classes)))
+                return (name, _materialize(classes, name, {}))
+            name = block.get("name", "")
+            return (name, _materialize(classes, name, block.get("params")))
+
+        algo_blocks = variant.get("algorithms")
+        if algo_blocks is None:
+            name = "" if "" in self.algorithm_classes else next(iter(sorted(self.algorithm_classes)))
+            algo_list = [(name, _materialize(self.algorithm_classes, name, {}))]
+        else:
+            algo_list = [
+                (
+                    b.get("name", ""),
+                    _materialize(self.algorithm_classes, b.get("name", ""), b.get("params")),
+                )
+                for b in algo_blocks
+            ]
+        return EngineParams(
+            data_source_params=slot("datasource", self.data_source_classes),
+            preparator_params=slot("preparator", self.preparator_classes),
+            algorithm_params_list=algo_list,
+            serving_params=slot("serving", self.serving_classes),
+        )
+
+
+def _materialize(classes: Dict[str, type], name: str, params_dict: Optional[dict]) -> Params:
+    if name not in classes:
+        raise KeyError(f"component {name!r} not found (available: {sorted(classes)})")
+    return params_from_dict(_declared_params_class(classes[name]), params_dict)
+
+
+class SimpleEngine(Engine):
+    """1-of-each sugar (ref: EngineParams.scala:98 SimpleEngine)."""
+
+    def __init__(self, data_source: type, preparator: type, algorithm: type, serving: type):
+        super().__init__(data_source, preparator, algorithm, serving)
+
+
+class EngineFactory(abc.ABC):
+    """User entry point (ref: EngineFactory.scala:28) —
+    ``class MyEngine(EngineFactory)`` with ``apply()`` returning an Engine."""
+
+    @abc.abstractmethod
+    def apply(self) -> Engine:
+        ...
+
+
+def resolve_engine_factory(dotted: str) -> Callable[[], Engine]:
+    """'pkg.module.ObjName' -> zero-arg engine factory.
+
+    ref: WorkflowUtils.getEngine:60 — accepts an EngineFactory subclass,
+    an instance, a plain function, or an Engine-returning attribute.
+    """
+    import importlib
+
+    module_name, _, attr = dotted.rpartition(".")
+    if not module_name:
+        raise ValueError(f"engine factory {dotted!r} must be a dotted path")
+    obj = getattr(importlib.import_module(module_name), attr)
+    if isinstance(obj, type) and issubclass(obj, EngineFactory):
+        return obj().apply
+    if isinstance(obj, EngineFactory):
+        return obj.apply
+    if isinstance(obj, Engine):
+        return lambda: obj
+    if callable(obj):
+        return obj
+    raise TypeError(f"{dotted} is not an EngineFactory / Engine / callable")

@@ -1,0 +1,91 @@
+"""Opt-in custom model persistence.
+
+Behavior contract from the reference (controller/PersistentModel.scala:47,72
+and PersistentModelManifest.scala:18): an algorithm whose model
+implements PersistentModel saves itself under the engine-instance id and
+is reloaded (not unpickled) at deploy; the Models repo then stores only
+a manifest naming the loader class. LocalFileSystemPersistentModel
+(ref: LocalFileSystemPersistentModel.scala:26) is the ready-made file
+based implementation.
+
+The reference's third path — a `Unit` model sentinel forcing a full
+retrain at deploy (Engine.scala:186-204) — is intentionally dropped:
+array models are cheap to persist (SURVEY.md §7 hard-part (c)).
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+import pickle
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from predictionio_tpu.core.params import Params
+from predictionio_tpu.parallel.mesh import MeshContext
+
+
+@dataclass(frozen=True)
+class PersistentModelManifest:
+    """Stored in the Models repo instead of the model bytes
+    (ref: PersistentModelManifest.scala:18)."""
+
+    class_name: str
+    module_name: str
+
+
+class PersistentModel(abc.ABC):
+    """Models that manage their own persistence (ref: PersistentModel.scala:47)."""
+
+    @abc.abstractmethod
+    def save(self, instance_id: str, params: Params, ctx: MeshContext) -> bool:
+        """Persist under the engine-instance id; return True if saved."""
+
+    @classmethod
+    @abc.abstractmethod
+    def load(cls, instance_id: str, params: Params, ctx: MeshContext) -> "PersistentModel":
+        """ref: PersistentModelLoader.apply."""
+
+
+def model_base_dir() -> str:
+    base = os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store"))
+    path = os.path.join(base, "persistent_models")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+class LocalFileSystemPersistentModel(PersistentModel):
+    """File-per-instance pickle persistence
+    (ref: LocalFileSystemPersistentModel.scala:26)."""
+
+    def save(self, instance_id: str, params: Params, ctx: MeshContext) -> bool:
+        with open(os.path.join(model_base_dir(), instance_id), "wb") as f:
+            pickle.dump(self, f)
+        return True
+
+    @classmethod
+    def load(cls, instance_id: str, params: Params, ctx: MeshContext):
+        with open(os.path.join(model_base_dir(), instance_id), "rb") as f:
+            return pickle.load(f)
+
+
+def manifest_for(model: PersistentModel) -> PersistentModelManifest:
+    return PersistentModelManifest(
+        class_name=type(model).__qualname__, module_name=type(model).__module__
+    )
+
+
+def load_from_manifest(
+    manifest: PersistentModelManifest,
+    instance_id: str,
+    params: Params,
+    ctx: MeshContext,
+) -> Any:
+    """ref: SparkWorkflowUtils.getPersistentModel (WorkflowUtils.scala:356)."""
+    import importlib
+
+    module = importlib.import_module(manifest.module_name)
+    cls = module
+    for part in manifest.class_name.split("."):
+        cls = getattr(cls, part)
+    return cls.load(instance_id, params, ctx)

@@ -13,11 +13,22 @@ Maps the reference's three default backends onto one directory tree:
 Writes go through the in-memory DAOs and are persisted with
 atomic-rename JSON snapshots (metadata) or appends (events), so a
 process restart replays to the same state.
+
+Multi-process coordination (CLI + servers sharing one basedir): every
+metadata mutation re-syncs from disk under an exclusive flock before
+applying, and read accessors reload when the file mtime changes. A
+mutation lost to the residual window between reload and save would
+require two processes mutating metadata in the same few microseconds —
+acceptable for the single-host tier this backend targets (scale-out
+backends own that problem properly).
 """
 
 from __future__ import annotations
 
+import contextlib
+import fcntl
 import json
+import logging
 import os
 import threading
 from typing import Dict, Optional, Tuple
@@ -36,6 +47,8 @@ from predictionio_tpu.data.metadata import (
 )
 from predictionio_tpu.data import storage as S
 from predictionio_tpu.data.backends import memory as M
+
+log = logging.getLogger(__name__)
 
 
 def _atomic_write(path: str, data: str) -> None:
@@ -61,25 +74,36 @@ class LocalFSEventStore(M.MemoryEventStore):
         return os.path.join(self._dir, name + ".jsonl")
 
     def _ensure_loaded(self, app_id: int, channel_id: Optional[int]) -> None:
-        key = (int(app_id), channel_id if channel_id is None else int(channel_id))
+        key = M._table_key(app_id, channel_id)
         if key in self._loaded:
             return
-        self._loaded.add(key)
         path = self._path(app_id, channel_id)
         if not os.path.exists(path):
             return
-        tbl = super()._table(app_id, channel_id, create=True)
+        tbl: Dict[str, Event] = {}
         with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
+            lines = f.readlines()
+        for lineno, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
                 d = json.loads(line)
-                if "__tombstone__" in d:
-                    tbl.pop(d["__tombstone__"], None)
-                else:
-                    e = Event.from_dict(d)
-                    tbl[e.event_id] = e
+            except json.JSONDecodeError:
+                # a torn final line (crash mid-append) is recoverable;
+                # corruption earlier in the log is not
+                if lineno == len(lines) - 1:
+                    log.warning("%s: dropping torn final line", path)
+                    continue
+                raise S.StorageError(f"{path}:{lineno + 1}: corrupt event log line")
+            if "__tombstone__" in d:
+                tbl.pop(d["__tombstone__"], None)
+            else:
+                e = Event.from_dict(d)
+                tbl[e.event_id] = e
+        # publish only after a full successful replay
+        self._tables[key] = tbl
+        self._loaded.add(key)
 
     def _append(self, app_id, channel_id, record: dict) -> None:
         with open(self._path(app_id, channel_id), "a") as f:
@@ -90,6 +114,7 @@ class LocalFSEventStore(M.MemoryEventStore):
         with self._lock:
             self._ensure_loaded(app_id, channel_id)
             super().init(app_id, channel_id)
+            self._loaded.add(M._table_key(app_id, channel_id))
             path = self._path(app_id, channel_id)
             if not os.path.exists(path):
                 open(path, "a").close()
@@ -97,9 +122,7 @@ class LocalFSEventStore(M.MemoryEventStore):
     def remove(self, app_id, channel_id=None):
         with self._lock:
             super().remove(app_id, channel_id)
-            self._loaded.discard(
-                (int(app_id), channel_id if channel_id is None else int(channel_id))
-            )
+            self._loaded.discard(M._table_key(app_id, channel_id))
             try:
                 os.remove(self._path(app_id, channel_id))
             except FileNotFoundError:
@@ -161,12 +184,12 @@ class LocalFSModelsRepo(S.ModelsRepo):
 
 
 _META_RECORDS = {
-    "apps": (App, "_apps", lambda r: r.id),
-    "access_keys": (AccessKey, "_keys", lambda r: r.key),
-    "channels": (Channel, "_channels", lambda r: r.id),
-    "engine_manifests": (EngineManifest, "_manifests", lambda r: (r.id, r.version)),
-    "engine_instances": (EngineInstance, "_instances", lambda r: r.id),
-    "evaluation_instances": (EvaluationInstance, "_instances", lambda r: r.id),
+    "apps": (App, lambda r: r.id),
+    "access_keys": (AccessKey, lambda r: r.key),
+    "channels": (Channel, lambda r: r.id),
+    "engine_manifests": (EngineManifest, lambda r: (r.id, r.version)),
+    "engine_instances": (EngineInstance, lambda r: r.id),
+    "evaluation_instances": (EvaluationInstance, lambda r: r.id),
 }
 
 
@@ -179,21 +202,34 @@ class LocalFSStorageClient(S.StorageClient):
         os.makedirs(basedir, exist_ok=True)
         self._basedir = basedir
         self._meta_path = os.path.join(basedir, "metadata.json")
+        self._lock_path = os.path.join(basedir, ".metadata.lock")
+        self._meta_mtime: Optional[int] = None
         self._lock = threading.RLock()
         self._sequences = M._Sequences()
-        save = self._save_metadata
+        save, sync = self._save_metadata, self._sync_from_disk
         self._events = LocalFSEventStore(basedir)
-        self._apps = M.MemoryAppsRepo(self._sequences, self._lock, save)
-        self._access_keys = M.MemoryAccessKeysRepo(self._lock, save)
-        self._channels = M.MemoryChannelsRepo(self._sequences, self._lock, save)
-        self._engine_manifests = M.MemoryEngineManifestsRepo(self._lock, save)
-        self._engine_instances = M.MemoryEngineInstancesRepo(self._lock, save)
-        self._evaluation_instances = M.MemoryEvaluationInstancesRepo(self._lock, save)
+        self._apps = M.MemoryAppsRepo(self._sequences, self._lock, save, sync)
+        self._access_keys = M.MemoryAccessKeysRepo(self._lock, save, sync)
+        self._channels = M.MemoryChannelsRepo(self._sequences, self._lock, save, sync)
+        self._engine_manifests = M.MemoryEngineManifestsRepo(self._lock, save, sync)
+        self._engine_instances = M.MemoryEngineInstancesRepo(self._lock, save, sync)
+        self._evaluation_instances = M.MemoryEvaluationInstancesRepo(self._lock, save, sync)
         self._models = LocalFSModelsRepo(basedir)
         self._loading = False
-        self._load_metadata()
+        with self._flocked():
+            self._load_metadata()
 
     # -- persistence --------------------------------------------------------
+    @contextlib.contextmanager
+    def _flocked(self):
+        """Cross-process exclusive lock for metadata load/save."""
+        with open(self._lock_path, "a+") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
+
     def _repos(self):
         return {
             "apps": self._apps,
@@ -204,44 +240,79 @@ class LocalFSStorageClient(S.StorageClient):
             "evaluation_instances": self._evaluation_instances,
         }
 
+    def _stat_mtime(self) -> Optional[int]:
+        try:
+            return os.stat(self._meta_path).st_mtime_ns
+        except FileNotFoundError:
+            return None
+
     def _save_metadata(self) -> None:
         if self._loading:
             return
-        with self._lock:
+        with self._lock, self._flocked():
             doc = {"sequences": self._sequences.state()}
-            for name, (cls, attr, _key) in _META_RECORDS.items():
+            for name in _META_RECORDS:
                 repo = self._repos()[name]
-                records = list(getattr(repo, attr).values())
-                doc[name] = [record_to_dict(r) for r in records]
+                doc[name] = [record_to_dict(r) for r in repo._records.values()]
             _atomic_write(self._meta_path, json.dumps(doc, indent=1, sort_keys=True))
+            self._meta_mtime = self._stat_mtime()
+
+    def _sync_from_disk(self) -> None:
+        """pre_change hook: pick up other processes' writes before mutating."""
+        if self._loading:
+            return
+        if self._stat_mtime() == self._meta_mtime:
+            return
+        with self._lock, self._flocked():
+            self._load_metadata()
 
     def _load_metadata(self) -> None:
-        if not os.path.exists(self._meta_path):
+        mtime = self._stat_mtime()
+        if mtime is None:
             return
         with open(self._meta_path) as f:
             doc = json.load(f)
         self._loading = True
         try:
             with self._lock:
-                self._sequences.restore(doc.get("sequences", {}))
-                for name, (cls, attr, key) in _META_RECORDS.items():
+                self._sequences.merge_max(doc.get("sequences", {}))
+                for name, (cls, key) in _META_RECORDS.items():
                     repo = self._repos()[name]
-                    store = getattr(repo, attr)
-                    store.clear()
+                    repo._records.clear()
                     for rd in doc.get(name, []):
                         rec = dict_to_record(cls, rd)
-                        store[key(rec)] = rec
+                        repo._records[key(rec)] = rec
+                self._meta_mtime = mtime
         finally:
             self._loading = False
 
     # -- accessors ----------------------------------------------------------
     def events(self): return self._events
-    def apps(self): return self._apps
-    def access_keys(self): return self._access_keys
-    def channels(self): return self._channels
-    def engine_manifests(self): return self._engine_manifests
-    def engine_instances(self): return self._engine_instances
-    def evaluation_instances(self): return self._evaluation_instances
+
+    def apps(self):
+        self._sync_from_disk()
+        return self._apps
+
+    def access_keys(self):
+        self._sync_from_disk()
+        return self._access_keys
+
+    def channels(self):
+        self._sync_from_disk()
+        return self._channels
+
+    def engine_manifests(self):
+        self._sync_from_disk()
+        return self._engine_manifests
+
+    def engine_instances(self):
+        self._sync_from_disk()
+        return self._engine_instances
+
+    def evaluation_instances(self):
+        self._sync_from_disk()
+        return self._evaluation_instances
+
     def models(self): return self._models
 
 

@@ -4,15 +4,20 @@ Implements every DAO contract from predictionio_tpu.data.storage with
 plain dicts under one RLock. This backend is what makes the whole
 framework testable in-process (the reference's storage tests need a live
 HBase + Elasticsearch; see SURVEY.md §4).
+
+Records are deep-copied at the repo boundary (insert/update/get), so
+callers mutating a dataclass after insert cannot bypass ``update`` —
+matching the serialize-on-write behavior of the reference's real
+backends. Repos accept ``on_change`` / ``pre_change`` hooks used by the
+localfs backend to persist after, and reload before, each mutation.
 """
 
 from __future__ import annotations
 
-import datetime as _dt
-import itertools
+import copy
 import threading
 import uuid
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data.metadata import (
@@ -26,6 +31,12 @@ from predictionio_tpu.data.metadata import (
 )
 from predictionio_tpu.data import storage as S
 
+_cp = copy.deepcopy
+
+
+def _table_key(app_id: int, channel_id: Optional[int]) -> Tuple[int, Optional[int]]:
+    return (int(app_id), channel_id if channel_id is None else int(channel_id))
+
 
 class MemoryEventStore(S.EventStore):
     def __init__(self):
@@ -34,12 +45,16 @@ class MemoryEventStore(S.EventStore):
         self._tables: Dict[Tuple[int, Optional[int]], Dict[str, Event]] = {}
 
     def _table(self, app_id: int, channel_id: Optional[int], create: bool = False):
-        key = (int(app_id), channel_id if channel_id is None else int(channel_id))
+        key = _table_key(app_id, channel_id)
         if create:
             return self._tables.setdefault(key, {})
         tbl = self._tables.get(key)
         if tbl is None:
-            raise S.StorageError(f"event table for app {app_id} channel {channel_id} not initialized")
+            # strict reads: an un-init()ed table is an error, like a missing
+            # HBase table in the reference (hbase/HBLEvents.scala)
+            raise S.StorageError(
+                f"event table for app {app_id} channel {channel_id} not initialized"
+            )
         return tbl
 
     def init(self, app_id, channel_id=None):
@@ -48,22 +63,22 @@ class MemoryEventStore(S.EventStore):
 
     def remove(self, app_id, channel_id=None):
         with self._lock:
-            self._tables.pop((int(app_id), channel_id if channel_id is None else int(channel_id)), None)
+            self._tables.pop(_table_key(app_id, channel_id), None)
 
     def insert(self, event: Event, app_id, channel_id=None) -> str:
         with self._lock:
-            tbl = self._table(app_id, channel_id, create=True)
+            tbl = self._table(app_id, channel_id)
             e = event if event.event_id else event.with_id()
             tbl[e.event_id] = e
             return e.event_id
 
     def get(self, event_id, app_id, channel_id=None):
         with self._lock:
-            return self._table(app_id, channel_id, create=True).get(event_id)
+            return self._table(app_id, channel_id).get(event_id)
 
     def delete(self, event_id, app_id, channel_id=None) -> bool:
         with self._lock:
-            return self._table(app_id, channel_id, create=True).pop(event_id, None) is not None
+            return self._table(app_id, channel_id).pop(event_id, None) is not None
 
     def find(
         self,
@@ -80,7 +95,7 @@ class MemoryEventStore(S.EventStore):
         reversed=False,
     ) -> List[Event]:
         with self._lock:
-            events = list(self._table(app_id, channel_id, create=True).values())
+            events = list(self._table(app_id, channel_id).values())
         out = [
             e
             for e in events
@@ -141,183 +156,194 @@ class _Sequences:
     def restore(self, state: Dict[str, int]) -> None:
         self._counters = dict(state)
 
+    def merge_max(self, state: Dict[str, int]) -> None:
+        for k, v in state.items():
+            self._counters[k] = max(self._counters.get(k, 0), v)
 
-class MemoryAppsRepo(S.AppsRepo):
-    def __init__(self, sequences: _Sequences, lock: threading.RLock, on_change=None):
-        self._apps: Dict[int, App] = {}
-        self._seq = sequences
+
+class _RecordRepo:
+    """Shared dict-backed repo plumbing: lock, boundary copies, hooks."""
+
+    def __init__(self, lock: threading.RLock, on_change=None, pre_change=None):
+        self._records: Dict = {}
         self._lock = lock
         self._on_change = on_change or (lambda: None)
+        self._pre = pre_change or (lambda: None)
+
+    def _put(self, key, record) -> None:
+        self._records[key] = _cp(record)
+        self._on_change()
+
+    def _get(self, key):
+        rec = self._records.get(key)
+        return _cp(rec) if rec is not None else None
+
+    def _all(self) -> list:
+        return [_cp(r) for r in self._records.values()]
+
+    def _drop(self, key) -> None:
+        self._records.pop(key, None)
+        self._on_change()
+
+
+class MemoryAppsRepo(_RecordRepo, S.AppsRepo):
+    def __init__(self, sequences: _Sequences, lock, on_change=None, pre_change=None):
+        super().__init__(lock, on_change, pre_change)
+        self._seq = sequences
 
     def insert(self, name, description=None) -> App:
         with self._lock:
-            if self.get_by_name(name) is not None:
+            self._pre()
+            if any(a.name == name for a in self._records.values()):
                 raise S.StorageError(f"app name {name!r} already exists")
             app = App(id=self._seq.next("apps"), name=name, description=description)
-            self._apps[app.id] = app
-            self._on_change()
-            return app
+            self._put(app.id, app)
+            return _cp(app)
 
     def get(self, app_id):
         with self._lock:
-            return self._apps.get(int(app_id))
+            return self._get(int(app_id))
 
     def get_by_name(self, name):
         with self._lock:
-            return next((a for a in self._apps.values() if a.name == name), None)
+            rec = next((a for a in self._records.values() if a.name == name), None)
+            return _cp(rec) if rec is not None else None
 
     def get_all(self):
         with self._lock:
-            return sorted(self._apps.values(), key=lambda a: a.id)
+            return sorted(self._all(), key=lambda a: a.id)
 
     def update(self, app):
         with self._lock:
-            self._apps[app.id] = app
-            self._on_change()
+            self._pre()
+            self._put(app.id, app)
 
     def delete(self, app_id):
         with self._lock:
-            self._apps.pop(int(app_id), None)
-            self._on_change()
+            self._pre()
+            self._drop(int(app_id))
 
 
-class MemoryAccessKeysRepo(S.AccessKeysRepo):
-    def __init__(self, lock: threading.RLock, on_change=None):
-        self._keys: Dict[str, AccessKey] = {}
-        self._lock = lock
-        self._on_change = on_change or (lambda: None)
-
+class MemoryAccessKeysRepo(_RecordRepo, S.AccessKeysRepo):
     def insert(self, access_key: AccessKey) -> str:
         with self._lock:
+            self._pre()
             if not access_key.key:
                 access_key = AccessKey.generate(access_key.appid, access_key.events)
-            self._keys[access_key.key] = access_key
-            self._on_change()
+            self._put(access_key.key, access_key)
             return access_key.key
 
     def get(self, key):
         with self._lock:
-            return self._keys.get(key)
+            return self._get(key)
 
     def get_all(self):
         with self._lock:
-            return list(self._keys.values())
+            return self._all()
 
     def get_by_app_id(self, app_id):
         with self._lock:
-            return [k for k in self._keys.values() if k.appid == int(app_id)]
+            return [_cp(k) for k in self._records.values() if k.appid == int(app_id)]
 
     def update(self, access_key):
         with self._lock:
-            self._keys[access_key.key] = access_key
-            self._on_change()
+            self._pre()
+            self._put(access_key.key, access_key)
 
     def delete(self, key):
         with self._lock:
-            self._keys.pop(key, None)
-            self._on_change()
+            self._pre()
+            self._drop(key)
 
 
-class MemoryChannelsRepo(S.ChannelsRepo):
-    def __init__(self, sequences: _Sequences, lock: threading.RLock, on_change=None):
-        self._channels: Dict[int, Channel] = {}
+class MemoryChannelsRepo(_RecordRepo, S.ChannelsRepo):
+    def __init__(self, sequences: _Sequences, lock, on_change=None, pre_change=None):
+        super().__init__(lock, on_change, pre_change)
         self._seq = sequences
-        self._lock = lock
-        self._on_change = on_change or (lambda: None)
 
     def insert(self, name, app_id) -> Channel:
         with self._lock:
+            self._pre()
             if not Channel.is_valid_name(name):
                 raise S.StorageError(
                     f"invalid channel name {name!r} (must match [a-zA-Z0-9-]{{1,16}})"
                 )
-            if any(c.name == name and c.appid == int(app_id) for c in self._channels.values()):
+            if any(c.name == name and c.appid == int(app_id) for c in self._records.values()):
                 raise S.StorageError(f"channel {name!r} already exists for app {app_id}")
             ch = Channel(id=self._seq.next("channels"), name=name, appid=int(app_id))
-            self._channels[ch.id] = ch
-            self._on_change()
-            return ch
+            self._put(ch.id, ch)
+            return _cp(ch)
 
     def get(self, channel_id):
         with self._lock:
-            return self._channels.get(int(channel_id))
+            return self._get(int(channel_id))
 
     def get_by_app_id(self, app_id):
         with self._lock:
             return sorted(
-                (c for c in self._channels.values() if c.appid == int(app_id)),
+                (_cp(c) for c in self._records.values() if c.appid == int(app_id)),
                 key=lambda c: c.id,
             )
 
     def delete(self, channel_id):
         with self._lock:
-            self._channels.pop(int(channel_id), None)
-            self._on_change()
+            self._pre()
+            self._drop(int(channel_id))
 
 
-class MemoryEngineManifestsRepo(S.EngineManifestsRepo):
-    def __init__(self, lock: threading.RLock, on_change=None):
-        self._manifests: Dict[Tuple[str, str], EngineManifest] = {}
-        self._lock = lock
-        self._on_change = on_change or (lambda: None)
-
+class MemoryEngineManifestsRepo(_RecordRepo, S.EngineManifestsRepo):
     def insert(self, manifest):
         with self._lock:
-            self._manifests[(manifest.id, manifest.version)] = manifest
-            self._on_change()
+            self._pre()
+            self._put((manifest.id, manifest.version), manifest)
 
     def get(self, id, version):
         with self._lock:
-            return self._manifests.get((id, version))
+            return self._get((id, version))
 
     def get_all(self):
         with self._lock:
-            return list(self._manifests.values())
+            return self._all()
 
     def update(self, manifest):
         self.insert(manifest)
 
     def delete(self, id, version):
         with self._lock:
-            self._manifests.pop((id, version), None)
-            self._on_change()
+            self._pre()
+            self._drop((id, version))
 
 
-class MemoryEngineInstancesRepo(S.EngineInstancesRepo):
-    def __init__(self, lock: threading.RLock, on_change=None):
-        self._instances: Dict[str, EngineInstance] = {}
-        self._lock = lock
-        self._on_change = on_change or (lambda: None)
-
+class MemoryEngineInstancesRepo(_RecordRepo, S.EngineInstancesRepo):
     def insert(self, instance) -> str:
         with self._lock:
+            self._pre()
             if not instance.id:
                 instance.id = uuid.uuid4().hex
-            self._instances[instance.id] = instance
-            self._on_change()
+            self._put(instance.id, instance)
             return instance.id
 
     def get(self, id):
         with self._lock:
-            return self._instances.get(id)
+            return self._get(id)
 
     def get_all(self):
         with self._lock:
-            return list(self._instances.values())
+            return self._all()
 
     def get_completed(self, engine_id, engine_version, engine_variant):
         # ref: EngineInstances.getCompleted — newest first
         with self._lock:
             out = [
-                i
-                for i in self._instances.values()
+                _cp(i)
+                for i in self._records.values()
                 if i.status == "COMPLETED"
                 and i.engine_id == engine_id
                 and i.engine_version == engine_version
                 and i.engine_variant == engine_variant
             ]
-            out.sort(key=lambda i: i.start_time, reverse=True)
-            return out
+        out.sort(key=lambda i: i.start_time, reverse=True)
+        return out
 
     def get_latest_completed(self, engine_id, engine_version, engine_variant):
         completed = self.get_completed(engine_id, engine_version, engine_variant)
@@ -325,52 +351,47 @@ class MemoryEngineInstancesRepo(S.EngineInstancesRepo):
 
     def update(self, instance):
         with self._lock:
-            self._instances[instance.id] = instance
-            self._on_change()
+            self._pre()
+            self._put(instance.id, instance)
 
     def delete(self, id):
         with self._lock:
-            self._instances.pop(id, None)
-            self._on_change()
+            self._pre()
+            self._drop(id)
 
 
-class MemoryEvaluationInstancesRepo(S.EvaluationInstancesRepo):
-    def __init__(self, lock: threading.RLock, on_change=None):
-        self._instances: Dict[str, EvaluationInstance] = {}
-        self._lock = lock
-        self._on_change = on_change or (lambda: None)
-
+class MemoryEvaluationInstancesRepo(_RecordRepo, S.EvaluationInstancesRepo):
     def insert(self, instance) -> str:
         with self._lock:
+            self._pre()
             if not instance.id:
                 instance.id = uuid.uuid4().hex
-            self._instances[instance.id] = instance
-            self._on_change()
+            self._put(instance.id, instance)
             return instance.id
 
     def get(self, id):
         with self._lock:
-            return self._instances.get(id)
+            return self._get(id)
 
     def get_all(self):
         with self._lock:
-            return list(self._instances.values())
+            return self._all()
 
     def get_completed(self):
         with self._lock:
-            out = [i for i in self._instances.values() if i.status == "EVALCOMPLETED"]
-            out.sort(key=lambda i: i.start_time, reverse=True)
-            return out
+            out = [_cp(i) for i in self._records.values() if i.status == "EVALCOMPLETED"]
+        out.sort(key=lambda i: i.start_time, reverse=True)
+        return out
 
     def update(self, instance):
         with self._lock:
-            self._instances[instance.id] = instance
-            self._on_change()
+            self._pre()
+            self._put(instance.id, instance)
 
     def delete(self, id):
         with self._lock:
-            self._instances.pop(id, None)
-            self._on_change()
+            self._pre()
+            self._drop(id)
 
 
 class MemoryModelsRepo(S.ModelsRepo):
@@ -380,11 +401,12 @@ class MemoryModelsRepo(S.ModelsRepo):
 
     def insert(self, model):
         with self._lock:
-            self._models[model.id] = model
+            self._models[model.id] = Model(id=model.id, models=bytes(model.models))
 
     def get(self, id):
         with self._lock:
-            return self._models.get(id)
+            m = self._models.get(id)
+            return Model(id=m.id, models=m.models) if m is not None else None
 
     def delete(self, id):
         with self._lock:

@@ -1,0 +1,24 @@
+"""Device mesh, sharding and distributed runtime.
+
+This package is the TPU-native replacement for what the reference
+delegates to Apache Spark (SURVEY.md §2.9): instead of RDD partitioning
++ shuffle, computation runs SPMD over a `jax.sharding.Mesh` with XLA
+collectives riding ICI; multi-host coordination uses jax.distributed
+over DCN instead of Spark's driver/executor control plane.
+"""
+
+from predictionio_tpu.parallel.mesh import (
+    MeshContext,
+    create_mesh,
+    local_device_count,
+    named_sharding,
+    replicated,
+)
+
+__all__ = [
+    "MeshContext",
+    "create_mesh",
+    "local_device_count",
+    "named_sharding",
+    "replicated",
+]

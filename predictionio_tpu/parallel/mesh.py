@@ -1,0 +1,100 @@
+"""Mesh construction + sharding helpers.
+
+The reference's unit of distribution is the Spark RDD partition; ours is
+the device-mesh axis. Algorithms receive a `MeshContext` (the analogue
+of the `sc: SparkContext` threaded through every DASE call in the
+reference, e.g. controller/Engine.scala:135) and annotate their arrays
+with `NamedSharding`s over it; XLA inserts the collectives.
+
+Axis convention (used by the built-in algorithms):
+
+  - ``data``  — batch / entity dimension (users, examples): DP
+  - ``model`` — feature / item / expert dimension: TP-style sharding
+
+A 1D mesh collapses ``model`` to size 1. Multi-host: `jax.distributed`
+initialization enumerates global devices; the same mesh spec then spans
+hosts with DCN between slices (SURVEY.md §5.8 mapping).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def create_mesh(
+    axes: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a Mesh from axis-name -> size; one size may be -1 (infer).
+
+    Default: all devices on the ``data`` axis, ``model`` axis of 1 —
+    pure DP, the layout matching the reference's Spark data parallelism
+    (SURVEY.md §2.9).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    axes = dict(axes or {"data": -1, "model": 1})
+    unknown = [k for k, v in axes.items() if v == -1]
+    if len(unknown) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    known = math.prod(v for v in axes.values() if v != -1)
+    if unknown:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        axes[unknown[0]] = n // known
+    if math.prod(axes.values()) != n:
+        raise ValueError(f"mesh {axes} does not cover {n} devices")
+    shape = tuple(axes.values())
+    return Mesh(np.array(devices).reshape(shape), tuple(axes.keys()))
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+@dataclasses.dataclass
+class MeshContext:
+    """Runtime context handed to every DASE component.
+
+    The analogue of the reference's SparkContext parameter (built by
+    WorkflowContext.scala:24): carries the device mesh, the RNG seed and
+    free-form runtime config. Components that never touch a device can
+    ignore it entirely (the reference's "local" L* components).
+    """
+
+    mesh: Optional[Mesh] = None
+    seed: int = 0
+    config: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def require_mesh(self) -> Mesh:
+        if self.mesh is None:
+            self.mesh = create_mesh()
+        return self.mesh
+
+    def rng(self) -> jax.Array:
+        return jax.random.PRNGKey(self.seed)
+
+    # -- sharding sugar -----------------------------------------------------
+    def shard(self, *spec) -> NamedSharding:
+        return named_sharding(self.require_mesh(), *spec)
+
+    def replicated(self) -> NamedSharding:
+        return replicated(self.require_mesh())
+
+    def data_parallel_size(self) -> int:
+        mesh = self.require_mesh()
+        return mesh.shape.get("data", 1)

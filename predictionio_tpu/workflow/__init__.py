@@ -1,0 +1,20 @@
+"""Workflow orchestration (ref: core/src/main/scala/io/prediction/workflow/).
+
+Submodules:
+  config   — WorkflowParams (ref: WorkflowParams.scala:19)
+  variant  — engine.json variant parsing (ref: Engine.scala:328-384)
+  train    — run_train (ref: CoreWorkflow.runTrain:42)
+  evaluate — run_evaluation (ref: CoreWorkflow.runEvaluation:96)
+  deploy   — model reload for serving (ref: Engine.prepareDeploy:174)
+"""
+
+# Submodules are imported lazily to keep core <-> workflow imports acyclic.
+_SUBMODULES = ("config", "variant", "train", "evaluate", "deploy")
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        import importlib
+
+        return importlib.import_module(f"predictionio_tpu.workflow.{name}")
+    raise AttributeError(name)

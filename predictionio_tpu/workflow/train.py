@@ -1,0 +1,120 @@
+"""Training workflow: train an engine, persist models + instance metadata.
+
+Behavior contract from the reference (workflow/CoreWorkflow.runTrain:42
+and CreateWorkflow.scala:232-255): create an EngineInstance metadata row
+(INIT), run Engine.train, serialize the per-algorithm models into the
+Models repo under the instance id (the reference Kryo-serializes;
+here: pickle, with PersistentModel models saving themselves and leaving
+a manifest), snapshot the full params into the instance, and mark it
+COMPLETED — or FAILED on error.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import pickle
+import uuid
+from typing import Any, List, Optional
+
+from predictionio_tpu.core.engine import Engine, TrainResult
+from predictionio_tpu.core.params import EngineParams, params_to_dict
+from predictionio_tpu.core.persistent_model import PersistentModel, manifest_for
+from predictionio_tpu.data.metadata import EngineInstance, Model
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.workflow.config import WorkflowParams
+
+log = logging.getLogger(__name__)
+UTC = _dt.timezone.utc
+
+
+def _now() -> _dt.datetime:
+    return _dt.datetime.now(tz=UTC)
+
+
+def serialize_models(
+    engine: Engine,
+    engine_params: EngineParams,
+    models: List[Any],
+    instance_id: str,
+    ctx: MeshContext,
+) -> bytes:
+    """Models -> bytes for the Models repo (ref: CoreWorkflow.scala:69-74).
+
+    PersistentModel models save themselves under the instance id and are
+    replaced by a manifest (ref: Engine.makeSerializableModels:260 +
+    PAlgorithm.makePersistentModel:98).
+    """
+    algorithms = engine.make_algorithms(engine_params)
+    persisted = []
+    for algo, model in zip(algorithms, models):
+        pm = algo.make_persistent_model(model)
+        if isinstance(pm, PersistentModel):
+            pm.save(instance_id, algo.params, ctx)
+            pm = manifest_for(pm)
+        persisted.append(pm)
+    return pickle.dumps(persisted)
+
+
+def run_train(
+    engine: Engine,
+    engine_params: EngineParams,
+    engine_id: str,
+    engine_version: str = "0",
+    engine_variant: str = "default",
+    engine_factory: str = "",
+    batch: str = "",
+    ctx: Optional[MeshContext] = None,
+    workflow_params: Optional[WorkflowParams] = None,
+    storage: Optional[Storage] = None,
+) -> EngineInstance:
+    """ref: CoreWorkflow.runTrain:42. Returns the COMPLETED instance."""
+    storage = storage or get_storage()
+    ctx = ctx or MeshContext()
+    wp = workflow_params or WorkflowParams()
+
+    ep_json = engine_params.to_json_dict()
+    instance = EngineInstance(
+        id=uuid.uuid4().hex,
+        status="INIT",
+        start_time=_now(),
+        end_time=_now(),
+        engine_id=engine_id,
+        engine_version=engine_version,
+        engine_variant=engine_variant,
+        engine_factory=engine_factory,
+        batch=batch or wp.batch,
+        data_source_params=json.dumps(ep_json["dataSourceParams"]),
+        preparator_params=json.dumps(ep_json["preparatorParams"]),
+        algorithms_params=json.dumps(ep_json["algorithmParamsList"]),
+        serving_params=json.dumps(ep_json["servingParams"]),
+    )
+    storage.engine_instances().insert(instance)
+    log.info("training instance %s (engine %s)", instance.id, engine_id)
+
+    try:
+        instance.status = "TRAINING"
+        storage.engine_instances().update(instance)
+        result: TrainResult = engine.train(ctx, engine_params, wp)
+        if result.stopped_after:
+            # debug interruption (ref: Engine.scala:624-648): no model persisted
+            instance.status = "COMPLETED"
+            instance.batch = (instance.batch + f" [stopped after {result.stopped_after}]").strip()
+            instance.end_time = _now()
+            storage.engine_instances().update(instance)
+            return instance
+        if wp.save_model:
+            blob = serialize_models(engine, engine_params, result.models, instance.id, ctx)
+            storage.models().insert(Model(id=instance.id, models=blob))
+        instance.status = "COMPLETED"
+        instance.end_time = _now()
+        storage.engine_instances().update(instance)
+        log.info("training completed: instance %s", instance.id)
+        return instance
+    except Exception:
+        instance.status = "FAILED"
+        instance.end_time = _now()
+        storage.engine_instances().update(instance)
+        raise

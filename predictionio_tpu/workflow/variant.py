@@ -1,0 +1,62 @@
+"""Engine variant (engine.json) loading.
+
+Behavior contract from the reference (CreateWorkflow.scala:152-177 +
+Engine.scala:328-384): an engine variant JSON names the engine factory
+and fills each DASE slot with ``{name, params}`` blocks:
+
+    {
+      "id": "default",
+      "description": "...",
+      "engineFactory": "myengine.RecommendationEngine",
+      "datasource": {"name": "", "params": {...}},
+      "preparator": {"name": "", "params": {...}},
+      "algorithms": [{"name": "als", "params": {...}}],
+      "serving": {"name": "", "params": {...}}
+    }
+
+The reference's `sparkConf` passthrough becomes `runtimeConf` (mesh
+axes, seeds, XLA options) forwarded into MeshContext.config.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from predictionio_tpu.core.engine import Engine, resolve_engine_factory
+from predictionio_tpu.core.params import EngineParams
+
+
+@dataclass
+class EngineVariant:
+    id: str
+    engine_factory: str
+    description: str = ""
+    raw: Dict[str, Any] = field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "EngineVariant":
+        if "engineFactory" not in d:
+            raise ValueError("engine variant requires 'engineFactory'")
+        return EngineVariant(
+            id=d.get("id", "default"),
+            engine_factory=d["engineFactory"],
+            description=d.get("description", ""),
+            raw=dict(d),
+        )
+
+    @staticmethod
+    def load(path: str) -> "EngineVariant":
+        with open(path) as f:
+            return EngineVariant.from_dict(json.load(f))
+
+    def create_engine(self) -> Engine:
+        return resolve_engine_factory(self.engine_factory)()
+
+    def engine_params(self, engine: Optional[Engine] = None) -> EngineParams:
+        engine = engine or self.create_engine()
+        return engine.engine_params_from_variant(self.raw)
+
+    def runtime_conf(self) -> Dict[str, str]:
+        return dict(self.raw.get("runtimeConf") or self.raw.get("sparkConf") or {})

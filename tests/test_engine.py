@@ -1,0 +1,152 @@
+"""Engine pipeline wiring (ref: core/src/test/.../EngineTest.scala:23-263)."""
+
+import pytest
+
+from predictionio_tpu.core import Engine, EngineParams, FirstServing, AverageServing
+from predictionio_tpu.core.params import EmptyParams, params_from_dict
+from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.workflow.config import WorkflowParams
+
+from tests.sample_engine import (
+    Algo0,
+    AlgoNoParams,
+    DataSource0,
+    IdParams,
+    Preparator0,
+    Prediction,
+    Query,
+    Serving0,
+)
+
+
+def make_engine():
+    return Engine(
+        data_source_classes={"ds": DataSource0},
+        preparator_classes={"prep": Preparator0},
+        algorithm_classes={"algo": Algo0, "noparams": AlgoNoParams},
+        serving_classes={"serve": Serving0, "first": FirstServing},
+    )
+
+
+def make_params(algo_ids=(3,)):
+    return EngineParams(
+        data_source_params=("ds", IdParams(id=1)),
+        preparator_params=("prep", IdParams(id=2)),
+        algorithm_params_list=[("algo", IdParams(id=i)) for i in algo_ids],
+        serving_params=("serve", IdParams(id=9)),
+    )
+
+
+ctx = MeshContext()
+
+
+def test_train_wiring_single_algo():
+    result = make_engine().train(ctx, make_params())
+    (model,) = result.models
+    assert model.algo_id == 3
+    assert model.pd.prep_id == 2
+    assert model.pd.td.ds_id == 1
+
+
+def test_train_multi_algorithm():
+    result = make_engine().train(ctx, make_params(algo_ids=(3, 4, 5)))
+    assert [m.algo_id for m in result.models] == [3, 4, 5]
+    # all share the same prepared-data lineage
+    assert all(m.pd.td.ds_id == 1 for m in result.models)
+
+
+def test_sanity_check_failure_propagates():
+    ep = make_params()
+    ep.data_source_params = ("ds", IdParams(id=1, fail_sanity=True))
+    with pytest.raises(ValueError, match="TD sanity failure"):
+        make_engine().train(ctx, ep)
+    # skip_sanity_check suppresses it (ref: WorkflowParams.skipSanityCheck)
+    result = make_engine().train(ctx, ep, WorkflowParams(skip_sanity_check=True))
+    assert result.models is not None
+
+
+def test_stop_after_read_and_prepare():
+    e = make_engine()
+    r1 = e.train(ctx, make_params(), WorkflowParams(stop_after_read=True))
+    assert r1.stopped_after == "read"
+    assert r1.models is None and r1.training_data.ds_id == 1
+    r2 = e.train(ctx, make_params(), WorkflowParams(stop_after_prepare=True))
+    assert r2.stopped_after == "prepare"
+    assert r2.prepared_data.prep_id == 2
+
+
+def test_eval_wiring():
+    results = make_engine().eval(ctx, make_params(algo_ids=(3, 4)))
+    assert len(results) == 2  # 2 folds
+    for fold, (ei, qpa) in enumerate(results):
+        assert ei.ds_id == 1 and ei.fold == fold
+        assert len(qpa) == 2
+        for q, p, a in qpa:
+            assert a.q == q.q
+            # serving sums algo ids -> proves both algorithms' predictions arrived
+            assert p.algo_id == 3 + 4
+            assert p.q == q.q
+
+
+def test_unknown_component_name():
+    with pytest.raises(KeyError, match="DataSource"):
+        ep = make_params()
+        ep.data_source_params = ("nope", IdParams())
+        make_engine().train(ctx, ep)
+
+
+def test_empty_algorithm_list_rejected():
+    ep = make_params()
+    ep.algorithm_params_list = []
+    with pytest.raises(ValueError):
+        make_engine().train(ctx, ep)
+
+
+def test_doer_create_no_params_ctor():
+    ep = make_params()
+    ep.algorithm_params_list = [("noparams", EmptyParams())]
+    result = make_engine().train(ctx, ep)
+    assert result.models[0].algo_id == -1
+
+
+def test_builtin_servings():
+    assert FirstServing.create().serve(None, [Prediction(1, 0), Prediction(2, 0)]).algo_id == 1
+    assert AverageServing.create().serve(None, [1.0, 2.0, 3.0]) == 2.0
+
+
+def test_variant_to_engine_params():
+    variant = {
+        "id": "default",
+        "engineFactory": "ignored.Here",
+        "datasource": {"name": "ds", "params": {"id": 7}},
+        "preparator": {"name": "prep", "params": {"id": 8}},
+        "algorithms": [
+            {"name": "algo", "params": {"id": 1}},
+            {"name": "algo", "params": {"id": 2}},
+        ],
+        "serving": {"name": "serve", "params": {"id": 9}},
+    }
+    ep = make_engine().engine_params_from_variant(variant)
+    assert ep.data_source_params == ("ds", IdParams(id=7))
+    assert [p.id for _, p in ep.algorithm_params_list] == [1, 2]
+    result = make_engine().train(ctx, ep)
+    assert [m.algo_id for m in result.models] == [1, 2]
+
+
+def test_variant_unknown_param_fails_fast():
+    with pytest.raises(ValueError, match="unknown params"):
+        make_engine().engine_params_from_variant(
+            {
+                "engineFactory": "x.Y",
+                "datasource": {"name": "ds", "params": {"bogus": 1}},
+                "algorithms": [{"name": "algo", "params": {}}],
+            }
+        )
+
+
+def test_params_from_dict():
+    p = params_from_dict(IdParams, {"id": 5})
+    assert p == IdParams(id=5)
+    assert params_from_dict(None, {}) == EmptyParams()
+    with pytest.raises(ValueError):
+        params_from_dict(None, {"x": 1})

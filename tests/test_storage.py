@@ -200,3 +200,53 @@ def test_verify_all_data_objects(storage):
     assert storage.verify_all_data_objects() == {
         "METADATA": True, "EVENTDATA": True, "MODELDATA": True,
     }
+
+
+def test_uninitialized_table_read_raises(storage):
+    es = storage.events()
+    with pytest.raises(StorageError, match="not initialized"):
+        es.find(999)
+    with pytest.raises(StorageError, match="not initialized"):
+        es.insert(ev(), 999)
+
+
+def test_repo_boundary_copies(storage):
+    """Mutating a record after insert must not bypass update()."""
+    repo = storage.engine_instances()
+    t0 = dt.datetime(2026, 1, 1, tzinfo=UTC)
+    inst = EngineInstance(
+        id="x", status="INIT", start_time=t0, end_time=t0,
+        engine_id="e", engine_version="1", engine_variant="v", engine_factory="f",
+    )
+    repo.insert(inst)
+    inst.status = "COMPLETED"  # not saved via update()
+    assert repo.get("x").status == "INIT"
+    repo.update(inst)
+    assert repo.get("x").status == "COMPLETED"
+
+
+def test_localfs_torn_final_line_recovered(tmp_path):
+    s1 = make_storage("localfs", tmp_path)
+    app = s1.apps().insert("torn")
+    s1.events().init(app.id)
+    s1.events().insert(ev(), app.id)
+    # simulate a crash mid-append
+    log_path = tmp_path / "store" / "events" / f"events_{app.id}.jsonl"
+    with open(log_path, "a") as f:
+        f.write('{"event": "rate", "entityTy')
+    s2 = make_storage("localfs", tmp_path)
+    assert len(s2.events().find(app.id)) == 1  # torn line dropped, rest intact
+
+
+def test_localfs_cross_process_metadata_sync(tmp_path):
+    """Two clients over one basedir: writes through one are visible to the
+    other, and neither clobbers the other's records."""
+    s1 = make_storage("localfs", tmp_path)
+    s2 = make_storage("localfs", tmp_path)
+    a1 = s1.apps().insert("from-one")
+    a2 = s2.apps().insert("from-two")  # s2 must sync before allocating an id
+    assert a2.id != a1.id
+    assert s1.apps().get_by_name("from-two") is not None
+    assert s2.apps().get_by_name("from-one") is not None
+    s3 = make_storage("localfs", tmp_path)
+    assert {a.name for a in s3.apps().get_all()} == {"from-one", "from-two"}

@@ -1,0 +1,161 @@
+"""Train workflow + deploy reload (ref: EngineWorkflowTest.scala +
+EngineTest train-persistence matrix)."""
+
+import pytest
+
+from predictionio_tpu.core import Engine, EngineParams
+from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.workflow.config import WorkflowParams
+from predictionio_tpu.workflow.deploy import engine_params_from_instance, prepare_deploy
+from predictionio_tpu.workflow.train import run_train
+from predictionio_tpu.workflow.variant import EngineVariant
+
+from tests.sample_engine import (
+    Algo0,
+    AlgoPersistent,
+    DataSource0,
+    IdParams,
+    Preparator0,
+    Query,
+    Serving0,
+)
+
+
+def make_engine():
+    return Engine(
+        data_source_classes={"ds": DataSource0},
+        preparator_classes={"prep": Preparator0},
+        algorithm_classes={"algo": Algo0, "persistent": AlgoPersistent},
+        serving_classes={"serve": Serving0},
+    )
+
+
+def make_params(algos=("algo",)):
+    return EngineParams(
+        data_source_params=("ds", IdParams(id=1)),
+        preparator_params=("prep", IdParams(id=2)),
+        algorithm_params_list=[(a, IdParams(id=3 + i)) for i, a in enumerate(algos)],
+        serving_params=("serve", IdParams(id=9)),
+    )
+
+
+ctx = MeshContext()
+
+
+def test_run_train_persists_instance_and_model(memory_storage):
+    engine = make_engine()
+    instance = run_train(
+        engine, make_params(), engine_id="myengine", storage=memory_storage
+    )
+    assert instance.status == "COMPLETED"
+    stored = memory_storage.engine_instances().get(instance.id)
+    assert stored.status == "COMPLETED"
+    assert memory_storage.models().get(instance.id) is not None
+    # params snapshot recorded (ref: CreateWorkflow.scala:232-252)
+    assert '"id": 1' in stored.data_source_params
+    latest = memory_storage.engine_instances().get_latest_completed("myengine", "0", "default")
+    assert latest.id == instance.id
+
+
+def test_deploy_round_trip(memory_storage):
+    engine = make_engine()
+    instance = run_train(
+        engine, make_params(algos=("algo", "algo")), engine_id="e", storage=memory_storage
+    )
+    deployment = prepare_deploy(engine, instance, ctx, memory_storage)
+    # deployed pipeline reproduces training wiring end-to-end
+    p = deployment.query(Query(q=42))
+    assert p.q == 42
+    assert p.algo_id == 3 + 4  # serving sums both algo ids
+    # engine params were reconstructed from the instance snapshot
+    ep = engine_params_from_instance(engine, instance)
+    assert ep.data_source_params == ("ds", IdParams(id=1))
+    assert [p.id for _, p in ep.algorithm_params_list] == [3, 4]
+
+
+def test_persistent_model_path(memory_storage, tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+    engine = make_engine()
+    instance = run_train(
+        engine, make_params(algos=("persistent",)), engine_id="e", storage=memory_storage
+    )
+    # the Models repo holds a manifest, not the model itself
+    import pickle
+
+    blob = pickle.loads(memory_storage.models().get(instance.id).models)
+    from predictionio_tpu.core.persistent_model import PersistentModelManifest
+
+    assert isinstance(blob[0], PersistentModelManifest)
+    # deploy reloads through the loader class
+    deployment = prepare_deploy(engine, instance, ctx, memory_storage)
+    assert deployment.query(Query(q=1)).algo_id == 3
+
+
+def test_failed_training_marks_instance(memory_storage):
+    engine = make_engine()
+    ep = make_params()
+    ep.data_source_params = ("ds", IdParams(id=1, fail_sanity=True))
+    with pytest.raises(ValueError):
+        run_train(engine, ep, engine_id="e", storage=memory_storage)
+    instances = memory_storage.engine_instances().get_all()
+    assert len(instances) == 1
+    assert instances[0].status == "FAILED"
+    assert memory_storage.engine_instances().get_latest_completed("e", "0", "default") is None
+
+
+def test_stop_after_read_skips_model(memory_storage):
+    engine = make_engine()
+    instance = run_train(
+        engine,
+        make_params(),
+        engine_id="e",
+        storage=memory_storage,
+        workflow_params=WorkflowParams(stop_after_read=True),
+    )
+    assert "stopped after read" in instance.batch
+    assert memory_storage.models().get(instance.id) is None
+
+
+def test_no_save_model(memory_storage):
+    engine = make_engine()
+    instance = run_train(
+        engine,
+        make_params(),
+        engine_id="e",
+        storage=memory_storage,
+        workflow_params=WorkflowParams(save_model=False),
+    )
+    assert instance.status == "COMPLETED"
+    assert memory_storage.models().get(instance.id) is None
+
+
+def test_engine_variant_loading(tmp_path):
+    import json
+
+    variant_path = tmp_path / "engine.json"
+    variant_path.write_text(
+        json.dumps(
+            {
+                "id": "v1",
+                "engineFactory": "tests.test_workflow.sample_factory",
+                "datasource": {"name": "ds", "params": {"id": 5}},
+                "algorithms": [{"name": "algo", "params": {"id": 6}}],
+                "preparator": {"name": "prep", "params": {}},
+                "serving": {"name": "serve", "params": {}},
+                "runtimeConf": {"mesh.data": "8"},
+            }
+        )
+    )
+    variant = EngineVariant.load(str(variant_path))
+    assert variant.id == "v1"
+    engine = variant.create_engine()
+    ep = variant.engine_params(engine)
+    assert ep.data_source_params[1].id == 5
+    assert variant.runtime_conf() == {"mesh.data": "8"}
+    result = engine.train(ctx, ep)
+    assert result.models[0].algo_id == 6
+
+
+def sample_factory():
+    """Engine factory resolved by dotted path (ref: WorkflowUtils.getEngine:60)."""
+    return make_engine()
