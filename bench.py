@@ -1,0 +1,77 @@
+"""Headline benchmark: ALS training throughput on MovieLens-20M-scale data.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric: rating-updates/sec/chip during ALS training — n_ratings *
+iterations / wall-time of the timed iterations (compilation and host
+binning excluded; one warm-up alternation runs first). This is the
+rebuild's side of BASELINE.md's north star ("ALS on MovieLens-20M at
+>=5x Spark-CPU events/sec/chip"): the reference publishes no numbers
+(BASELINE.json "published": {}), so vs_baseline is computed against a
+1e6 ratings/sec Spark-MLlib-CPU-node proxy — the >=5x target is
+therefore vs_baseline >= 5.
+
+Scale knobs via env: PIO_BENCH_USERS/ITEMS/RATINGS/RANK/ITERS.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    n_users = int(os.environ.get("PIO_BENCH_USERS", 138_000))
+    n_items = int(os.environ.get("PIO_BENCH_ITEMS", 27_000))
+    n_ratings = int(os.environ.get("PIO_BENCH_RATINGS", 20_000_000))
+    rank = int(os.environ.get("PIO_BENCH_RANK", 64))
+    iterations = int(os.environ.get("PIO_BENCH_ITERS", 5))
+
+    from predictionio_tpu.ops.als import ALSConfig, ALSTrainer
+
+    rng = np.random.default_rng(0)
+    # Zipf-ish popularity for items, uniform users — MovieLens-shaped
+    uu = rng.integers(0, n_users, size=n_ratings, dtype=np.int64)
+    item_pop = rng.zipf(1.2, size=n_ratings) % n_items
+    ii = item_pop.astype(np.int64)
+    vals = rng.integers(1, 11, size=n_ratings).astype(np.float32) / 2.0
+
+    cfg = ALSConfig(rank=rank, iterations=iterations, reg=0.1, block_size=4096)
+
+    # one-time costs: host binning + device placement + XLA compile
+    t0 = time.perf_counter()
+    trainer = ALSTrainer((uu, ii, vals), n_users, n_items, cfg,
+                         max_ratings_per_user=256, max_ratings_per_item=2048)
+    trainer.compile()
+    warm = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    trainer.run(iterations)
+    elapsed = time.perf_counter() - t0
+
+    # honest accounting: the per-group caps drop the tail of very long
+    # groups, so count only entries actually touched by each half-step
+    # (mean of the user-side and item-side survivors)
+    effective = (trainer.kept_user_entries + trainer.kept_item_entries) / 2
+    value = effective * iterations / elapsed
+    baseline_proxy = 1e6  # Spark MLlib ALS CPU-node ratings/sec (see module doc)
+    print(json.dumps({
+        "metric": "als_ml20m_rating_updates_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "ratings*iters/sec",
+        "vs_baseline": round(value / baseline_proxy, 2),
+        "detail": {
+            "n_users": n_users, "n_items": n_items, "n_ratings": n_ratings,
+            "effective_ratings": int(effective),
+            "kept_user_frac": round(trainer.kept_user_entries / n_ratings, 3),
+            "kept_item_frac": round(trainer.kept_item_entries / n_ratings, 3),
+            "rank": rank, "iterations": iterations,
+            "elapsed_sec": round(elapsed, 2), "warmup_sec": round(warm, 2),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""Algorithm library (ref: e2/ engines + examples/ template algorithms).
+
+Each module pairs a JAX/TPU compute core from predictionio_tpu.ops with
+a DASE Algorithm wrapper:
+
+  als            — matrix factorization (ref: MLlib ALS templates)
+  naive_bayes    — categorical NB (ref: e2/.../CategoricalNaiveBayes.scala)
+  logistic       — logistic regression via optax (ref: classification template)
+  similarproduct — item-cosine similarity (ref: scala-parallel-similarproduct)
+  ecommerce      — ALS + business-rule serving filters
+                   (ref: scala-parallel-ecommercerecommendation)
+  markov         — top-N transition chains (ref: e2/.../MarkovChain.scala)
+  two_tower      — flax neural recommender (stretch config in BASELINE.json)
+"""

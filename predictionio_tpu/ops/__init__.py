@@ -1,0 +1,8 @@
+"""Numeric kernels: the TPU-native replacement for Spark/MLlib internals.
+
+Everything here obeys the XLA compilation model: static shapes, no
+data-dependent Python control flow, batch dimensions laid out so the
+MXU sees large matmuls (see /opt/skills/guides/pallas_guide.md and
+SURVEY.md §2.9 for the design mapping from the reference's Spark
+shuffle-based algorithms).
+"""

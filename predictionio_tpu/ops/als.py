@@ -1,0 +1,273 @@
+"""Alternating least squares on the TPU mesh.
+
+The TPU-native replacement for MLlib ALS (the reference's flagship
+algorithm: examples/scala-parallel-recommendation templates call
+``ALS.train`` with shuffle-based block exchange each iteration). Design
+per SURVEY.md §2.9/§7.4:
+
+  - ragged ratings are pre-binned into static padded blocks
+    (predictionio_tpu.ops.ragged) — no recompilation across iterations
+  - each half-step solves ALL users (or items) as one batched
+    normal-equation problem: gather opposing factors [B, L, K], form
+    A = Yg^T Yg (+reg), b = Yg^T r with masked einsums (MXU work), and
+    solve the K x K systems with a batched LU — ``lax.map`` over fixed
+    user blocks bounds HBM footprint
+  - data parallelism: the group axis is sharded over the mesh ``data``
+    axis with ``shard_map``; the opposing factor matrix is replicated,
+    so the only cross-device traffic is the all-gather of the freshly
+    solved factors at the end of each half-step (XLA inserts it when
+    the sharded output is next consumed replicated) — ICI traffic
+    instead of the reference's Spark shuffle
+  - explicit feedback uses ALS-WR regularization (lambda * n_u * I,
+    matching MLlib); implicit feedback implements Hu-Koren-Volinsky
+    (c = 1 + alpha * r) with the Y^T Y Gramian trick
+
+Solves run in float32 (K x K conditioning); the big gather+einsum work
+is float32 too — scoring (ops.topk) may downcast to bfloat16.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.ops.ragged import PaddedGroups, build_padded_groups, pad_to_multiple
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSConfig:
+    rank: int = 32
+    iterations: int = 10
+    reg: float = 0.1          # lambda
+    implicit: bool = False
+    alpha: float = 1.0        # implicit confidence scale, c = 1 + alpha*r
+    block_size: int = 4096    # users solved per lax.map step
+    seed: int = 7
+    solver: str = "cg"        # "cg" (MXU-friendly, default) | "direct" (LU)
+    cg_iters: int = 16        # CG steps; 16 reaches ~1e-3 rel err at K=64
+
+
+def plan_blocks(n_groups: int, n_shards: int, block_size: int) -> Tuple[int, int]:
+    """(padded_group_count, block) so G = n_shards * n_blocks * block."""
+    per_shard = pad_to_multiple(max(1, -(-n_groups // n_shards)), 8)
+    block = min(block_size, per_shard)
+    per_shard = pad_to_multiple(per_shard, block)
+    return per_shard * n_shards, block
+
+
+def _batched_cg(A, b, iters: int):
+    """Batched conjugate gradient for SPD K x K systems.
+
+    TPU-shaped replacement for ``jnp.linalg.solve``: batched LU/Cholesky
+    lowers poorly on TPU (~10x slower than the einsum work feeding it),
+    while CG is pure batched matvecs the MXU eats. 16 iterations reach
+    ~1e-3 relative error at K=64 — far below ALS's own convergence
+    tolerance.
+    """
+    x = jnp.zeros_like(b)
+    r = b
+    p = r
+    rs = jnp.einsum("bi,bi->b", r, r)
+
+    def body(carry, _):
+        x, r, p, rs = carry
+        Ap = jnp.einsum("bij,bj->bi", A, p)
+        alpha = rs / (jnp.einsum("bi,bi->b", p, Ap) + 1e-20)
+        x = x + alpha[:, None] * p
+        r = r - alpha[:, None] * Ap
+        rs_new = jnp.einsum("bi,bi->b", r, r)
+        p = r + (rs_new / (rs + 1e-20))[:, None] * p
+        return (x, r, p, rs_new), None
+
+    (x, _, _, _), _ = jax.lax.scan(body, (x, r, p, rs), None, length=iters)
+    return x
+
+
+def _solve_shard(Y, idx, val, mask, counts, *, rank, reg, implicit, alpha, block,
+                 solver, cg_iters):
+    """Solve all groups of one shard: [G_loc, L] -> [G_loc, K]."""
+    g_loc, L = idx.shape
+    nb = g_loc // block
+    idx = idx.reshape(nb, block, L)
+    val = val.reshape(nb, block, L)
+    mask = mask.reshape(nb, block, L)
+    counts = counts.reshape(nb, block)
+    eye = jnp.eye(rank, dtype=jnp.float32)
+    YtY = (Y.T @ Y) if implicit else None
+
+    def solve_block(args):
+        idx_b, val_b, mask_b, cnt_b = args
+        Yg = Y[idx_b] * mask_b[..., None]          # [B, L, K] padded rows zeroed
+        if implicit:
+            # A = Y^T Y + alpha * Yg^T diag(r) Yg + reg*I ; b = Yg^T (1 + alpha r)
+            A = YtY + alpha * jnp.einsum("blk,bl,blj->bkj", Yg, val_b, Yg) + reg * eye
+            b = jnp.einsum("blk,bl->bk", Yg, (1.0 + alpha * val_b) * mask_b)
+        else:
+            # ALS-WR: A = Yg^T Yg + reg * n_u * I ; b = Yg^T r
+            A = jnp.einsum("blk,blj->bkj", Yg, Yg)
+            n_u = jnp.maximum(cnt_b.astype(jnp.float32), 1.0)  # keep empty rows nonsingular
+            A = A + (reg * n_u)[:, None, None] * eye
+            b = jnp.einsum("blk,bl->bk", Yg, val_b)
+        if solver == "cg":
+            return _batched_cg(A, b, cg_iters)     # [B, K]
+        return jnp.linalg.solve(A, b[..., None])[..., 0]
+
+    out = jax.lax.map(solve_block, (idx, val, mask, counts))  # [nb, B, K]
+    return out.reshape(g_loc, rank)
+
+
+def make_half_step(mesh: Optional[Mesh], cfg: ALSConfig, block: int):
+    """Compile one ALS half-step, sharded over the mesh ``data`` axis."""
+    kwargs = dict(
+        rank=cfg.rank, reg=cfg.reg, implicit=cfg.implicit, alpha=cfg.alpha, block=block,
+        solver=cfg.solver, cg_iters=cfg.cg_iters,
+    )
+    fn = functools.partial(_solve_shard, **kwargs)
+    if mesh is not None and np.prod([mesh.shape[a] for a in mesh.axis_names]) > 1:
+        fn = jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(P(), P("data", None), P("data", None), P("data", None), P("data")),
+            out_specs=P("data", None),
+        )
+    return jax.jit(fn)
+
+
+def _force(x: jax.Array) -> None:
+    """Real execution barrier: pull one scalar to the host."""
+    jnp.sum(x).item()
+
+
+@dataclasses.dataclass
+class ALSFactors:
+    user_factors: np.ndarray  # [n_users, K] float32
+    item_factors: np.ndarray  # [n_items, K] float32
+
+
+class ALSTrainer:
+    """Prepared ALS run: data binned + placed on device, steps compiled.
+
+    Separates the one-time costs (host binning, sharding, XLA compile)
+    from the per-iteration device work so callers — and the benchmark —
+    can alternate without paying them again. The full pipeline replaces
+    the reference's `ALS.train` call (examples/.../ALSAlgorithm.scala:56).
+    """
+
+    def __init__(
+        self,
+        user_coo: Tuple[np.ndarray, np.ndarray, np.ndarray],
+        n_users: int,
+        n_items: int,
+        cfg: ALSConfig,
+        mesh: Optional[Mesh] = None,
+        max_ratings_per_user: Optional[int] = None,
+        max_ratings_per_item: Optional[int] = None,
+    ):
+        u_idx, i_idx, vals = user_coo
+        self.cfg = cfg
+        self.mesh = mesh
+        self.n_users, self.n_items = n_users, n_items
+        n_shards = mesh.shape["data"] if mesh is not None else 1
+
+        self._g_users, block_u = plan_blocks(n_users, n_shards, cfg.block_size)
+        self._g_items, block_i = plan_blocks(n_items, n_shards, cfg.block_size)
+        # group_multiple == planned size pads the group axis straight to it
+        by_user = build_padded_groups(
+            u_idx, i_idx, vals, n_users, max_len=max_ratings_per_user,
+            group_multiple=self._g_users,
+        )
+        by_item = build_padded_groups(
+            i_idx, u_idx, vals, n_items, max_len=max_ratings_per_item,
+            group_multiple=self._g_items,
+        )
+        assert by_user.idx.shape[0] == self._g_users
+        assert by_item.idx.shape[0] == self._g_items
+        # entries actually processed per half-step after the per-group caps
+        # (rating-count truncation drops the tail of very long groups)
+        self.kept_user_entries = int(by_user.counts.sum())
+        self.kept_item_entries = int(by_item.counts.sum())
+        self.total_entries = len(vals)
+
+        key = jax.random.PRNGKey(cfg.seed)
+        ku, ki = jax.random.split(key)
+        scale = 1.0 / np.sqrt(cfg.rank)
+        X = jax.random.normal(ku, (self._g_users, cfg.rank), jnp.float32) * scale
+        Y = jax.random.normal(ki, (self._g_items, cfg.rank), jnp.float32) * scale
+        # factor rows past the true count stay zero-contributing via masks;
+        # zero them so padded items never influence user solves
+        self._X = X.at[n_users:].set(0.0) if self._g_users > n_users else X
+        self._Y = Y.at[n_items:].set(0.0) if self._g_items > n_items else Y
+
+        self._user_step = make_half_step(mesh, cfg, block_u)
+        self._item_step = make_half_step(mesh, cfg, block_i)
+        self._ud = self._to_device(by_user)
+        self._it = self._to_device(by_item)
+
+    def _to_device(self, pg: PaddedGroups):
+        arrs = (jnp.asarray(pg.idx), jnp.asarray(pg.val), jnp.asarray(pg.mask),
+                jnp.asarray(pg.counts))
+        if self.mesh is not None:
+            shardings = [
+                NamedSharding(self.mesh, P("data", None)) if a.ndim == 2
+                else NamedSharding(self.mesh, P("data"))
+                for a in arrs
+            ]
+            arrs = tuple(jax.device_put(a, s) for a, s in zip(arrs, shardings))
+        return arrs
+
+    def compile(self) -> "ALSTrainer":
+        """Force both half-step compilations (bench warm-up).
+
+        Synced via scalar readback: on tunneled backends
+        ``block_until_ready`` can return before compilation/execution
+        actually happens, so a host pull is the only reliable barrier.
+        """
+        _force(self._user_step(self._Y, *self._ud))
+        _force(self._item_step(self._X, *self._it))
+        return self
+
+    def run(self, iterations: Optional[int] = None) -> ALSFactors:
+        X, Y = self._X, self._Y
+        for _ in range(iterations if iterations is not None else self.cfg.iterations):
+            X = self._user_step(Y, *self._ud)
+            Y = self._item_step(X, *self._it)
+        self._X, self._Y = X, Y
+        return self.factors()  # np.asarray is the real sync barrier
+
+    def factors(self) -> ALSFactors:
+        return ALSFactors(
+            user_factors=np.asarray(self._X)[: self.n_users],
+            item_factors=np.asarray(self._Y)[: self.n_items],
+        )
+
+
+def als_train(
+    user_coo: Tuple[np.ndarray, np.ndarray, np.ndarray],
+    n_users: int,
+    n_items: int,
+    cfg: ALSConfig,
+    mesh: Optional[Mesh] = None,
+    max_ratings_per_user: Optional[int] = None,
+    max_ratings_per_item: Optional[int] = None,
+) -> ALSFactors:
+    """One-call train from COO (user_idx, item_idx, rating) triples."""
+    return ALSTrainer(
+        user_coo, n_users, n_items, cfg, mesh=mesh,
+        max_ratings_per_user=max_ratings_per_user,
+        max_ratings_per_item=max_ratings_per_item,
+    ).run()
+
+
+def predict_rmse(factors: ALSFactors, coo) -> float:
+    """Host-side RMSE over COO ratings (evaluation metric helper)."""
+    u, i, r = coo
+    pred = np.einsum(
+        "nk,nk->n", factors.user_factors[np.asarray(u)], factors.item_factors[np.asarray(i)]
+    )
+    return float(np.sqrt(np.mean((pred - np.asarray(r)) ** 2)))

@@ -1,0 +1,106 @@
+"""Ragged -> static-shape conversion (host side).
+
+The pervasive hard part of mapping event data onto the TPU (SURVEY.md §7
+"hard parts (a)"): event streams produce ragged per-entity lists (each
+user rates a different number of items), but XLA wants static shapes.
+This module bins ragged COO data into fixed-size padded blocks:
+
+  COO (group_idx, item_idx, value)  ->  per-group padded
+      idx  [G, L]  int32   (0 where padded)
+      val  [G, L]  float32 (0 where padded)
+      mask [G, L]  float32 1/0
+      counts [G]   int32   true lengths (pre-truncation, capped)
+
+Groups longer than ``max_len`` are truncated deterministically keeping
+the *latest* entries (event-recency wins, matching recommender
+practice); ``max_len=None`` sizes to the longest group. Also pads the
+group axis to a multiple (mesh divisibility).
+
+The reference's analogue is MLlib ALS's shuffle-based InBlock/OutBlock
+construction; here it is a vectorized numpy pass that feeds
+device buffers directly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class PaddedGroups:
+    """Static-shape view of ragged per-group data."""
+
+    idx: np.ndarray     # [G, L] int32
+    val: np.ndarray     # [G, L] float32
+    mask: np.ndarray    # [G, L] float32
+    counts: np.ndarray  # [G] int32 (capped at L)
+    n_groups: int       # true number of groups (before group-axis padding)
+
+    @property
+    def max_len(self) -> int:
+        return self.idx.shape[1]
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple if multiple > 1 else n
+
+
+def build_padded_groups(
+    group_idx: np.ndarray,
+    item_idx: np.ndarray,
+    values: np.ndarray,
+    n_groups: int,
+    max_len: Optional[int] = None,
+    group_multiple: int = 1,
+    len_multiple: int = 8,
+) -> PaddedGroups:
+    """Bin COO triples into per-group padded blocks.
+
+    ``group_multiple`` pads the group axis (e.g. to a multiple of
+    mesh_size * block_size); ``len_multiple`` rounds L up for clean
+    tiling on the MXU lane dimension.
+    """
+    group_idx = np.asarray(group_idx, dtype=np.int64)
+    item_idx = np.asarray(item_idx, dtype=np.int64)
+    values = np.asarray(values, dtype=np.float32)
+    if not (len(group_idx) == len(item_idx) == len(values)):
+        raise ValueError("COO arrays must have equal length")
+    nnz = len(group_idx)
+
+    counts_true = np.bincount(group_idx, minlength=n_groups).astype(np.int64)
+    longest = int(counts_true.max()) if nnz else 0
+    L = longest if max_len is None else min(max_len, longest) if longest else 0
+    L = max(pad_to_multiple(max(L, 1), len_multiple), len_multiple)
+    G = pad_to_multiple(max(n_groups, 1), group_multiple)
+
+    idx = np.zeros((G, L), dtype=np.int32)
+    val = np.zeros((G, L), dtype=np.float32)
+    mask = np.zeros((G, L), dtype=np.float32)
+
+    if nnz:
+        # stable sort by group keeps original (chronological) order within
+        # a group; truncation below then keeps the latest entries
+        order = np.argsort(group_idx, kind="stable")
+        g_sorted = group_idx[order]
+        i_sorted = item_idx[order]
+        v_sorted = values[order]
+        # position of each entry within its group
+        starts = np.zeros(n_groups + 1, dtype=np.int64)
+        np.cumsum(counts_true, out=starts[1:])
+        pos_in_group = np.arange(nnz, dtype=np.int64) - starts[g_sorted]
+        # keep the last L entries of each group
+        keep_from = counts_true[g_sorted] - L
+        kept = pos_in_group >= keep_from
+        slot = pos_in_group - np.maximum(counts_true[g_sorted] - L, 0)
+        g_k, s_k = g_sorted[kept], slot[kept]
+        idx[g_k, s_k] = i_sorted[kept].astype(np.int32)
+        val[g_k, s_k] = v_sorted[kept]
+        mask[g_k, s_k] = 1.0
+
+    counts = np.minimum(counts_true, L).astype(np.int32)
+    counts_out = np.zeros(G, dtype=np.int32)
+    counts_out[:n_groups] = counts
+    return PaddedGroups(idx=idx, val=val, mask=mask, counts=counts_out, n_groups=n_groups)

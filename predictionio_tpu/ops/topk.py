@@ -1,0 +1,102 @@
+"""HBM-resident top-k scoring for serving.
+
+Serve-path design (SURVEY.md §7.5): model factors stay resident on the
+device; a query is one embedding-row lookup plus a [1, K] x [K, I]
+matmul and a fixed-shape ``lax.top_k`` — no per-request host<->device
+round trips beyond the scalar inputs/outputs. The reference's analogue
+is ALSModel.recommendProducts' driver-side dot-product scan
+(MLlib MatrixFactorizationModel, used by
+examples/scala-parallel-recommendation templates).
+
+Batched variants score many users at once (evaluation batchPredict and
+micro-batched serving).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = jnp.float32(-1e30)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _topk_scores(
+    user_vecs: jax.Array,      # [B, K]
+    item_factors: jax.Array,   # [I, K]
+    exclude_idx: jax.Array,    # [B, E] int32, -1 = no exclusion
+    k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    scores = user_vecs @ item_factors.T                      # [B, I] MXU
+    # mask excluded items (seen items / business rules); -1 slots are
+    # routed to a scratch column then dropped
+    B, I = scores.shape
+    padded = jnp.concatenate([scores, jnp.zeros((B, 1), scores.dtype)], axis=1)
+    excl = jnp.where(exclude_idx < 0, I, exclude_idx)
+    masked = jax.vmap(lambda row, e: row.at[e].set(NEG_INF))(padded, excl)
+    masked = masked[:, :I]
+    return jax.lax.top_k(masked, k)
+
+
+def _pow2_bucket(n: int, lo: int, hi: int) -> int:
+    b = lo
+    while b < min(n, hi):
+        b *= 2
+    return b
+
+
+class TopKScorer:
+    """Precompiled scorer over a fixed item-factor matrix.
+
+    Serve-path shape discipline: ``k``, the exclusion width and the
+    batch size are bucketed to powers of two (exclusions capped at
+    ``max_exclude``) so arbitrary per-request values hit a handful of
+    compiled shapes instead of retracing per novel (B, E, k).
+    """
+
+    def __init__(self, item_factors: np.ndarray, max_exclude: int = 64):
+        self.item_factors = jnp.asarray(item_factors, dtype=jnp.float32)
+        self.max_exclude = max_exclude
+
+    def score(
+        self,
+        user_vecs: np.ndarray,
+        k: int,
+        exclude_idx: Optional[np.ndarray] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """(scores [B, k], item_indices [B, k]); exclude_idx [B, E] with -1 padding.
+
+        Excluded entries beyond ``max_exclude`` are dropped (oldest
+        first) — callers needing exact long blacklists should filter
+        host-side on the returned ranking.
+        """
+        user_vecs = jnp.atleast_2d(jnp.asarray(user_vecs, dtype=jnp.float32))
+        B = user_vecs.shape[0]
+        n_items = self.item_factors.shape[0]
+        if exclude_idx is None:
+            exclude_idx = np.full((B, 1), -1, dtype=np.int32)
+        exclude_idx = np.asarray(exclude_idx, dtype=np.int32)
+        if exclude_idx.ndim == 1:
+            exclude_idx = np.broadcast_to(exclude_idx, (B, exclude_idx.shape[0]))
+        exclude_idx = exclude_idx[:, -self.max_exclude:]
+        e_bucket = _pow2_bucket(exclude_idx.shape[1], 1, self.max_exclude)
+        if exclude_idx.shape[1] < e_bucket:
+            pad = np.full((B, e_bucket - exclude_idx.shape[1]), -1, dtype=np.int32)
+            exclude_idx = np.concatenate([exclude_idx, pad], axis=1)
+        k = min(k, n_items)
+        k_bucket = min(_pow2_bucket(k, 8, 1 << 20), n_items)
+        scores, idx = _topk_scores(
+            user_vecs, self.item_factors, jnp.asarray(exclude_idx), k_bucket
+        )
+        return np.asarray(scores)[:, :k], np.asarray(idx)[:, :k]
+
+
+def cosine_normalize(m: np.ndarray, eps: float = 1e-8) -> np.ndarray:
+    """Row-normalize so dot products become cosine similarities
+    (similarproduct-template scoring)."""
+    norms = np.linalg.norm(m, axis=1, keepdims=True)
+    return m / np.maximum(norms, eps)

@@ -1,0 +1,18 @@
+"""Ready-made engine templates (ref: examples/ + templates.prediction.io gallery).
+
+Each template assembles a full DASE engine the way the reference's
+template gallery does (tools/.../console/Template.scala): a DataSource
+reading the event store, a Preparator shaping data for the device, one
+or more Algorithms, and a Serving combiner, plus an engine factory for
+engine.json variants.
+
+  recommendation — ALS personal recommendations
+                   (ref: examples/scala-parallel-recommendation)
+  classification — NaiveBayes / logistic regression over $set features
+                   (ref: examples/scala-parallel-classification)
+  similarproduct — items similar to a basket
+                   (ref: examples/scala-parallel-similarproduct)
+  ecommerce      — ALS + serve-time business-rule filters
+                   (ref: examples/scala-parallel-ecommercerecommendation)
+  vanilla        — skeleton for new engines (ref: template gallery vanilla)
+"""

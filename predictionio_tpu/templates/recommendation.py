@@ -1,0 +1,136 @@
+"""Recommendation engine template (ALS).
+
+Behavior contract from the reference template
+(examples/scala-parallel-recommendation/custom-serving/src/main/scala/
+DataSource.scala:31 + ALSAlgorithm.scala + Serving.scala): the
+DataSource reads "rate" (rating property) and "buy" (implicit rating
+4.0) events between user and item entities; the Preparator indexes
+string ids to dense rows; ALS factorizes; queries return top-N item
+scores. ``read_eval`` provides k-fold splits for the evaluation harness
+(ref: e2/.../evaluation/CrossValidation.scala:33 semantics — fold i
+holds out indices with idx % k == i).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.core import (
+    DataSource,
+    Engine,
+    FirstServing,
+    Preparator,
+    SanityCheck,
+)
+from predictionio_tpu.core.params import Params
+from predictionio_tpu.data import store
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.models.als import ALSAlgorithm, ALSParams, PreparedRatings
+from predictionio_tpu.parallel.mesh import MeshContext
+
+
+@dataclass
+class RatingEvent:
+    user: str
+    item: str
+    rating: float
+
+
+@dataclass
+class RatingsTD(SanityCheck):
+    """TD: raw (user, item, rating) triples from the event store."""
+
+    ratings: List[RatingEvent] = field(default_factory=list)
+
+    def sanity_check(self) -> None:
+        if not self.ratings:
+            raise ValueError("RatingsTD is empty — no rate/buy events found")
+
+
+@dataclass
+class RecoDataSourceParams(Params):
+    app_name: str = ""
+    channel_name: Optional[str] = None
+    rate_event: str = "rate"
+    buy_event: str = "buy"
+    buy_rating: float = 4.0
+    eval_k: int = 0           # >0 enables k-fold readEval
+    eval_query_num: int = 10
+
+
+class RecoDataSource(DataSource):
+    """ref: recommendation template DataSource.scala:31."""
+
+    def __init__(self, params: RecoDataSourceParams):
+        super().__init__(params)
+
+    def _read(self) -> List[RatingEvent]:
+        p: RecoDataSourceParams = self.params
+        events = store.find(
+            p.app_name,
+            channel_name=p.channel_name,
+            entity_type="user",
+            event_names=[p.rate_event, p.buy_event],
+            target_entity_type="item",
+        )
+        out = []
+        for e in events:
+            if e.event == p.rate_event:
+                rating = float(e.properties.get("rating", 0.0))
+            else:
+                rating = p.buy_rating
+            out.append(RatingEvent(user=e.entity_id, item=e.target_entity_id, rating=rating))
+        return out
+
+    def read_training(self, ctx: MeshContext) -> RatingsTD:
+        return RatingsTD(ratings=self._read())
+
+    def read_eval(self, ctx: MeshContext):
+        """k-fold split by idx % k (ref: CrossValidation.scala:33)."""
+        p: RecoDataSourceParams = self.params
+        if p.eval_k <= 1:
+            return []
+        all_ratings = self._read()
+        folds = []
+        for fold in range(p.eval_k):
+            train = [r for i, r in enumerate(all_ratings) if i % p.eval_k != fold]
+            test = [r for i, r in enumerate(all_ratings) if i % p.eval_k == fold]
+            qa = [
+                (
+                    {"user": r.user, "num": p.eval_query_num},
+                    {"item": r.item, "rating": r.rating},
+                )
+                for r in test
+            ]
+            folds.append((RatingsTD(ratings=train), {"fold": fold}, qa))
+        return folds
+
+
+class RecoPreparator(Preparator):
+    """String ids -> dense COO (ref: template Preparator + MLlibs' indexing
+    via BiMap, SURVEY.md §2.4 BiMap row)."""
+
+    def prepare(self, ctx: MeshContext, td: RatingsTD) -> PreparedRatings:
+        users = BiMap.string_int(r.user for r in td.ratings)
+        items = BiMap.string_int(r.item for r in td.ratings)
+        n = len(td.ratings)
+        user_idx = np.fromiter((users[r.user] for r in td.ratings), np.int64, count=n)
+        item_idx = np.fromiter((items[r.item] for r in td.ratings), np.int64, count=n)
+        ratings = np.fromiter((r.rating for r in td.ratings), np.float32, count=n)
+        return PreparedRatings(
+            user_ids=users, item_ids=items,
+            user_idx=user_idx, item_idx=item_idx, ratings=ratings,
+        )
+
+
+def recommendation_engine() -> Engine:
+    """Engine factory (ref: examples/.../RecommendationEngine object)."""
+    return Engine(
+        data_source_classes=RecoDataSource,
+        preparator_classes=RecoPreparator,
+        algorithm_classes={"als": ALSAlgorithm},
+        serving_classes=FirstServing,
+    )

@@ -1,0 +1,97 @@
+"""Evaluation workflow: run tuning, persist EvaluationInstance + results.
+
+Behavior contract from the reference (workflow/CoreWorkflow.runEvaluation:96
++ EvaluationWorkflow.scala:31 + CreateWorkflow eval branch :263-276):
+create an EvaluationInstance (INIT -> EVALUATING), run the evaluator
+over the candidate list, store the one-liner / JSON / HTML renderings
+on the instance and mark EVALCOMPLETED (or FAILED).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import logging
+import uuid
+from typing import Optional, Sequence
+
+from predictionio_tpu.core.engine import Engine
+from predictionio_tpu.core.evaluation import (
+    EngineParamsGenerator,
+    Evaluation,
+    MetricEvaluator,
+    MetricEvaluatorResult,
+)
+from predictionio_tpu.core.params import EngineParams
+from predictionio_tpu.data.metadata import EvaluationInstance
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.workflow.config import WorkflowParams
+
+log = logging.getLogger(__name__)
+UTC = _dt.timezone.utc
+
+
+def _now() -> _dt.datetime:
+    return _dt.datetime.now(tz=UTC)
+
+
+def run_evaluation(
+    evaluation: Evaluation,
+    engine_params_list: Optional[Sequence[EngineParams]] = None,
+    generator: Optional[EngineParamsGenerator] = None,
+    evaluation_class: str = "",
+    generator_class: str = "",
+    batch: str = "",
+    ctx: Optional[MeshContext] = None,
+    workflow_params: Optional[WorkflowParams] = None,
+    storage: Optional[Storage] = None,
+    evaluator: Optional[MetricEvaluator] = None,
+    use_fast_eval: bool = True,
+) -> MetricEvaluatorResult:
+    """ref: CoreWorkflow.runEvaluation:96. Returns the evaluator result."""
+    storage = storage or get_storage()
+    ctx = ctx or MeshContext()
+    evaluator = evaluator or MetricEvaluator()
+    if engine_params_list is None:
+        if generator is None:
+            raise ValueError("provide engine_params_list or generator")
+        engine_params_list = generator.engine_params_list
+
+    instance = EvaluationInstance(
+        id=uuid.uuid4().hex,
+        status="INIT",
+        start_time=_now(),
+        end_time=_now(),
+        evaluation_class=evaluation_class,
+        engine_params_generator_class=generator_class,
+        batch=batch,
+    )
+    storage.evaluation_instances().insert(instance)
+    try:
+        instance.status = "EVALUATING"
+        storage.evaluation_instances().update(instance)
+
+        eval_fn = None
+        if use_fast_eval:
+            # memoize shared DASE prefixes across candidates
+            # (ref: FastEvalEngine.scala:38)
+            from predictionio_tpu.core.fast_eval import FastEvalEngineWorkflow
+
+            workflow = FastEvalEngineWorkflow(evaluation.engine, ctx)
+            eval_fn = lambda c, ep: workflow.eval(ep)
+
+        result = evaluator.evaluate(
+            ctx, evaluation, engine_params_list, workflow_params, eval_fn=eval_fn
+        )
+        instance.status = "EVALCOMPLETED"
+        instance.end_time = _now()
+        instance.evaluator_results = result.to_one_liner()
+        instance.evaluator_results_json = result.to_json()
+        instance.evaluator_results_html = result.to_html()
+        storage.evaluation_instances().update(instance)
+        return result
+    except Exception:
+        instance.status = "FAILED"
+        instance.end_time = _now()
+        storage.evaluation_instances().update(instance)
+        raise

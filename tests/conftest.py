@@ -8,10 +8,16 @@ first ``import jax`` anywhere in the test session.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+# the environment may pin a TPU platform plugin over JAX_PLATFORMS; the
+# config update wins as long as no backend has been initialized yet
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
