@@ -1,0 +1,17 @@
+"""HTTP servers: event collection + query serving + ops stats.
+
+Maps the reference's server layer (SURVEY.md §1 L6):
+
+  event_server  — REST event ingestion, port 7070
+                  (ref: data/.../api/EventAPI.scala)
+  engine_server — deployed-engine query serving, port 8000
+                  (ref: core/.../workflow/CreateServer.scala)
+  stats         — per-app operational counters
+                  (ref: data/.../api/Stats.scala, StatsActor.scala)
+  webhooks      — third-party payload connectors
+                  (ref: data/.../webhooks/)
+
+Servers are stdlib ThreadingHTTPServer-based: the compute hot path
+(predict) is one jitted device call, so an async reactor adds nothing
+the thread pool doesn't already give at this tier.
+"""

@@ -1,0 +1,285 @@
+"""The Engine Server: deployed-engine query serving, default port 8000.
+
+Behavior contract from the reference (core/.../workflow/CreateServer.scala):
+
+  - boots from the latest COMPLETED EngineInstance for an engine
+    (Console.deploy picks it, Console.scala:845-852), reloading models
+    from the Models repo (createServerActorWithEngine:190)
+  - ``POST /queries.json`` (:462): JSON query -> every algorithm's
+    predict on its model -> Serving combines -> JSON response; per
+    request stats (requestCount / avg serving time :552-559); optional
+    feedback loop POSTs a ``predict`` event (+prId) back to the event
+    server (:488-550)
+  - ``GET /`` status page with engine info, params and request stats
+    (:433-459)
+  - ``GET /reload`` hot-swaps to the latest completed instance (:592)
+  - ``POST /stop`` shuts the server down (:600)
+  - bind retry x3 with 1s backoff (MasterActor, :340-350)
+
+The reference's Akka Master/Server actor pair collapses into one
+threaded HTTP server with a swappable Deployment reference.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import threading
+import time
+import urllib.request
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+from urllib.parse import urlparse
+
+from predictionio_tpu.core.engine import Engine
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.workflow.deploy import Deployment, prepare_deploy
+
+log = logging.getLogger(__name__)
+
+DEFAULT_PORT = 8000  # ref: CreateServer.scala:83
+UTC = _dt.timezone.utc
+
+
+class ServingStats:
+    """Request bookkeeping (ref: CreateServer.scala:552-559)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.request_count = 0
+        self.total_serving_sec = 0.0
+        self.last_serving_sec = 0.0
+        self.start_time = _dt.datetime.now(tz=UTC)
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self.request_count += 1
+            self.total_serving_sec += seconds
+            self.last_serving_sec = seconds
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            avg = self.total_serving_sec / self.request_count if self.request_count else 0.0
+            return {
+                "startTime": self.start_time.isoformat(),
+                "requestCount": self.request_count,
+                "avgServingSec": avg,
+                "lastServingSec": self.last_serving_sec,
+            }
+
+
+class EngineServer:
+    """One deployed engine behind HTTP (ref: CreateServer.scala:100,106)."""
+
+    def __init__(
+        self,
+        engine: Engine,
+        engine_id: str,
+        engine_version: str = "0",
+        engine_variant: str = "default",
+        host: str = "0.0.0.0",
+        port: int = DEFAULT_PORT,
+        ctx: Optional[MeshContext] = None,
+        storage: Optional[Storage] = None,
+        feedback_url: Optional[str] = None,
+        feedback_access_key: Optional[str] = None,
+        bind_retries: int = 3,
+    ):
+        self.engine = engine
+        self.engine_id = engine_id
+        self.engine_version = engine_version
+        self.engine_variant = engine_variant
+        self.ctx = ctx or MeshContext()
+        self.storage = storage or get_storage()
+        self.feedback_url = feedback_url
+        self.feedback_access_key = feedback_access_key
+        self.stats = ServingStats()
+        self._deployment_lock = threading.Lock()
+        self.deployment: Deployment = self._load_latest()
+
+        handler = type("Handler", (_EngineRequestHandler,), {"server_ref": self})
+        last_error = None
+        for attempt in range(bind_retries):
+            # bind retry x3 with 1s backoff (ref: CreateServer.scala:340-350)
+            try:
+                self.httpd = ThreadingHTTPServer((host, port), handler)
+                break
+            except OSError as e:
+                last_error = e
+                log.warning("bind attempt %d failed: %s", attempt + 1, e)
+                time.sleep(1)
+        else:
+            raise last_error
+        self._thread: Optional[threading.Thread] = None
+
+    # -- deployment management ----------------------------------------------
+    def _load_latest(self) -> Deployment:
+        instance = self.storage.engine_instances().get_latest_completed(
+            self.engine_id, self.engine_version, self.engine_variant
+        )
+        if instance is None:
+            raise RuntimeError(
+                f"No valid engine instance found for engine {self.engine_id} "
+                f"{self.engine_version} {self.engine_variant}"
+            )
+        return prepare_deploy(self.engine, instance, self.ctx, self.storage)
+
+    def reload(self) -> str:
+        """Hot-swap to the latest completed instance (ref: /reload :592)."""
+        deployment = self._load_latest()
+        with self._deployment_lock:
+            self.deployment = deployment
+        return deployment.instance.id
+
+    # -- query path ---------------------------------------------------------
+    def query(self, payload: Any) -> Any:
+        t0 = time.perf_counter()
+        with self._deployment_lock:
+            deployment = self.deployment
+        result = deployment.query(payload)
+        elapsed = time.perf_counter() - t0
+        self.stats.record(elapsed)
+        if self.feedback_url and self.feedback_access_key:
+            # prId lets follow-up events join back to this prediction
+            # (ref: CreateServer feedback loop assigns prId :488-550)
+            pr_id = uuid.uuid4().hex
+            if isinstance(result, dict):
+                result = {**result, "prId": pr_id}
+            threading.Thread(
+                target=self._send_feedback,
+                args=(payload, result, pr_id, deployment.instance.id),
+                daemon=True,
+            ).start()
+        return result
+
+    def _send_feedback(self, query: Any, prediction: Any, pr_id: str, instance_id: str) -> None:
+        """Async predict-event feedback loop (ref: CreateServer.scala:488-550)."""
+        try:
+            event = {
+                "event": "predict",
+                "entityType": "pio_pr",
+                "entityId": instance_id,
+                "prId": pr_id,
+                "properties": {"query": query, "prediction": prediction},
+            }
+            req = urllib.request.Request(
+                f"{self.feedback_url}/events.json?accessKey={self.feedback_access_key}",
+                data=json.dumps(event).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            urllib.request.urlopen(req, timeout=5)
+        except Exception as e:  # feedback is best-effort
+            log.warning("feedback loop failed: %s", e)
+
+    def status(self) -> dict:
+        """ref: status landing page content (CreateServer.scala:433-459)."""
+        with self._deployment_lock:
+            instance = self.deployment.instance
+        return {
+            "status": "alive",
+            "engineId": self.engine_id,
+            "engineVersion": self.engine_version,
+            "engineVariant": self.engine_variant,
+            "engineInstanceId": instance.id,
+            "engineFactory": instance.engine_factory,
+            "trainedAt": instance.end_time.isoformat(),
+            "algorithms": json.loads(instance.algorithms_params or "[]"),
+            "stats": self.stats.snapshot(),
+        }
+
+    # -- lifecycle ----------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> "EngineServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        log.info("engine server for %s listening on %s", self.engine_id, self.port)
+        return self
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def stop(self) -> None:
+        # shutdown must complete before the socket closes, and may not run
+        # on the serve thread — do both in order on a helper thread
+        def _shutdown():
+            self.httpd.shutdown()
+            self.httpd.server_close()
+
+        threading.Thread(target=_shutdown, daemon=True).start()
+
+
+class _EngineRequestHandler(BaseHTTPRequestHandler):
+    server_version = "PIOEngineServer/0.1"
+    server_ref: EngineServer = None
+
+    def log_message(self, fmt, *args):
+        log.debug("engine-server: " + fmt, *args)
+
+    def _send(self, status: int, body: Any, content_type="application/json; charset=UTF-8"):
+        data = json.dumps(body).encode() if not isinstance(body, bytes) else body
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        path = urlparse(self.path).path
+        if path == "/":
+            self._send(200, self.server_ref.status())
+        elif path == "/reload":
+            try:
+                instance_id = self.server_ref.reload()
+                self._send(200, {"message": "reloaded", "engineInstanceId": instance_id})
+            except RuntimeError as e:
+                self._send(404, {"message": str(e)})
+        else:
+            self._send(404, {"message": "Not Found"})
+
+    def do_POST(self):
+        path = urlparse(self.path).path
+        if path == "/queries.json":
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                payload = json.loads(self.rfile.read(length) or b"{}")
+            except json.JSONDecodeError as e:
+                self._send(400, {"message": f"invalid JSON: {e}"})
+                return
+            try:
+                result = self.server_ref.query(payload)
+            except (KeyError, TypeError, ValueError) as e:
+                # malformed query for this engine (ref: 400 on bad query JSON)
+                self._send(400, {"message": f"bad query: {e}"})
+                return
+            except Exception as e:
+                log.exception("query failed")
+                self._send(500, {"message": str(e)})
+                return
+            self._send(200, result)
+        elif path == "/stop":
+            self._send(200, {"message": "stopping"})
+            self.server_ref.stop()
+        else:
+            self._send(404, {"message": "Not Found"})
+
+
+def deploy(
+    engine: Engine,
+    engine_id: str,
+    engine_version: str = "0",
+    engine_variant: str = "default",
+    **kwargs,
+) -> EngineServer:
+    """Convenience: build + start a server for the latest completed
+    instance (the `pio deploy` path, Console.scala:830)."""
+    return EngineServer(
+        engine, engine_id, engine_version=engine_version,
+        engine_variant=engine_variant, **kwargs
+    ).start()

@@ -1,0 +1,368 @@
+"""The Event Server: REST event collection API, default port 7070.
+
+Behavior contract from the reference (data/.../api/EventAPI.scala):
+
+  - access-key auth on every data route: ``accessKey`` query param (or
+    ``Authorization`` basic credentials), resolving to (appId,
+    channelId); optional ``channel`` query param; failures are
+    401 {"message": "Invalid accessKey."} / channel errors likewise
+    (withAccessKey, EventAPI.scala:91-117)
+  - ``POST /events.json`` — single event create -> 201 {"eventId": id};
+    access keys may carry an allowed-event whitelist -> 403 on others
+  - ``GET /events/<id>.json`` / ``DELETE /events/<id>.json`` — fetch /
+    delete one event (EventAPI.scala:131)
+  - ``GET /events.json`` — filtered query: startTime/untilTime (ISO),
+    entityType/entityId, event (repeatable), targetEntityType/Id,
+    limit (default 20, -1 = all), reversed (requires entityType+Id)
+    (EventAPI.scala:209)
+  - ``GET /`` — {"status": "alive"}; ``GET /stats.json`` — per-app op
+    counters (EventAPI.scala:324)
+  - ``POST /webhooks/<name>.json`` (JSON) and ``POST /webhooks/<name>``
+    (form) via the connector registry; GET checks connector existence
+    (EventAPI.scala:352-454)
+
+The reference's spray/akka actor stack maps to a stdlib threading HTTP
+server; Stats bookkeeping replaces the StatsActor.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import threading
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from predictionio_tpu.data.event import Event, EventValidationError, validate_event, _parse_time
+from predictionio_tpu.data.storage import UNSET, Storage, StorageError, get_storage
+from predictionio_tpu.serving.stats import Stats
+from predictionio_tpu.serving import webhooks as webhook_registry
+from predictionio_tpu.serving.webhooks import ConnectorError
+
+log = logging.getLogger(__name__)
+
+DEFAULT_PORT = 7070  # ref: EventAPI.scala:494
+
+
+class AuthError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+@dataclass
+class AuthData:
+    """ref: EventAPI.scala AuthData(appId, channelId, events)."""
+
+    app_id: int
+    channel_id: Optional[int]
+    events: list
+
+
+class EventServerCore:
+    """Transport-independent request handling (also used by tests)."""
+
+    def __init__(self, storage: Optional[Storage] = None, stats: Optional[Stats] = None):
+        self.storage = storage or get_storage()
+        self.stats = stats or Stats()
+
+    # -- auth ---------------------------------------------------------------
+    def authenticate(self, access_key: Optional[str], channel_name: Optional[str]) -> AuthData:
+        """ref: withAccessKey (EventAPI.scala:91)."""
+        if not access_key:
+            raise AuthError(401, "Missing accessKey.")
+        key = self.storage.access_keys().get(access_key)
+        if key is None:
+            raise AuthError(401, "Invalid accessKey.")
+        channel_id = None
+        if channel_name is not None:
+            channels = self.storage.channels().get_by_app_id(key.appid)
+            ch = next((c for c in channels if c.name == channel_name), None)
+            if ch is None:
+                raise AuthError(400, "Invalid channel.")
+            channel_id = ch.id
+        return AuthData(app_id=key.appid, channel_id=channel_id, events=list(key.events))
+
+    # -- event CRUD ---------------------------------------------------------
+    def create_event(self, auth: AuthData, payload: dict) -> Tuple[int, dict]:
+        try:
+            event = Event.from_dict(payload)
+            validate_event(event)
+        except (EventValidationError, ValueError, TypeError, AttributeError) as e:
+            # bad field types / unparseable times are client errors too
+            self.stats.update(auth.app_id, 400, payload.get("event", ""), payload.get("entityType", ""))
+            return 400, {"message": str(e)}
+        if auth.events and event.event not in auth.events:
+            # per-key event whitelist (ref: AccessKeys events field)
+            self.stats.update(auth.app_id, 403, event.event, event.entity_type)
+            return 403, {"message": f"{event.event} events are not allowed"}
+        try:
+            event_id = self.storage.events().insert(event, auth.app_id, auth.channel_id)
+        except StorageError as e:
+            return 500, {"message": str(e)}
+        self.stats.update(auth.app_id, 201, event.event, event.entity_type)
+        return 201, {"eventId": event_id}
+
+    def get_event(self, auth: AuthData, event_id: str) -> Tuple[int, dict]:
+        event = self.storage.events().get(event_id, auth.app_id, auth.channel_id)
+        if event is None:
+            return 404, {"message": "Not Found"}
+        return 200, event.to_dict(api_format=False)
+
+    def delete_event(self, auth: AuthData, event_id: str) -> Tuple[int, dict]:
+        found = self.storage.events().delete(event_id, auth.app_id, auth.channel_id)
+        if not found:
+            return 404, {"message": "Not Found"}
+        return 200, {"message": "Found"}
+
+    def query_events(self, auth: AuthData, params: Dict[str, list]) -> Tuple[int, Any]:
+        """ref: GET /events.json (EventAPI.scala:209)."""
+
+        def one(name, default=None):
+            vals = params.get(name)
+            return vals[0] if vals else default
+
+        try:
+            start_time = _parse_iso(one("startTime"))
+            until_time = _parse_iso(one("untilTime"))
+        except ValueError as e:
+            return 400, {"message": str(e)}
+        entity_type = one("entityType")
+        entity_id = one("entityId")
+        event_names = params.get("event")
+        target_entity_type = one("targetEntityType", UNSET)
+        target_entity_id = one("targetEntityId", UNSET)
+        try:
+            limit = int(one("limit", "20"))
+        except ValueError:
+            return 400, {"message": "limit must be an integer."}
+        if limit == 0 or limit < -1:
+            return 400, {"message": "limit must be -1 (all) or positive."}
+        reversed_flag = one("reversed", "false").lower() == "true"
+        if reversed_flag and not (entity_type and entity_id):
+            return 400, {
+                "message": "the reversed parameter can only be used with both entityType and entityId specified."
+            }
+        events = self.storage.events().find(
+            auth.app_id,
+            channel_id=auth.channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=event_names,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
+            limit=None if limit == -1 else limit,
+            reversed=reversed_flag,
+        )
+        if not events:
+            return 404, {"message": "Not Found"}
+        return 200, [e.to_dict(api_format=False) for e in events]
+
+    # -- webhooks -----------------------------------------------------------
+    def webhook_json(self, auth: AuthData, name: str, payload: dict) -> Tuple[int, dict]:
+        try:
+            connector = webhook_registry.json_connector(name)
+        except KeyError:
+            return 404, {"message": f"webhook connection for {name} is not supported."}
+        try:
+            event_json = connector.to_event_json(payload)
+        except ConnectorError as e:
+            return 400, {"message": str(e)}
+        return self.create_event(auth, event_json)
+
+    def webhook_form(self, auth: AuthData, name: str, fields: Dict[str, str]) -> Tuple[int, dict]:
+        try:
+            connector = webhook_registry.form_connector(name)
+        except KeyError:
+            return 404, {"message": f"webhook connection for {name} is not supported."}
+        try:
+            event_json = connector.to_event_json(fields)
+        except ConnectorError as e:
+            return 400, {"message": str(e)}
+        return self.create_event(auth, event_json)
+
+    def webhook_exists(self, name: str, form: bool) -> Tuple[int, dict]:
+        try:
+            (webhook_registry.form_connector if form else webhook_registry.json_connector)(name)
+            return 200, {"message": "Ok"}
+        except KeyError:
+            return 404, {"message": f"webhook connection for {name} is not supported."}
+
+
+def _parse_iso(s: Optional[str]) -> Optional[_dt.datetime]:
+    if s is None:
+        return None
+    try:
+        return _parse_time(s)  # same parser as event bodies (data/event.py)
+    except ValueError:
+        raise ValueError(f"Invalid time string: {s}")
+
+
+class _EventRequestHandler(BaseHTTPRequestHandler):
+    server_version = "PIOEventServer/0.1"
+    core: EventServerCore = None  # set by EventServer
+
+    # -- plumbing -----------------------------------------------------------
+    def log_message(self, fmt, *args):
+        log.debug("event-server: " + fmt, *args)
+
+    def _send(self, status: int, body: Any) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json; charset=UTF-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _auth(self, params) -> AuthData:
+        access_key = (params.get("accessKey") or [None])[0]
+        if not access_key:
+            # Basic credentials with the key as username
+            # (ref: withAccessKey also accepts HTTP credentials, EventAPI.scala:91)
+            header = self.headers.get("Authorization", "")
+            if header.startswith("Basic "):
+                import base64
+
+                try:
+                    decoded = base64.b64decode(header[6:]).decode()
+                    access_key = decoded.split(":", 1)[0]
+                except Exception:
+                    pass
+        channel = (params.get("channel") or [None])[0]
+        return self.core.authenticate(access_key, channel)
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(length) if length else b""
+
+    def _dispatch(self, method: str) -> None:
+        url = urlparse(self.path)
+        path = url.path
+        params = parse_qs(url.query)
+        try:
+            if path == "/" and method == "GET":
+                self._send(200, {"status": "alive"})
+                return
+            if path == "/stats.json" and method == "GET":
+                auth = self._auth(params)
+                self._send(200, self.core.stats.report(auth.app_id))
+                return
+            if path == "/events.json":
+                auth = self._auth(params)
+                if method == "POST":
+                    try:
+                        payload = json.loads(self._read_body() or b"{}")
+                    except json.JSONDecodeError as e:
+                        self._send(400, {"message": f"invalid JSON: {e}"})
+                        return
+                    self._send(*self.core.create_event(auth, payload))
+                elif method == "GET":
+                    self._send(*self.core.query_events(auth, params))
+                else:
+                    self._send(405, {"message": "method not allowed"})
+                return
+            if path.startswith("/events/") and path.endswith(".json"):
+                auth = self._auth(params)
+                event_id = path[len("/events/"):-len(".json")]
+                if method == "GET":
+                    self._send(*self.core.get_event(auth, event_id))
+                elif method == "DELETE":
+                    self._send(*self.core.delete_event(auth, event_id))
+                else:
+                    self._send(405, {"message": "method not allowed"})
+                return
+            if path.startswith("/webhooks/"):
+                name = path[len("/webhooks/"):]
+                is_json = name.endswith(".json")
+                if is_json:
+                    name = name[:-len(".json")]
+                if method == "GET":
+                    self._send(*self.core.webhook_exists(name, form=not is_json))
+                    return
+                auth = self._auth(params)
+                if is_json:
+                    try:
+                        payload = json.loads(self._read_body() or b"{}")
+                    except json.JSONDecodeError as e:
+                        self._send(400, {"message": f"invalid JSON: {e}"})
+                        return
+                    self._send(*self.core.webhook_json(auth, name, payload))
+                else:
+                    fields = {
+                        k: v[0]
+                        for k, v in parse_qs(
+                            self._read_body().decode(), keep_blank_values=True
+                        ).items()
+                    }
+                    self._send(*self.core.webhook_form(auth, name, fields))
+                return
+            self._send(404, {"message": "Not Found"})
+        except AuthError as e:
+            self._send(e.status, {"message": e.message})
+        except Exception as e:  # pragma: no cover - defensive 500
+            log.exception("event server error")
+            self._send(500, {"message": str(e)})
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+
+class EventServer:
+    """ref: EventServer.createEventServer (EventAPI.scala:497)."""
+
+    def __init__(
+        self,
+        storage: Optional[Storage] = None,
+        host: str = "0.0.0.0",
+        port: int = DEFAULT_PORT,
+        stats: Optional[Stats] = None,
+    ):
+        self.core = EventServerCore(storage, stats)
+        handler = type("Handler", (_EventRequestHandler,), {"core": self.core})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> "EventServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        log.info("event server listening on %s", self.port)
+        return self
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def main(argv=None) -> None:
+    """Standalone runner (ref: EventServer Run main, EventAPI.scala:519)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description="PredictionIO-TPU event server")
+    parser.add_argument("--ip", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=DEFAULT_PORT)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    EventServer(host=args.ip, port=args.port).serve_forever()
+
+
+if __name__ == "__main__":
+    main()

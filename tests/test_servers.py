@@ -1,0 +1,367 @@
+"""Event + Engine server HTTP behavior
+(ref specs: EventServiceSpec.scala:33, webhook connector specs,
+CreateServer routes)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+
+import pytest
+
+from predictionio_tpu.core import Algorithm, DataSource, Engine, FirstServing, IdentityPreparator
+from predictionio_tpu.core.params import EngineParams, Params
+from predictionio_tpu.data.metadata import AccessKey
+from predictionio_tpu.serving.engine_server import EngineServer
+from predictionio_tpu.serving.event_server import EventServer
+from predictionio_tpu.workflow.train import run_train
+
+
+def http(method, url, body=None, form=False):
+    data = None
+    headers = {}
+    if body is not None:
+        if form:
+            from urllib.parse import urlencode
+
+            data = urlencode(body).encode()
+            headers["Content-Type"] = "application/x-www-form-urlencoded"
+        else:
+            data = json.dumps(body).encode()
+            headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=data, headers=headers, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+@pytest.fixture()
+def event_server(memory_storage):
+    app = memory_storage.apps().insert("srv-app")
+    memory_storage.events().init(app.id)
+    key = AccessKey.generate(app.id)
+    memory_storage.access_keys().insert(key)
+    server = EventServer(storage=memory_storage, host="127.0.0.1", port=0).start()
+    yield server, app, key
+    server.stop()
+
+
+def test_event_server_alive_and_auth(event_server):
+    server, app, key = event_server
+    base = f"http://127.0.0.1:{server.port}"
+    assert http("GET", f"{base}/")[1] == {"status": "alive"}
+    status, body = http("POST", f"{base}/events.json", {"event": "rate"})
+    assert status == 401
+    status, body = http("POST", f"{base}/events.json?accessKey=WRONG", {"event": "rate"})
+    assert status == 401
+    assert body["message"] == "Invalid accessKey."
+
+
+def test_event_crud_over_http(event_server):
+    server, app, key = event_server
+    base = f"http://127.0.0.1:{server.port}/events"
+    auth = f"accessKey={key.key}"
+    status, body = http(
+        "POST",
+        f"{base}.json?{auth}",
+        {
+            "event": "rate",
+            "entityType": "user",
+            "entityId": "u1",
+            "targetEntityType": "item",
+            "targetEntityId": "i1",
+            "properties": {"rating": 5},
+            "eventTime": "2026-01-01T00:00:00Z",
+        },
+    )
+    assert status == 201
+    event_id = body["eventId"]
+    status, body = http("GET", f"{base}/{event_id}.json?{auth}")
+    assert status == 200
+    assert body["event"] == "rate" and body["properties"] == {"rating": 5}
+    assert body["eventTime"] == "2026-01-01T00:00:00Z"
+    status, body = http("DELETE", f"{base}/{event_id}.json?{auth}")
+    assert status == 200 and body == {"message": "Found"}
+    assert http("GET", f"{base}/{event_id}.json?{auth}")[0] == 404
+    assert http("DELETE", f"{base}/{event_id}.json?{auth}")[0] == 404
+
+
+def test_event_validation_and_whitelist(event_server):
+    server, app, key = event_server
+    base = f"http://127.0.0.1:{server.port}/events.json"
+    status, body = http(
+        "POST", f"{base}?accessKey={key.key}",
+        {"event": "$bogus", "entityType": "user", "entityId": "u1"},
+    )
+    assert status == 400
+    # whitelist-restricted key
+    restricted = AccessKey.generate(app.id, events=["view"])
+    server.core.storage.access_keys().insert(restricted)
+    status, body = http(
+        "POST", f"{base}?accessKey={restricted.key}",
+        {"event": "buy", "entityType": "user", "entityId": "u1"},
+    )
+    assert status == 403
+    status, _ = http(
+        "POST", f"{base}?accessKey={restricted.key}",
+        {"event": "view", "entityType": "user", "entityId": "u1"},
+    )
+    assert status == 201
+
+
+def test_event_query_filters(event_server):
+    server, app, key = event_server
+    base = f"http://127.0.0.1:{server.port}/events.json"
+    auth = f"accessKey={key.key}"
+    for i, (name, uid) in enumerate([("rate", "u1"), ("rate", "u2"), ("buy", "u1")]):
+        http("POST", f"{base}?{auth}", {
+            "event": name, "entityType": "user", "entityId": uid,
+            "eventTime": f"2026-01-01T00:0{i}:00Z",
+        })
+    status, body = http("GET", f"{base}?{auth}")
+    assert status == 200 and len(body) == 3
+    status, body = http("GET", f"{base}?{auth}&event=rate")
+    assert len(body) == 2
+    status, body = http("GET", f"{base}?{auth}&entityType=user&entityId=u1&reversed=true&limit=1")
+    assert body[0]["event"] == "buy"
+    # reversed without entity -> 400 (ref: EventAPI reversed constraint)
+    assert http("GET", f"{base}?{auth}&reversed=true")[0] == 400
+    # half-open window
+    status, body = http(
+        "GET", f"{base}?{auth}&startTime=2026-01-01T00:01:00Z&untilTime=2026-01-01T00:02:00Z"
+    )
+    assert len(body) == 1 and body[0]["entityId"] == "u2"
+    assert http("GET", f"{base}?{auth}&startTime=garbage")[0] == 400
+    # no match -> 404
+    assert http("GET", f"{base}?{auth}&event=nope")[0] == 404
+
+
+def test_channels_over_http(event_server):
+    server, app, key = event_server
+    ch = server.core.storage.channels().insert("live", app.id)
+    server.core.storage.events().init(app.id, ch.id)
+    base = f"http://127.0.0.1:{server.port}/events.json"
+    http("POST", f"{base}?accessKey={key.key}&channel=live",
+         {"event": "rate", "entityType": "user", "entityId": "u9"})
+    status, body = http("GET", f"{base}?accessKey={key.key}&channel=live")
+    assert len(body) == 1 and body[0]["entityId"] == "u9"
+    # default channel unaffected
+    assert http("GET", f"{base}?accessKey={key.key}")[0] == 404
+    assert http("GET", f"{base}?accessKey={key.key}&channel=nope")[0] == 400
+
+
+def test_stats_endpoint(event_server):
+    server, app, key = event_server
+    base = f"http://127.0.0.1:{server.port}"
+    http("POST", f"{base}/events.json?accessKey={key.key}",
+         {"event": "rate", "entityType": "user", "entityId": "u1"})
+    http("POST", f"{base}/events.json?accessKey={key.key}", {"event": "$bogus",
+         "entityType": "user", "entityId": "u1"})
+    status, body = http("GET", f"{base}/stats.json?accessKey={key.key}")
+    assert status == 200
+    counts = {(c["status"], c["event"]): c["count"] for b in body["buckets"] for c in b["counts"]}
+    assert counts[(201, "rate")] == 1
+    assert counts[(400, "$bogus")] == 1
+
+
+def test_webhooks(event_server):
+    server, app, key = event_server
+    base = f"http://127.0.0.1:{server.port}/webhooks"
+    auth = f"accessKey={key.key}"
+    # GET existence checks (ref: EventAPI webhook GET routes)
+    assert http("GET", f"{base}/segmentio.json?{auth}")[0] == 200
+    assert http("GET", f"{base}/nope.json?{auth}")[0] == 404
+    assert http("GET", f"{base}/mailchimp?{auth}")[0] == 200
+    # segmentio identify (ref: SegmentIOConnector)
+    status, body = http("POST", f"{base}/segmentio.json?{auth}", {
+        "type": "identify", "userId": "u42",
+        "timestamp": "2026-02-01T10:00:00Z",
+        "traits": {"email": "x@y.z"},
+    })
+    assert status == 201
+    ev = server.core.storage.events().find(app.id, event_names=["identify"])[0]
+    assert ev.entity_id == "u42"
+    assert ev.properties.get("traits", dict) == {"email": "x@y.z"}
+    # unknown segmentio type -> 400
+    status, body = http("POST", f"{base}/segmentio.json?{auth}",
+                        {"type": "track", "userId": "u", "timestamp": "2026-01-01T00:00:00Z"})
+    assert status == 400
+    # mailchimp subscribe form (ref: MailChimpConnector)
+    fields = {
+        "type": "subscribe", "fired_at": "2026-03-26 21:35:57",
+        "data[id]": "8a25ff1d98", "data[list_id]": "a6b5da1054",
+        "data[email]": "api@mailchimp.com", "data[email_type]": "html",
+        "data[merges][EMAIL]": "api@mailchimp.com",
+        "data[merges][FNAME]": "MailChimp", "data[merges][LNAME]": "API",
+        "data[merges][INTERESTS]": "Group1,Group2",
+        "data[ip_opt]": "10.20.10.30", "data[ip_signup]": "10.20.10.30",
+    }
+    status, body = http("POST", f"{base}/mailchimp?{auth}", fields, form=True)
+    assert status == 201
+    ev = server.core.storage.events().find(app.id, event_names=["subscribe"])[0]
+    assert ev.target_entity_id == "a6b5da1054"
+    assert ev.event_time.year == 2026 and ev.event_time.hour == 21
+    # missing type -> 400
+    assert http("POST", f"{base}/mailchimp?{auth}", {"x": "1"}, form=True)[0] == 400
+
+
+# ---------------------------------------------------------------------------
+# engine server
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ConstParams(Params):
+    value: float = 1.0
+
+
+class ConstDataSource(DataSource):
+    def __init__(self, params: ConstParams):
+        super().__init__(params)
+
+    def read_training(self, ctx):
+        return self.params.value
+
+
+class ConstAlgo(Algorithm):
+    def __init__(self, params: ConstParams):
+        super().__init__(params)
+
+    def train(self, ctx, pd):
+        return pd + self.params.value
+
+    def predict(self, model, query):
+        return {"result": model * query["mult"]}
+
+
+def const_engine():
+    return Engine(ConstDataSource, IdentityPreparator, {"const": ConstAlgo}, FirstServing)
+
+
+def train_const(storage, ds_value=1.0, algo_value=2.0):
+    engine = const_engine()
+    ep = EngineParams(
+        data_source_params=("", ConstParams(value=ds_value)),
+        preparator_params=("", None),
+        algorithm_params_list=[("const", ConstParams(value=algo_value))],
+        serving_params=("", None),
+    )
+    return engine, run_train(engine, ep, engine_id="const", storage=storage)
+
+
+@pytest.fixture()
+def engine_server(memory_storage):
+    engine, _ = train_const(memory_storage)  # model = 1 + 2 = 3
+    server = EngineServer(
+        engine, "const", host="127.0.0.1", port=0, storage=memory_storage
+    ).start()
+    yield server, engine, memory_storage
+    server.stop()
+
+
+def test_engine_server_query_and_status(engine_server):
+    server, engine, storage = engine_server
+    base = f"http://127.0.0.1:{server.port}"
+    status, body = http("POST", f"{base}/queries.json", {"mult": 5})
+    assert status == 200 and body == {"result": 15.0}
+    status, body = http("GET", f"{base}/")
+    assert body["status"] == "alive"
+    assert body["engineId"] == "const"
+    assert body["stats"]["requestCount"] == 1
+    assert body["stats"]["avgServingSec"] > 0
+    # malformed query -> 400
+    assert http("POST", f"{base}/queries.json", {"wrong": 1})[0] == 400
+    assert http("GET", f"{base}/nope")[0] == 404
+
+
+def test_engine_server_reload_hot_swaps(engine_server):
+    server, engine, storage = engine_server
+    base = f"http://127.0.0.1:{server.port}"
+    assert http("POST", f"{base}/queries.json", {"mult": 1})[1] == {"result": 3.0}
+    # retrain with new params, then /reload (ref: CreateServer.scala:592)
+    train_const(storage, ds_value=10.0, algo_value=10.0)  # model = 20
+    status, body = http("GET", f"{base}/reload")
+    assert status == 200
+    assert http("POST", f"{base}/queries.json", {"mult": 1})[1] == {"result": 20.0}
+
+
+def test_engine_server_requires_completed_instance(memory_storage):
+    with pytest.raises(RuntimeError, match="No valid engine instance"):
+        EngineServer(const_engine(), "never-trained", host="127.0.0.1", port=0,
+                     storage=memory_storage)
+
+
+def test_engine_server_stop_route(memory_storage):
+    engine, _ = train_const(memory_storage)
+    server = EngineServer(engine, "const", host="127.0.0.1", port=0,
+                          storage=memory_storage).start()
+    base = f"http://127.0.0.1:{server.port}"
+    assert http("POST", f"{base}/stop")[1] == {"message": "stopping"}
+    time.sleep(0.2)
+    with pytest.raises(Exception):
+        http("GET", f"{base}/", None)
+
+
+def test_feedback_loop(memory_storage):
+    """Query -> async predict event lands in the event store
+    (ref: CreateServer.scala:488-550)."""
+    app = memory_storage.apps().insert("fb-app")
+    memory_storage.events().init(app.id)
+    key = AccessKey.generate(app.id)
+    memory_storage.access_keys().insert(key)
+    event_srv = EventServer(storage=memory_storage, host="127.0.0.1", port=0).start()
+    engine, _ = train_const(memory_storage)
+    engine_srv = EngineServer(
+        engine, "const", host="127.0.0.1", port=0, storage=memory_storage,
+        feedback_url=f"http://127.0.0.1:{event_srv.port}",
+        feedback_access_key=key.key,
+    ).start()
+    try:
+        http("POST", f"http://127.0.0.1:{engine_srv.port}/queries.json", {"mult": 2})
+        deadline = time.time() + 5
+        events = []
+        while time.time() < deadline:
+            events = memory_storage.events().find(app.id, event_names=["predict"])
+            if events:
+                break
+            time.sleep(0.05)
+        assert events, "feedback predict event never arrived"
+        props = events[0].properties
+        assert props.get("query", dict) == {"mult": 2}
+        prediction = props.get("prediction", dict)
+        assert prediction["result"] == 6.0
+        # prId joins the event back to the served prediction
+        assert events[0].pr_id == prediction["prId"]
+        assert events[0].entity_type == "pio_pr"
+    finally:
+        engine_srv.stop()
+        event_srv.stop()
+
+
+def test_event_server_review_regressions(event_server):
+    """400s (not 500s) for bad eventTime / bad limit; target filters work;
+    Basic-auth credentials accepted."""
+    server, app, key = event_server
+    base = f"http://127.0.0.1:{server.port}/events.json"
+    auth = f"accessKey={key.key}"
+    status, body = http("POST", f"{base}?{auth}", {
+        "event": "rate", "entityType": "user", "entityId": "u1",
+        "eventTime": "not-a-date"})
+    assert status == 400
+    assert http("GET", f"{base}?{auth}&limit=abc")[0] == 400
+    # target entity filters
+    for iid in ("i1", "i2"):
+        http("POST", f"{base}?{auth}", {"event": "rate", "entityType": "user",
+             "entityId": "u1", "targetEntityType": "item", "targetEntityId": iid})
+    status, body = http("GET", f"{base}?{auth}&targetEntityType=item&targetEntityId=i2")
+    assert status == 200 and len(body) == 1 and body[0]["targetEntityId"] == "i2"
+    # Basic auth: key as username (ref: withAccessKey credentials path)
+    import base64 as b64
+    req = urllib.request.Request(
+        f"{base}", method="GET",
+        headers={"Authorization": "Basic " + b64.b64encode(f"{key.key}:".encode()).decode()},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.status == 200
