@@ -1,0 +1,109 @@
+"""Top-N Markov chain transition model (ref: e2/.../engine/MarkovChain.scala:25).
+
+Behavior contract from the reference:
+
+  - ``train`` takes a tally of state transitions (a sparse coordinate
+    matrix), normalizes each row by its *full* row total, keeps the
+    top-N entries per row (MarkovChain.scala:32-55).
+  - ``predict`` multiplies a current-state probability vector through
+    the kept transitions: next[j] = sum_i current[i] * P[i, j]
+    (MarkovChain.scala:72-90).
+
+TPU-first design: the ragged per-row top-N lists become fixed-shape
+padded arrays ``indices[S, N]`` / ``probs[S, N]`` (pad prob = 0, so
+padding is a no-op in the sum), and predict is one jitted
+broadcast-multiply + scatter-add instead of the reference's
+collect-and-loop over sparse vectors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _predict(indices: jax.Array, probs: jax.Array, current: jax.Array) -> jax.Array:
+    # weighted[i, n] = P(i -> indices[i, n]) * current[i]
+    weighted = probs * current[:, None]
+    out = jnp.zeros(current.shape[0], dtype=probs.dtype)
+    return out.at[indices.reshape(-1)].add(weighted.reshape(-1))
+
+
+@dataclass
+class MarkovChainModel:
+    """Padded top-N transition table; predict runs on-device."""
+
+    indices: np.ndarray   # [n_states, top_n] int32 destination states
+    probs: np.ndarray     # [n_states, top_n] float32 (0 = padding)
+    top_n: int
+
+    @property
+    def n_states(self) -> int:
+        return self.indices.shape[0]
+
+    def predict(self, current_state: Sequence[float]) -> List[float]:
+        """Next-state probabilities (ref: MarkovChainModel.predict :72)."""
+        current = jnp.asarray(np.asarray(current_state, dtype=np.float32))
+        if current.shape[0] != self.n_states:
+            raise ValueError(
+                f"current_state has {current.shape[0]} entries, "
+                f"model has {self.n_states} states"
+            )
+        out = _predict(jnp.asarray(self.indices), jnp.asarray(self.probs), current)
+        return [float(x) for x in np.asarray(out)]
+
+    def transition_row(self, state: int) -> List[Tuple[int, float]]:
+        """Kept (destination, probability) pairs of one row, by destination."""
+        pairs = [
+            (int(j), float(p))
+            for j, p in zip(self.indices[state], self.probs[state])
+            if p > 0.0
+        ]
+        return sorted(pairs)
+
+
+def train(
+    entries: Tuple[np.ndarray, np.ndarray, np.ndarray],
+    n_states: int,
+    top_n: int,
+) -> MarkovChainModel:
+    """Build the model from COO transition tallies (ref: MarkovChain.train :32).
+
+    ``entries`` is (row, col, value) arrays of the tally matrix. Each
+    row is normalized by its full total; only the ``top_n`` largest
+    entries per row are kept (reference semantics — dropped mass is
+    discarded, not renormalized).
+    """
+    rows = np.asarray(entries[0], dtype=np.int64)
+    cols = np.asarray(entries[1], dtype=np.int64)
+    vals = np.asarray(entries[2], dtype=np.float64)
+    if top_n < 1:
+        raise ValueError("top_n must be >= 1")
+
+    indices = np.zeros((n_states, top_n), dtype=np.int32)
+    probs = np.zeros((n_states, top_n), dtype=np.float32)
+
+    totals = np.zeros(n_states, dtype=np.float64)
+    np.add.at(totals, rows, vals)
+
+    order = np.argsort(rows, kind="stable")
+    rows_s, cols_s, vals_s = rows[order], cols[order], vals[order]
+    starts = np.searchsorted(rows_s, np.arange(n_states), side="left")
+    ends = np.searchsorted(rows_s, np.arange(n_states), side="right")
+    for i in range(n_states):
+        lo, hi = starts[i], ends[i]
+        if lo == hi:
+            continue
+        c, v = cols_s[lo:hi], vals_s[lo:hi]
+        keep = np.argsort(-v, kind="stable")[:top_n]
+        keep = keep[np.argsort(c[keep])]  # reference sorts kept entries by col
+        k = len(keep)
+        indices[i, :k] = c[keep]
+        probs[i, :k] = (v[keep] / totals[i]).astype(np.float32)
+
+    return MarkovChainModel(indices=indices, probs=probs, top_n=top_n)

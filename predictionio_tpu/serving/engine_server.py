@@ -101,18 +101,17 @@ class EngineServer:
         self.deployment: Deployment = self._load_latest()
 
         handler = type("Handler", (_EngineRequestHandler,), {"server_ref": self})
-        last_error = None
-        for attempt in range(bind_retries):
+        attempts = max(1, bind_retries)
+        for attempt in range(attempts):
             # bind retry x3 with 1s backoff (ref: CreateServer.scala:340-350)
             try:
                 self.httpd = ThreadingHTTPServer((host, port), handler)
                 break
             except OSError as e:
-                last_error = e
                 log.warning("bind attempt %d failed: %s", attempt + 1, e)
+                if attempt + 1 == attempts:
+                    raise
                 time.sleep(1)
-        else:
-            raise last_error
         self._thread: Optional[threading.Thread] = None
 
     # -- deployment management ----------------------------------------------

@@ -89,6 +89,9 @@ class EventServerCore:
 
     # -- event CRUD ---------------------------------------------------------
     def create_event(self, auth: AuthData, payload: dict) -> Tuple[int, dict]:
+        if not isinstance(payload, dict):
+            self.stats.update(auth.app_id, 400, "", "")
+            return 400, {"message": "event must be a JSON object"}
         try:
             event = Event.from_dict(payload)
             validate_event(event)
@@ -282,10 +285,10 @@ class _EventRequestHandler(BaseHTTPRequestHandler):
                 is_json = name.endswith(".json")
                 if is_json:
                     name = name[:-len(".json")]
+                auth = self._auth(params)
                 if method == "GET":
                     self._send(*self.core.webhook_exists(name, form=not is_json))
                     return
-                auth = self._auth(params)
                 if is_json:
                     try:
                         payload = json.loads(self._read_body() or b"{}")

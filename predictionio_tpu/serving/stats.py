@@ -46,9 +46,9 @@ class Stats:
     def report(self, app_id: int) -> dict:
         """Previous + current hour counts for one app (ref: /stats.json)."""
         with self._lock:
-            now = _hour_bucket()
+            cutoff = _hour_bucket() - _dt.timedelta(hours=1)
             out = []
-            for bucket in sorted(self._buckets):
+            for bucket in sorted(b for b in self._buckets if b >= cutoff):
                 counts = self._buckets[bucket].get(int(app_id), {})
                 if not counts:
                     continue

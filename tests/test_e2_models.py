@@ -1,0 +1,208 @@
+"""e2 algorithm library tests.
+
+Mirrors the reference suites (e2/src/test/.../engine/
+CategoricalNaiveBayesTest.scala, MarkovChainTest.scala,
+evaluation/CrossValidationTest.scala) including their numeric fixtures,
+so the JAX implementations are checked against the exact values the
+reference asserts.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.cross_validation import split_data
+from predictionio_tpu.models import markov, naive_bayes
+from predictionio_tpu.models.naive_bayes import LabeledPoint
+
+TOL = 1e-4
+
+BANANA, ORANGE, OTHER = "Banana", "Orange", "Other Fruit"
+LONG, NOT_LONG = "Long", "Not Long"
+SWEET, NOT_SWEET = "Sweet", "Not Sweet"
+YELLOW, NOT_YELLOW = "Yellow", "Not Yellow"
+
+FRUIT_POINTS = [
+    LabeledPoint(BANANA, [LONG, SWEET, YELLOW]),
+    LabeledPoint(BANANA, [LONG, SWEET, YELLOW]),
+    LabeledPoint(BANANA, [LONG, SWEET, YELLOW]),
+    LabeledPoint(BANANA, [LONG, SWEET, YELLOW]),
+    LabeledPoint(BANANA, [NOT_LONG, NOT_SWEET, NOT_YELLOW]),
+    LabeledPoint(ORANGE, [NOT_LONG, SWEET, NOT_YELLOW]),
+    LabeledPoint(ORANGE, [NOT_LONG, NOT_SWEET, NOT_YELLOW]),
+    LabeledPoint(OTHER, [LONG, SWEET, NOT_YELLOW]),
+    LabeledPoint(OTHER, [NOT_LONG, SWEET, NOT_YELLOW]),
+    LabeledPoint(OTHER, [LONG, SWEET, YELLOW]),
+    LabeledPoint(OTHER, [NOT_LONG, NOT_SWEET, NOT_YELLOW]),
+]
+
+
+@pytest.fixture(scope="module")
+def fruit_model():
+    return naive_bayes.train(FRUIT_POINTS)
+
+
+class TestCategoricalNaiveBayes:
+    # ref: CategoricalNaiveBayesTest.scala:27-69
+    def test_priors_and_likelihoods(self, fruit_model):
+        m = fruit_model
+        assert m.priors[BANANA] == pytest.approx(-0.7885, abs=TOL)
+        assert m.priors[ORANGE] == pytest.approx(-1.7047, abs=TOL)
+        assert m.priors[OTHER] == pytest.approx(-1.0116, abs=TOL)
+
+        lik = m.likelihoods
+        assert lik[BANANA][0][LONG] == pytest.approx(math.log(4 / 5), abs=TOL)
+        assert lik[BANANA][0][NOT_LONG] == pytest.approx(math.log(1 / 5), abs=TOL)
+        assert lik[BANANA][1][SWEET] == pytest.approx(math.log(4 / 5), abs=TOL)
+        assert lik[BANANA][2][YELLOW] == pytest.approx(math.log(4 / 5), abs=TOL)
+        # Orange never seen Long / Yellow (ref :48,55)
+        assert LONG not in lik[ORANGE][0]
+        assert lik[ORANGE][0][NOT_LONG] == pytest.approx(0.0, abs=TOL)
+        assert YELLOW not in lik[ORANGE][2]
+        assert lik[OTHER][0][LONG] == pytest.approx(math.log(2 / 4), abs=TOL)
+        assert lik[OTHER][1][SWEET] == pytest.approx(math.log(3 / 4), abs=TOL)
+
+    # ref: :71-82
+    def test_log_score(self, fruit_model):
+        score = fruit_model.log_score(
+            LabeledPoint(BANANA, [LONG, NOT_SWEET, NOT_YELLOW]))
+        assert score is not None
+        assert score == pytest.approx(-4.2304, abs=TOL)
+
+    # ref: :84-95
+    def test_log_score_unseen_feature_is_neg_inf(self, fruit_model):
+        score = fruit_model.log_score(
+            LabeledPoint(BANANA, [LONG, NOT_SWEET, "Not Exist"]))
+        assert score == float("-inf")
+
+    # ref: :97-106
+    def test_log_score_unknown_label_is_none(self, fruit_model):
+        score = fruit_model.log_score(
+            LabeledPoint("Not Exist", [LONG, NOT_SWEET, YELLOW]))
+        assert score is None
+
+    # ref: :109-123
+    def test_custom_default_likelihood(self, fruit_model):
+        score = fruit_model.log_score(
+            LabeledPoint(BANANA, [LONG, NOT_SWEET, "Not Exist"]),
+            default_likelihood=lambda ls: min(ls) - math.log(2),
+        )
+        assert score == pytest.approx(-4.9236, abs=TOL)
+
+    def test_baked_default_matches_callable(self):
+        # Baking the default at train time must equal scoring with the
+        # same callable at query time.
+        fn = lambda ls: (min(ls) - math.log(2)) if ls else float("-inf")
+        m = naive_bayes.train(FRUIT_POINTS, default_likelihood=fn)
+        baked = m.log_score(LabeledPoint(BANANA, [LONG, NOT_SWEET, "Not Exist"]))
+        assert baked == pytest.approx(-4.9236, abs=TOL)
+
+    # ref: :125-130
+    def test_predict(self, fruit_model):
+        assert fruit_model.predict([LONG, SWEET, YELLOW]) == BANANA
+
+    def test_predict_batch_matches_single(self, fruit_model):
+        batch = [
+            [LONG, SWEET, YELLOW],
+            [NOT_LONG, NOT_SWEET, NOT_YELLOW],
+            [NOT_LONG, SWEET, NOT_YELLOW],
+        ]
+        assert fruit_model.predict_batch(batch) == [
+            fruit_model.predict(f) for f in batch
+        ]
+
+    def test_score_batch_shape(self, fruit_model):
+        scores = fruit_model.score_batch([[LONG, SWEET, YELLOW]] * 3)
+        assert scores.shape == (3, 3)
+
+    def test_inconsistent_arity_raises(self, fruit_model):
+        with pytest.raises(ValueError):
+            fruit_model.encode_features([[LONG, SWEET]])
+        with pytest.raises(ValueError):
+            naive_bayes.train([
+                LabeledPoint("a", ["x"]),
+                LabeledPoint("b", ["x", "y"]),
+            ])
+
+
+# ref fixtures: MarkovChainFixture.scala
+TWO_BY_TWO = ([0, 0, 1, 1], [0, 1, 0, 1], [3, 7, 10, 10])
+FIVE_BY_FIVE = (
+    [0, 0, 1, 1, 1, 1, 1, 2, 2, 2, 3, 3, 3, 4, 4, 4],
+    [1, 2, 0, 1, 2, 3, 4, 1, 2, 4, 0, 3, 4, 1, 3, 4],
+    [12, 8, 3, 3, 9, 2, 8, 10, 8, 10, 2, 3, 4, 7, 8, 10],
+)
+
+
+class TestMarkovChain:
+    # ref: MarkovChainTest.scala:13-23
+    def test_train_two_by_two(self):
+        model = markov.train(TWO_BY_TWO, n_states=2, top_n=2)
+        assert model.top_n == 2
+        assert model.transition_row(0) == [
+            (0, pytest.approx(0.3)), (1, pytest.approx(0.7))]
+        assert model.transition_row(1) == [
+            (0, pytest.approx(0.5)), (1, pytest.approx(0.5))]
+
+    # ref: :25-40 — keep top-N only, normalized by FULL row total
+    def test_top_n_only(self):
+        model = markov.train(FIVE_BY_FIVE, n_states=5, top_n=2)
+        assert model.transition_row(0) == [
+            (1, pytest.approx(0.6)), (2, pytest.approx(0.4))]
+        assert model.transition_row(1) == [
+            (2, pytest.approx(9 / 25)), (4, pytest.approx(8 / 25))]
+        assert model.transition_row(2) == [
+            (1, pytest.approx(10 / 28)), (4, pytest.approx(10 / 28))]
+        assert model.transition_row(3) == [
+            (3, pytest.approx(3 / 9)), (4, pytest.approx(4 / 9))]
+        assert model.transition_row(4) == [
+            (3, pytest.approx(8 / 25)), (4, pytest.approx(0.4))]
+
+    # ref: :42-50
+    def test_predict(self):
+        model = markov.train(TWO_BY_TWO, n_states=2, top_n=2)
+        next_state = model.predict([0.4, 0.6])
+        assert next_state == [pytest.approx(0.42, abs=1e-6),
+                              pytest.approx(0.58, abs=1e-6)]
+
+    def test_empty_row(self):
+        model = markov.train(([0], [1], [5.0]), n_states=3, top_n=2)
+        assert model.transition_row(2) == []
+        out = model.predict([0.0, 0.0, 1.0])
+        assert out == [0.0, 0.0, 0.0]
+
+    def test_state_length_mismatch(self):
+        model = markov.train(TWO_BY_TWO, n_states=2, top_n=2)
+        with pytest.raises(ValueError):
+            model.predict([1.0, 0.0, 0.0])
+
+
+class TestCrossValidation:
+    # ref: CrossValidationTest.scala — idx % k == foldIdx selects test points
+    def test_fold_membership(self):
+        data = list(range(10))
+        folds = split_data(
+            3, data, "info",
+            training_data_creator=list,
+            query_creator=lambda d: ("q", d),
+            actual_creator=lambda d: ("a", d),
+        )
+        assert len(folds) == 3
+        for fold_idx, (td, ei, qa) in enumerate(folds):
+            assert ei == "info"
+            test_pts = [q[1] for q, _ in qa]
+            assert test_pts == [d for i, d in enumerate(data) if i % 3 == fold_idx]
+            assert td == [d for i, d in enumerate(data) if i % 3 != fold_idx]
+            assert sorted(td + test_pts) == data
+            assert all(a == ("a", q[1]) for q, a in qa)
+
+    def test_k_one(self):
+        folds = split_data(1, [1, 2], None, list, lambda d: d, lambda d: d)
+        assert len(folds) == 1
+        td, _, qa = folds[0]
+        assert td == [] and [q for q, _ in qa] == [1, 2]
+
+    def test_invalid_k(self):
+        with pytest.raises(ValueError):
+            split_data(0, [1], None, list, lambda d: d, lambda d: d)
