@@ -1,0 +1,218 @@
+"""Classification algorithms over numeric feature vectors.
+
+Behavior contracts:
+
+  - ``NaiveBayesAlgorithm`` mirrors the reference classification
+    template (examples/scala-parallel-classification/add-algorithm/
+    src/main/scala/NaiveBayesAlgorithm.scala:16-28), which delegates to
+    MLlib's multinomial NaiveBayes with additive smoothing ``lambda``:
+      pi(c)     = log((count_c + lambda) / (N + numLabels * lambda))
+      theta(c,j)= log((sum_{i in c} x_ij + lambda)
+                      / (sum_j sum_{i in c} x_ij + numFeatures * lambda))
+      predict(x) = argmax_c pi(c) + theta(c) . x
+    Labels are floats, as in MLlib.
+  - ``LogisticRegressionAlgorithm`` is the second-algorithm slot the
+    reference fills with MLlib RandomForest (RandomForestAlgorithm.scala
+    in the same template). Tree ensembles do not map onto the MXU, so
+    the TPU build's second algorithm is softmax regression trained with
+    optax — same engine-level contract (numeric features in, float
+    label out), compute that is all matmuls.
+
+Training is segment-sum counting / full-batch gradient steps under
+``jit``; prediction is one matmul + argmax.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.core import Algorithm, SanityCheck
+from predictionio_tpu.core.params import Params
+from predictionio_tpu.parallel.mesh import MeshContext
+
+
+@dataclass
+class LabeledVectors(SanityCheck):
+    """PD: dense feature matrix + float labels (ref: TrainingData w/
+    RDD[LabeledPoint], DataSource.scala:58)."""
+
+    features: np.ndarray   # [N, D] float32
+    labels: np.ndarray     # [N] float
+
+    def sanity_check(self) -> None:
+        if len(self.features) == 0:
+            raise ValueError("no labeled points found")
+        if len(self.features) != len(self.labels):
+            raise ValueError("features/labels length mismatch")
+
+
+# -- multinomial naive Bayes -------------------------------------------------
+
+@partial(jax.jit, static_argnames=("n_classes",))
+def _nb_counts(features: jax.Array, label_idx: jax.Array, n_classes: int):
+    one_hot = jax.nn.one_hot(label_idx, n_classes, dtype=features.dtype)  # [N, C]
+    class_counts = one_hot.sum(axis=0)                 # [C]
+    feature_sums = one_hot.T @ features                # [C, D] MXU
+    return class_counts, feature_sums
+
+
+@dataclass
+class NaiveBayesModel:
+    class_labels: np.ndarray   # [C] float — MLlib label values
+    pi: np.ndarray             # [C] log priors
+    theta: np.ndarray          # [C, D] log feature likelihoods
+
+    def _scores(self, x: np.ndarray) -> np.ndarray:
+        x = jnp.atleast_2d(jnp.asarray(x, dtype=jnp.float32))
+        return np.asarray(
+            jnp.asarray(self.pi)[None, :] + x @ jnp.asarray(self.theta).T
+        )
+
+    def predict(self, features: Sequence[float]) -> float:
+        return float(self.class_labels[int(np.argmax(self._scores(np.asarray(features))))])
+
+    def predict_batch(self, features: np.ndarray) -> np.ndarray:
+        return self.class_labels[np.argmax(self._scores(features), axis=1)]
+
+
+def train_naive_bayes(pd: LabeledVectors, lambda_: float = 1.0) -> NaiveBayesModel:
+    class_labels, label_idx = np.unique(pd.labels, return_inverse=True)
+    n_classes = len(class_labels)
+    class_counts, feature_sums = _nb_counts(
+        jnp.asarray(pd.features, dtype=jnp.float32),
+        jnp.asarray(label_idx),
+        n_classes,
+    )
+    class_counts = np.asarray(class_counts, dtype=np.float64)
+    feature_sums = np.asarray(feature_sums, dtype=np.float64)
+    n, d = len(pd.labels), pd.features.shape[1]
+    pi = np.log(class_counts + lambda_) - np.log(n + n_classes * lambda_)
+    theta = np.log(feature_sums + lambda_) - np.log(
+        feature_sums.sum(axis=1, keepdims=True) + d * lambda_
+    )
+    return NaiveBayesModel(
+        class_labels=class_labels,
+        pi=pi.astype(np.float32),
+        theta=theta.astype(np.float32),
+    )
+
+
+@dataclass
+class NaiveBayesParams(Params):
+    lambda_: float = 1.0
+
+
+class NaiveBayesAlgorithm(Algorithm):
+    """ref: NaiveBayesAlgorithm.scala:16."""
+
+    def __init__(self, params: NaiveBayesParams):
+        super().__init__(params)
+
+    def train(self, ctx: MeshContext, pd: LabeledVectors) -> NaiveBayesModel:
+        return train_naive_bayes(pd, self.params.lambda_)
+
+    def predict(self, model: NaiveBayesModel, query: Dict[str, Any]) -> Dict[str, Any]:
+        return {"label": model.predict([float(v) for v in query["features"]])}
+
+    def batch_predict(self, model, queries):
+        feats = np.array([q["features"] for _, q in queries], dtype=np.float32)
+        labels = model.predict_batch(feats)
+        return [(i, {"label": float(l)}) for (i, _q), l in zip(queries, labels)]
+
+
+# -- softmax regression (optax) ----------------------------------------------
+
+@dataclass
+class LogisticRegressionModel:
+    class_labels: np.ndarray   # [C] float
+    weights: np.ndarray        # [D, C]
+    bias: np.ndarray           # [C]
+    feature_mean: np.ndarray   # [D] standardization applied at train time
+    feature_std: np.ndarray    # [D]
+
+    def _scores(self, x: np.ndarray) -> np.ndarray:
+        x = np.atleast_2d(np.asarray(x, dtype=np.float32))
+        x = (x - self.feature_mean) / self.feature_std
+        return x @ self.weights + self.bias
+
+    def predict(self, features: Sequence[float]) -> float:
+        return float(self.class_labels[int(np.argmax(self._scores(np.asarray(features))))])
+
+    def predict_batch(self, features: np.ndarray) -> np.ndarray:
+        return self.class_labels[np.argmax(self._scores(features), axis=1)]
+
+
+@dataclass
+class LogisticRegressionParams(Params):
+    learning_rate: float = 0.1
+    iterations: int = 200
+    l2: float = 1e-4
+    seed: int = 0
+
+
+def train_logistic_regression(
+    pd: LabeledVectors, p: LogisticRegressionParams
+) -> LogisticRegressionModel:
+    import optax
+
+    class_labels, label_idx = np.unique(pd.labels, return_inverse=True)
+    n_classes = len(class_labels)
+    d = pd.features.shape[1]
+    mean = pd.features.mean(axis=0)
+    std = np.maximum(pd.features.std(axis=0), 1e-8)
+    x = jnp.asarray((pd.features - mean) / std, dtype=jnp.float32)
+    y = jnp.asarray(label_idx)
+
+    tx = optax.adam(p.learning_rate)
+    params = {
+        "w": jnp.zeros((d, n_classes), dtype=jnp.float32),
+        "b": jnp.zeros((n_classes,), dtype=jnp.float32),
+    }
+
+    def loss_fn(params):
+        logits = x @ params["w"] + params["b"]
+        nll = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        return nll + p.l2 * (params["w"] ** 2).sum()
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    opt_state = tx.init(params)
+    for _ in range(p.iterations):
+        params, opt_state, _loss = step(params, opt_state)
+
+    return LogisticRegressionModel(
+        class_labels=class_labels,
+        weights=np.asarray(params["w"]),
+        bias=np.asarray(params["b"]),
+        feature_mean=mean.astype(np.float32),
+        feature_std=std.astype(np.float32),
+    )
+
+
+class LogisticRegressionAlgorithm(Algorithm):
+    """Second algorithm slot (ref: RandomForestAlgorithm.scala — see
+    module docstring for the substitution rationale)."""
+
+    def __init__(self, params: LogisticRegressionParams):
+        super().__init__(params)
+
+    def train(self, ctx: MeshContext, pd: LabeledVectors) -> LogisticRegressionModel:
+        return train_logistic_regression(pd, self.params)
+
+    def predict(self, model, query: Dict[str, Any]) -> Dict[str, Any]:
+        return {"label": model.predict([float(v) for v in query["features"]])}
+
+    def batch_predict(self, model, queries):
+        feats = np.array([q["features"] for _, q in queries], dtype=np.float32)
+        labels = model.predict_batch(feats)
+        return [(i, {"label": float(l)}) for (i, _q), l in zip(queries, labels)]

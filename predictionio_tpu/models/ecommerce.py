@@ -1,0 +1,291 @@
+"""E-commerce recommendation: explicit ALS + serve-time business-rule filters.
+
+Behavior contract from the reference template
+(examples/scala-parallel-ecommercerecommendation/train-with-rate-event/
+src/main/scala/ALSAlgorithm.scala):
+
+  - ``train`` (:63-146): index users/items, dedupe (user, item) rate
+    events keeping the LATEST rating, explicit ALS, model keeps BOTH
+    user and item ("product") factors plus item metadata.
+  - ``predict`` (:148-277): build a final blacklist from the query's
+    blackList + the user's "seen" events (live event-store lookup when
+    ``unseen_only``) + the latest ``$set`` of the special
+    ``constraint/unavailableItems`` entity; known users score
+    user_vec . item_vec; users unseen at train time fall back to summed
+    cosine similarity against their recently viewed items' factors
+    (predictNewUser :286-363); apply category/whiteList candidate
+    predicates; keep score > 0; top-``num``.
+
+TPU-first design: factors stay device-resident; both the known-user
+path (dot products) and the new-user path (sum-of-cosines, which
+factorizes to one matvec over normalized factors) are a single masked
+matmul + top_k (ops.topk.score_masked); candidate predicates become
+vectorized bool masks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from predictionio_tpu.core import Algorithm, SanityCheck
+from predictionio_tpu.core.params import Params
+from predictionio_tpu.data import store
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.storage import StorageError
+from predictionio_tpu.ops.als import ALSConfig, als_train
+from predictionio_tpu.ops.topk import TopKScorer, cosine_normalize
+from predictionio_tpu.parallel.mesh import MeshContext
+
+
+@dataclass
+class ECommTrainingData(SanityCheck):
+    users: List[str] = field(default_factory=list)
+    items: List[str] = field(default_factory=list)
+    item_categories: Dict[str, List[str]] = field(default_factory=dict)
+    # (user, item, rating) — events in time order
+    rate_events: List[Tuple[str, str, float]] = field(default_factory=list)
+
+    def sanity_check(self) -> None:
+        if not self.rate_events:
+            raise ValueError("rateEvents cannot be empty")
+        if not self.users:
+            raise ValueError("users cannot be empty")
+        if not self.items:
+            raise ValueError("items cannot be empty")
+
+
+@dataclass
+class ECommAlgorithmParams(Params):
+    app_name: str = ""
+    unseen_only: bool = False
+    seen_events: List[str] = field(default_factory=lambda: ["buy", "view"])
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    seed: int = 3
+    block_size: int = 4096
+
+
+class ECommModel:
+    """User + item factors, id maps, item metadata (ref: ALSModel :29)."""
+
+    def __init__(
+        self,
+        user_factors: np.ndarray,
+        item_factors: np.ndarray,
+        user_ids: BiMap,
+        item_ids: BiMap,
+        item_categories: Dict[str, List[str]],
+        rated_users: Optional[np.ndarray] = None,
+        rated_items: Optional[np.ndarray] = None,
+    ):
+        self.user_factors = np.asarray(user_factors, dtype=np.float32)
+        self.item_factors = np.asarray(item_factors, dtype=np.float32)
+        self.user_ids = user_ids
+        self.item_ids = item_ids
+        self.item_categories = item_categories
+        # MLlib's factor maps only cover entities present in the ratings
+        # (userFeatures.get -> None drives the new-user path, :225-231;
+        # productFeatures feature.isDefined gates candidates, :235).
+        # Dense factor matrices cover every indexed id, so track which
+        # rows were actually trained.
+        self.rated_users = (
+            rated_users if rated_users is not None
+            else np.ones(len(user_ids), dtype=bool)
+        )
+        self.rated_items = (
+            rated_items if rated_items is not None
+            else np.ones(len(item_ids), dtype=bool)
+        )
+        self._scorer: Optional[TopKScorer] = None
+        self._cos_scorer: Optional[TopKScorer] = None
+        self._normalized: Optional[np.ndarray] = None
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["_scorer"] = d["_cos_scorer"] = d["_normalized"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+
+    def scorer(self) -> TopKScorer:
+        if self._scorer is None:
+            self._scorer = TopKScorer(self.item_factors)
+        return self._scorer
+
+    def cos_scorer(self) -> TopKScorer:
+        if self._cos_scorer is None:
+            self._normalized = cosine_normalize(self.item_factors)
+            self._cos_scorer = TopKScorer(self._normalized)
+        return self._cos_scorer
+
+    def candidate_mask(
+        self,
+        categories: Optional[Set[str]],
+        white_list: Optional[Set[str]],
+        black_list: Set[str],
+    ) -> np.ndarray:
+        """Vectorized isCandidateItem + feature.isDefined (ref: :380-398, :235)."""
+        n = len(self.item_ids)
+        mask = self.rated_items.copy()
+        if white_list is not None:
+            wl = np.zeros(n, dtype=bool)
+            wl[[self.item_ids[i] for i in white_list if i in self.item_ids]] = True
+            mask &= wl
+        if black_list:
+            mask[[self.item_ids[i] for i in black_list if i in self.item_ids]] = False
+        if categories:
+            cat_mask = np.zeros(n, dtype=bool)
+            for item, cats in self.item_categories.items():
+                row = self.item_ids.get(item)
+                if row is not None and set(cats) & categories:
+                    cat_mask[row] = True
+            mask &= cat_mask  # items without categories are discarded
+        return mask
+
+
+class ECommAlgorithm(Algorithm):
+    """ref: ALSAlgorithm (train-with-rate-event variant)."""
+
+    def __init__(self, params: ECommAlgorithmParams):
+        super().__init__(params)
+
+    def train(self, ctx: MeshContext, pd: ECommTrainingData) -> ECommModel:
+        p: ECommAlgorithmParams = self.params
+        user_ids = BiMap.string_int(pd.users)
+        item_ids = BiMap.string_int(pd.items)
+        # dedupe (user, item), keeping the latest rating (ref: :96-107)
+        latest: Dict[Tuple[int, int], float] = {}
+        for user, item, rating in pd.rate_events:
+            u, i = user_ids.get(user), item_ids.get(item)
+            if u is None or i is None:
+                continue  # ref logs and drops nonexistent ids
+            latest[(u, i)] = float(rating)
+        if not latest:
+            raise ValueError(
+                "ratings cannot be empty — check that events contain valid "
+                "user and item IDs"
+            )
+        keys = np.array(list(latest.keys()), dtype=np.int64)
+        vals = np.array(list(latest.values()), dtype=np.float32)
+        cfg = ALSConfig(
+            rank=p.rank,
+            iterations=p.num_iterations,
+            reg=p.lambda_,
+            implicit=False,
+            block_size=p.block_size,
+            seed=p.seed,
+        )
+        factors = als_train(
+            (keys[:, 0], keys[:, 1], vals),
+            len(user_ids),
+            len(item_ids),
+            cfg,
+            mesh=ctx.mesh,
+        )
+        rated_users = np.zeros(len(user_ids), dtype=bool)
+        rated_items = np.zeros(len(item_ids), dtype=bool)
+        rated_users[keys[:, 0]] = True
+        rated_items[keys[:, 1]] = True
+        return ECommModel(
+            np.asarray(factors.user_factors),
+            np.asarray(factors.item_factors),
+            user_ids,
+            item_ids,
+            pd.item_categories,
+            rated_users=rated_users,
+            rated_items=rated_items,
+        )
+
+    # -- serve-time event lookups (ref: lEventsDb.findSingleEntity calls) -----
+    def _seen_items(self, user: str) -> Set[str]:
+        p: ECommAlgorithmParams = self.params
+        if not p.unseen_only:
+            return set()
+        try:
+            events = store.find_by_entity(
+                p.app_name, "user", user,
+                event_names=list(p.seen_events),
+                target_entity_type="item",
+            )
+        except StorageError:
+            return set()
+        return {e.target_entity_id for e in events if e.target_entity_id}
+
+    def _unavailable_items(self) -> Set[str]:
+        """Latest constraint/unavailableItems $set (ref: :195-215)."""
+        p: ECommAlgorithmParams = self.params
+        try:
+            events = store.find_by_entity(
+                p.app_name, "constraint", "unavailableItems",
+                event_names=["$set"], limit=1, latest=True,
+            )
+        except StorageError:
+            return set()
+        if not events:
+            return set()
+        items = events[0].properties.get_opt("items")
+        return set(items) if items else set()
+
+    def _recent_items(self, user: str) -> List[str]:
+        """Latest 10 viewed items (ref: predictNewUser :293-322)."""
+        p: ECommAlgorithmParams = self.params
+        try:
+            events = store.find_by_entity(
+                p.app_name, "user", user,
+                event_names=["view"],
+                target_entity_type="item",
+                limit=10, latest=True,
+            )
+        except StorageError:
+            return []
+        return [e.target_entity_id for e in events if e.target_entity_id]
+
+    def predict(self, model: ECommModel, query: Dict[str, Any]) -> Dict[str, Any]:
+        p: ECommAlgorithmParams = self.params
+        user = str(query["user"])
+        num = int(query.get("num", 10))
+        categories = set(query["categories"]) if query.get("categories") else None
+        white_list = set(query["whiteList"]) if query.get("whiteList") else None
+        black_list = set(query.get("blackList") or ())
+
+        final_black = black_list | self._seen_items(user) | self._unavailable_items()
+        mask = model.candidate_mask(categories, white_list, final_black)
+
+        row = model.user_ids.get(user)
+        if row is not None and not model.rated_users[row]:
+            row = None  # indexed but never rated -> new-user path (ref: :225)
+        if row is not None:
+            if not mask.any():
+                return {"itemScores": []}
+            scores, idx = model.scorer().score_masked(
+                model.user_factors[row], num, mask
+            )
+        else:
+            # new user: summed cosine vs recently viewed items (ref: :286)
+            recent_rows = [
+                model.item_ids[i]
+                for i in self._recent_items(user)
+                if i in model.item_ids
+            ]
+            if not recent_rows or not mask.any():
+                return {"itemScores": []}
+            model.cos_scorer()  # ensures _normalized
+            qvec = model._normalized[recent_rows].sum(axis=0)
+            scores, idx = model.cos_scorer().score_masked(qvec, num, mask)
+
+        inv = model.item_ids.inverse()
+        return {
+            "itemScores": [
+                {"item": inv[int(i)], "score": float(s)}
+                for s, i in zip(scores[0], idx[0])
+                if s > 0.0  # ref keeps score > 0 only (:252)
+            ]
+        }
+
+    def batch_predict(self, model, queries):
+        return [(i, self.predict(model, q)) for i, q in queries]

@@ -84,26 +84,40 @@ def train(
     vals = np.asarray(entries[2], dtype=np.float64)
     if top_n < 1:
         raise ValueError("top_n must be >= 1")
+    if len(rows) and (rows.min() < 0 or rows.max() >= n_states
+                      or cols.min() < 0 or cols.max() >= n_states):
+        raise ValueError("COO entries reference states outside [0, n_states)")
 
     indices = np.zeros((n_states, top_n), dtype=np.int32)
     probs = np.zeros((n_states, top_n), dtype=np.float32)
+    if not len(rows):
+        return MarkovChainModel(indices=indices, probs=probs, top_n=top_n)
+
+    # combine duplicate (row, col) tallies (streaming callers emit one
+    # entry per observed transition)
+    flat = rows * n_states + cols
+    uniq, inverse = np.unique(flat, return_inverse=True)
+    summed = np.zeros(len(uniq), dtype=np.float64)
+    np.add.at(summed, inverse, vals)
+    rows_u, cols_u = uniq // n_states, uniq % n_states
 
     totals = np.zeros(n_states, dtype=np.float64)
-    np.add.at(totals, rows, vals)
+    np.add.at(totals, rows_u, summed)
 
-    order = np.argsort(rows, kind="stable")
-    rows_s, cols_s, vals_s = rows[order], cols[order], vals[order]
-    starts = np.searchsorted(rows_s, np.arange(n_states), side="left")
-    ends = np.searchsorted(rows_s, np.arange(n_states), side="right")
-    for i in range(n_states):
-        lo, hi = starts[i], ends[i]
-        if lo == hi:
-            continue
-        c, v = cols_s[lo:hi], vals_s[lo:hi]
-        keep = np.argsort(-v, kind="stable")[:top_n]
-        keep = keep[np.argsort(c[keep])]  # reference sorts kept entries by col
-        k = len(keep)
-        indices[i, :k] = c[keep]
-        probs[i, :k] = (v[keep] / totals[i]).astype(np.float32)
+    # vectorized per-row top-N: sort by (row asc, value desc), keep the
+    # first top_n of each row, then re-sort kept entries by (row, col)
+    # (reference stores kept entries column-sorted, MarkovChain.scala:45)
+    order = np.lexsort((-summed, rows_u))
+    rows_s, cols_s, vals_s = rows_u[order], cols_u[order], summed[order]
+    row_starts = np.searchsorted(rows_s, rows_s)       # start offset of own row
+    rank = np.arange(len(rows_s)) - row_starts
+    keep = rank < top_n
+    rows_k, cols_k, vals_k = rows_s[keep], cols_s[keep], vals_s[keep]
+
+    order2 = np.lexsort((cols_k, rows_k))
+    rows_k, cols_k, vals_k = rows_k[order2], cols_k[order2], vals_k[order2]
+    slot = np.arange(len(rows_k)) - np.searchsorted(rows_k, rows_k)
+    indices[rows_k, slot] = cols_k
+    probs[rows_k, slot] = (vals_k / totals[rows_k]).astype(np.float32)
 
     return MarkovChainModel(indices=indices, probs=probs, top_n=top_n)

@@ -1,0 +1,240 @@
+"""Similar-product algorithms: item-to-item similarity over ALS factors.
+
+Behavior contract from the reference similarproduct template
+(examples/scala-parallel-similarproduct/multi/src/main/scala/
+ALSAlgorithm.scala + LikeAlgorithm.scala):
+
+  - ``ALSAlgorithm.train`` indexes users/items, aggregates duplicate
+    (user, item) view events into counts, trains *implicit* ALS, keeps
+    the item ("product") factors + item metadata (:74-144).
+  - ``LikeAlgorithm.train`` does the same over like/dislike events with
+    rating +1 / -1 (LikeAlgorithm.scala:27-99).
+  - ``predict``: look up the query items' factor vectors, score every
+    item by the SUM of cosine similarities to the query vectors, drop
+    the query items themselves, apply whiteList/blackList/categories
+    candidate predicates, return top-``num`` (:146-207, 239-263).
+
+TPU-first design: sum-of-cosines factorizes — with row-normalized
+factors F, sum_q cos(f_q, f_i) = (sum_q F[q]) . F[i] — so the whole
+scoring pass is one query-vector sum plus one masked [1,K]x[K,I] matmul
++ top_k on device (ops.topk.score_masked); the candidate predicate is a
+vectorized host-side bool mask, not a per-item filter loop.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from predictionio_tpu.core import Algorithm, SanityCheck
+from predictionio_tpu.core.params import Params
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.ops.als import ALSConfig, als_train
+from predictionio_tpu.ops.topk import NEG_INF, TopKScorer, cosine_normalize
+from predictionio_tpu.parallel.mesh import MeshContext
+
+
+@dataclass
+class SimilarProductData(SanityCheck):
+    """TD/PD: users, items (with optional categories), and interactions."""
+
+    users: List[str] = field(default_factory=list)
+    items: List[str] = field(default_factory=list)
+    item_categories: Dict[str, List[str]] = field(default_factory=dict)
+    # (user, item) view pairs
+    view_events: List[Tuple[str, str]] = field(default_factory=list)
+    # (user, item, like?) pairs
+    like_events: List[Tuple[str, str, bool]] = field(default_factory=list)
+
+    def sanity_check(self) -> None:
+        if not self.users:
+            raise ValueError("users cannot be empty")
+        if not self.items:
+            raise ValueError("items cannot be empty")
+        if not self.view_events and not self.like_events:
+            raise ValueError("no view/like events found")
+
+
+@dataclass
+class SimilarProductParams(Params):
+    rank: int = 10
+    num_iterations: int = 20
+    lambda_: float = 0.01
+    seed: int = 3
+    block_size: int = 4096
+
+
+class SimilarProductModel:
+    """Row-normalized item factors resident on device + item metadata."""
+
+    def __init__(
+        self,
+        item_factors: np.ndarray,      # [I, K] raw ALS factors
+        item_ids: BiMap,
+        item_categories: Dict[str, List[str]],
+    ):
+        self.item_factors = np.asarray(item_factors, dtype=np.float32)
+        self.item_ids = item_ids
+        self.item_categories = item_categories
+        self._normalized = cosine_normalize(self.item_factors)
+        self._scorer: Optional[TopKScorer] = None
+        self._category_index: Optional[Dict[str, np.ndarray]] = None
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["_scorer"] = None
+        d["_category_index"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+
+    def scorer(self) -> TopKScorer:
+        if self._scorer is None:
+            self._scorer = TopKScorer(self._normalized)
+        return self._scorer
+
+    def _category_mask(self, categories: Set[str]) -> np.ndarray:
+        """[I] bool — items sharing >=1 category with the query.
+
+        Items without categories are discarded when a category filter is
+        given (ref: isCandidateItem .getOrElse(false))."""
+        if self._category_index is None:
+            idx: Dict[str, np.ndarray] = {}
+            per_cat: Dict[str, List[int]] = {}
+            for item, cats in self.item_categories.items():
+                row = self.item_ids.get(item)
+                if row is None:
+                    continue
+                for c in cats:
+                    per_cat.setdefault(c, []).append(row)
+            n = len(self.item_ids)
+            for c, rows in per_cat.items():
+                m = np.zeros(n, dtype=bool)
+                m[rows] = True
+                idx[c] = m
+            self._category_index = idx
+        mask = np.zeros(len(self.item_ids), dtype=bool)
+        for c in categories:
+            m = self._category_index.get(c)
+            if m is not None:
+                mask |= m
+        return mask
+
+    def similar(
+        self,
+        items: Sequence[str],
+        num: int,
+        categories: Optional[Set[str]] = None,
+        white_list: Optional[Set[str]] = None,
+        black_list: Optional[Set[str]] = None,
+    ) -> List[Tuple[str, float]]:
+        """Top-num items by summed cosine similarity to ``items``."""
+        query_rows = [self.item_ids[i] for i in items if i in self.item_ids]
+        if not query_rows:
+            return []
+        qvec = self._normalized[query_rows].sum(axis=0)
+
+        n = len(self.item_ids)
+        mask = np.ones(n, dtype=bool)
+        mask[query_rows] = False                     # discard query items
+        if white_list is not None:
+            wl = np.zeros(n, dtype=bool)
+            wl[[self.item_ids[i] for i in white_list if i in self.item_ids]] = True
+            mask &= wl
+        if black_list:
+            mask[[self.item_ids[i] for i in black_list if i in self.item_ids]] = False
+        if categories:
+            mask &= self._category_mask(set(categories))
+        if not mask.any():
+            return []
+
+        scores, idx = self.scorer().score_masked(qvec, num, mask)
+        inv = self.item_ids.inverse()
+        return [
+            (inv[int(i)], float(s))
+            for s, i in zip(scores[0], idx[0])
+            if s > 0.0  # ref keeps score > 0 only (:174)
+        ]
+
+
+def _train_als_item_factors(
+    pairs: List[Tuple[int, int, float]],
+    n_users: int,
+    n_items: int,
+    p: SimilarProductParams,
+    ctx: MeshContext,
+) -> np.ndarray:
+    u, i, r = (
+        np.array([x[0] for x in pairs], dtype=np.int64),
+        np.array([x[1] for x in pairs], dtype=np.int64),
+        np.array([x[2] for x in pairs], dtype=np.float32),
+    )
+    cfg = ALSConfig(
+        rank=p.rank,
+        iterations=p.num_iterations,
+        reg=p.lambda_,
+        implicit=True,
+        alpha=1.0,
+        block_size=p.block_size,
+        seed=p.seed,
+    )
+    factors = als_train((u, i, r), n_users, n_items, cfg, mesh=ctx.mesh)
+    return np.asarray(factors.item_factors)
+
+
+class SimilarProductAlgorithm(Algorithm):
+    """Implicit ALS over view counts (ref: ALSAlgorithm.scala:69)."""
+
+    def __init__(self, params: SimilarProductParams):
+        super().__init__(params)
+
+    def _interactions(self, pd: SimilarProductData) -> Dict[Tuple[str, str], float]:
+        counts: Dict[Tuple[str, str], float] = {}
+        for user, item in pd.view_events:
+            counts[(user, item)] = counts.get((user, item), 0.0) + 1.0
+        return counts
+
+    def train(self, ctx: MeshContext, pd: SimilarProductData) -> SimilarProductModel:
+        user_ids = BiMap.string_int(pd.users)
+        item_ids = BiMap.string_int(pd.items)
+        pairs = [
+            (user_ids[u], item_ids[i], r)
+            for (u, i), r in self._interactions(pd).items()
+            if u in user_ids and i in item_ids
+        ]
+        if not pairs:
+            raise ValueError(
+                "ratings cannot be empty — check that events contain valid "
+                "user and item IDs"
+            )
+        item_factors = _train_als_item_factors(
+            pairs, len(user_ids), len(item_ids), self.params, ctx
+        )
+        return SimilarProductModel(item_factors, item_ids, pd.item_categories)
+
+    def predict(self, model: SimilarProductModel, query: Dict[str, Any]) -> Dict[str, Any]:
+        recs = model.similar(
+            [str(i) for i in query["items"]],
+            int(query.get("num", 10)),
+            categories=set(query["categories"]) if query.get("categories") else None,
+            white_list=set(query["whiteList"]) if query.get("whiteList") else None,
+            black_list=set(query["blackList"]) if query.get("blackList") else None,
+        )
+        return {"itemScores": [{"item": i, "score": s} for i, s in recs]}
+
+    def batch_predict(self, model, queries):
+        return [(i, self.predict(model, q)) for i, q in queries]
+
+
+class LikeAlgorithm(SimilarProductAlgorithm):
+    """Same ALS over like/dislike = +1/-1 (ref: LikeAlgorithm.scala:27);
+    duplicate (user, item) pairs keep the LATEST event's polarity."""
+
+    def _interactions(self, pd: SimilarProductData) -> Dict[Tuple[str, str], float]:
+        latest: Dict[Tuple[str, str], float] = {}
+        for user, item, like in pd.like_events:  # events arrive time-ordered
+            latest[(user, item)] = 1.0 if like else -1.0
+        return latest

@@ -42,6 +42,22 @@ def _topk_scores(
     return jax.lax.top_k(masked, k)
 
 
+@functools.partial(jax.jit, static_argnames=("k",))
+def _topk_scores_masked(
+    user_vecs: jax.Array,      # [B, K]
+    item_factors: jax.Array,   # [I, K]
+    mask: jax.Array,           # [B, I] or [I] bool, True = candidate
+    k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k over arbitrary candidate masks (business-rule filters —
+    category/whitelist predicates — computed host-side as one bool
+    vector instead of per-item Python checks, ref: isCandidateItem in
+    examples/scala-parallel-similarproduct/multi/.../ALSAlgorithm.scala:239)."""
+    scores = user_vecs @ item_factors.T                      # [B, I] MXU
+    masked = jnp.where(mask, scores, NEG_INF)
+    return jax.lax.top_k(masked, k)
+
+
 def _pow2_bucket(n: int, lo: int, hi: int) -> int:
     b = lo
     while b < min(n, hi):
@@ -91,6 +107,26 @@ class TopKScorer:
         k_bucket = min(_pow2_bucket(k, 8, 1 << 20), n_items)
         scores, idx = _topk_scores(
             user_vecs, self.item_factors, jnp.asarray(exclude_idx), k_bucket
+        )
+        return np.asarray(scores)[:, :k], np.asarray(idx)[:, :k]
+
+    def score_masked(
+        self,
+        user_vecs: np.ndarray,
+        k: int,
+        mask: np.ndarray,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """(scores, item_indices) over candidates where ``mask`` is True.
+
+        ``mask`` is [I] or [B, I] bool. Masked-out entries that still
+        make the top-k (fewer candidates than k) come back with score
+        <= NEG_INF — callers drop them by score threshold.
+        """
+        user_vecs = jnp.atleast_2d(jnp.asarray(user_vecs, dtype=jnp.float32))
+        n_items = self.item_factors.shape[0]
+        k_bucket = min(_pow2_bucket(min(k, n_items), 8, 1 << 20), n_items)
+        scores, idx = _topk_scores_masked(
+            user_vecs, self.item_factors, jnp.asarray(mask, dtype=bool), k_bucket
         )
         return np.asarray(scores)[:, :k], np.asarray(idx)[:, :k]
 

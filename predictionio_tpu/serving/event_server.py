@@ -289,6 +289,9 @@ class _EventRequestHandler(BaseHTTPRequestHandler):
                 if method == "GET":
                     self._send(*self.core.webhook_exists(name, form=not is_json))
                     return
+                if method != "POST":
+                    self._send(405, {"message": "method not allowed"})
+                    return
                 if is_json:
                     try:
                         payload = json.loads(self._read_body() or b"{}")

@@ -1,0 +1,97 @@
+"""E-commerce recommendation engine template.
+
+Behavior contract from the reference
+(examples/scala-parallel-ecommercerecommendation/train-with-rate-event/
+src/main/scala/DataSource.scala + Engine.scala): the DataSource
+aggregates "user" and "item" entities (items carry an optional
+``categories`` property) and reads user-rate-item events with a
+``rating`` property; the engine wires one "als" ECommAlgorithm behind a
+first-serving combiner. Serve-time business rules (seen items,
+unavailable-items constraint, new-user fallback) live in the algorithm
+(predictionio_tpu.models.ecommerce).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from predictionio_tpu.core import DataSource, Engine, FirstServing, IdentityPreparator
+from predictionio_tpu.core.params import EngineParams, Params
+from predictionio_tpu.data import store
+from predictionio_tpu.models.ecommerce import (
+    ECommAlgorithm,
+    ECommAlgorithmParams,
+    ECommTrainingData,
+)
+from predictionio_tpu.parallel.mesh import MeshContext
+
+
+@dataclass
+class ECommDSParams(Params):
+    app_name: str = ""
+    channel_name: Optional[str] = None
+    rate_event: str = "rate"
+
+
+class ECommDataSource(DataSource):
+    """ref: DataSource.scala:22 readTraining (rate-event variant)."""
+
+    def __init__(self, params: ECommDSParams):
+        super().__init__(params)
+
+    def read_training(self, ctx: MeshContext) -> ECommTrainingData:
+        p: ECommDSParams = self.params
+        users = sorted(
+            store.aggregate_properties(p.app_name, "user", channel_name=p.channel_name)
+        )
+        item_props = store.aggregate_properties(
+            p.app_name, "item", channel_name=p.channel_name
+        )
+        item_categories = {
+            item: props.get_opt("categories")
+            for item, props in item_props.items()
+            if props.get_opt("categories") is not None
+        }
+        rate_events = store.find(
+            p.app_name,
+            channel_name=p.channel_name,
+            entity_type="user",
+            event_names=[p.rate_event],
+            target_entity_type="item",
+        )
+        return ECommTrainingData(
+            users=users,
+            items=sorted(item_props),
+            item_categories=item_categories,
+            rate_events=[
+                (e.entity_id, e.target_entity_id,
+                 float(e.properties.get("rating", 0.0)))
+                for e in rate_events
+            ],
+        )
+
+
+def ecommerce_engine() -> Engine:
+    """ref: ECommerceRecommendationEngine factory (Engine.scala:23)."""
+    return Engine(
+        data_source_classes=ECommDataSource,
+        preparator_classes=IdentityPreparator,
+        algorithm_classes={"als": ECommAlgorithm},
+        serving_classes=FirstServing,
+    )
+
+
+def default_engine_params(
+    app_name: str,
+    channel_name: Optional[str] = None,
+    algo_params: Optional[ECommAlgorithmParams] = None,
+) -> EngineParams:
+    algo = algo_params or ECommAlgorithmParams(app_name=app_name)
+    if not algo.app_name:
+        algo.app_name = app_name
+    return EngineParams(
+        data_source_params=("", ECommDSParams(
+            app_name=app_name, channel_name=channel_name)),
+        algorithm_params_list=[("als", algo)],
+    )

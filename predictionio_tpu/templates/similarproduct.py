@@ -1,0 +1,144 @@
+"""Similar-product engine template.
+
+Behavior contract from the reference template
+(examples/scala-parallel-similarproduct/multi/src/main/scala/):
+
+  - DataSource (DataSource.scala:25-128): aggregate "user" entities,
+    "item" entities (optional ``categories`` property), read
+    user-view-item events and user-like/dislike-item events.
+  - Engine (Engine.scala:25-34): TWO algorithms — "als" over views and
+    "likealgo" over likes — combined by a custom Serving.
+  - Serving (Serving.scala:12-54): z-score standardize each algorithm's
+    scores (skip when num == 1; stddev 0 -> score 0), sum scores of the
+    same item across algorithms, return top-num.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from predictionio_tpu.core import DataSource, Engine, IdentityPreparator, Serving
+from predictionio_tpu.core.params import Params
+from predictionio_tpu.data import store
+from predictionio_tpu.models.similarproduct import (
+    LikeAlgorithm,
+    SimilarProductAlgorithm,
+    SimilarProductData,
+    SimilarProductParams,
+)
+from predictionio_tpu.parallel.mesh import MeshContext
+
+
+@dataclass
+class SimilarProductDSParams(Params):
+    app_name: str = ""
+    channel_name: Optional[str] = None
+
+
+class SimilarProductDataSource(DataSource):
+    """ref: DataSource.scala:25 readTraining."""
+
+    def __init__(self, params: SimilarProductDSParams):
+        super().__init__(params)
+
+    def read_training(self, ctx: MeshContext) -> SimilarProductData:
+        p: SimilarProductDSParams = self.params
+        users = sorted(
+            store.aggregate_properties(p.app_name, "user", channel_name=p.channel_name)
+        )
+        item_props = store.aggregate_properties(
+            p.app_name, "item", channel_name=p.channel_name
+        )
+        item_categories = {
+            item: props.get_opt("categories")
+            for item, props in item_props.items()
+            if props.get_opt("categories") is not None
+        }
+        views = store.find(
+            p.app_name,
+            channel_name=p.channel_name,
+            entity_type="user",
+            event_names=["view"],
+            target_entity_type="item",
+        )
+        likes = store.find(
+            p.app_name,
+            channel_name=p.channel_name,
+            entity_type="user",
+            event_names=["like", "dislike"],
+            target_entity_type="item",
+        )
+        return SimilarProductData(
+            users=users,
+            items=sorted(item_props),
+            item_categories=item_categories,
+            view_events=[(e.entity_id, e.target_entity_id) for e in views],
+            like_events=[
+                (e.entity_id, e.target_entity_id, e.event == "like") for e in likes
+            ],
+        )
+
+
+class StandardizingServing(Serving):
+    """z-score standardize per algorithm, sum per item (ref: Serving.scala:12)."""
+
+    def serve(self, query: Dict[str, Any], predictions: Sequence[Dict[str, Any]]):
+        num = int(query.get("num", 10))
+        score_lists = [p.get("itemScores", []) for p in predictions]
+        if num == 1:
+            standardized = score_lists
+        else:
+            standardized = []
+            for scores in score_lists:
+                vals = np.array([s["score"] for s in scores], dtype=np.float64)
+                if len(vals) == 0:
+                    standardized.append([])
+                    continue
+                std = vals.std(ddof=1) if len(vals) > 1 else 0.0
+                standardized.append([
+                    {
+                        "item": s["item"],
+                        "score": 0.0 if std == 0 else (s["score"] - vals.mean()) / std,
+                    }
+                    for s in scores
+                ])
+        combined: Dict[str, float] = {}
+        for scores in standardized:
+            for s in scores:
+                combined[s["item"]] = combined.get(s["item"], 0.0) + s["score"]
+        top = sorted(combined.items(), key=lambda kv: -kv[1])[:num]
+        return {"itemScores": [{"item": i, "score": v} for i, v in top]}
+
+
+def similar_product_engine() -> Engine:
+    """ref: SimilarProductEngine factory (Engine.scala:25-34)."""
+    return Engine(
+        data_source_classes=SimilarProductDataSource,
+        preparator_classes=IdentityPreparator,
+        algorithm_classes={
+            "als": SimilarProductAlgorithm,
+            "likealgo": LikeAlgorithm,
+        },
+        serving_classes=StandardizingServing,
+    )
+
+
+def default_engine_params(
+    app_name: str,
+    channel_name: Optional[str] = None,
+    als_params: Optional[SimilarProductParams] = None,
+    like_params: Optional[SimilarProductParams] = None,
+) -> "EngineParams":
+    from predictionio_tpu.core.params import EngineParams
+
+    return EngineParams(
+        data_source_params=("", SimilarProductDSParams(
+            app_name=app_name, channel_name=channel_name)),
+        algorithm_params_list=[
+            ("als", als_params or SimilarProductParams()),
+            ("likealgo", like_params or SimilarProductParams()),
+        ],
+    )

@@ -177,6 +177,26 @@ class TestMarkovChain:
         with pytest.raises(ValueError):
             model.predict([1.0, 0.0, 0.0])
 
+    def test_duplicate_entries_are_combined(self):
+        # streaming form: one entry per observed transition
+        model = markov.train(([0, 0, 0], [1, 1, 2], [3.0, 4.0, 5.0]),
+                             n_states=3, top_n=1)
+        assert model.transition_row(0) == [(1, pytest.approx(7 / 12))]
+        model2 = markov.train(([0, 0, 0], [1, 1, 2], [3.0, 4.0, 5.0]),
+                              n_states=3, top_n=2)
+        assert model2.transition_row(0) == [
+            (1, pytest.approx(7 / 12)), (2, pytest.approx(5 / 12))]
+
+    def test_out_of_range_states_rejected(self):
+        with pytest.raises(ValueError):
+            markov.train(([0], [5], [1.0]), n_states=2, top_n=1)
+        with pytest.raises(ValueError):
+            markov.train(([-1], [0], [1.0]), n_states=2, top_n=1)
+
+    def test_no_entries(self):
+        model = markov.train(([], [], []), n_states=2, top_n=2)
+        assert model.predict([1.0, 0.0]) == [0.0, 0.0]
+
 
 class TestCrossValidation:
     # ref: CrossValidationTest.scala — idx % k == foldIdx selects test points

@@ -175,6 +175,9 @@ def test_webhooks(event_server):
     assert http("GET", f"{base}/segmentio.json?{auth}")[0] == 200
     assert http("GET", f"{base}/nope.json?{auth}")[0] == 404
     assert http("GET", f"{base}/mailchimp?{auth}")[0] == 200
+    # auth required even for GET; non-GET/POST methods rejected
+    assert http("GET", f"{base}/segmentio.json")[0] == 401
+    assert http("DELETE", f"{base}/segmentio.json?{auth}", {"type": "identify"})[0] == 405
     # segmentio identify (ref: SegmentIOConnector)
     status, body = http("POST", f"{base}/segmentio.json?{auth}", {
         "type": "identify", "userId": "u42",

@@ -1,0 +1,302 @@
+"""Engine template tests: similarproduct, ecommerce, classification, vanilla.
+
+Each template trains end-to-end against the in-memory event store and
+asserts the serve-time behaviors the reference templates implement
+(candidate filters, serve-time event lookups, multi-algorithm
+combining; see module docstrings for file:line contracts).
+"""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.templates import classification as cls_t
+from predictionio_tpu.templates import ecommerce as ecom_t
+from predictionio_tpu.templates import similarproduct as simprod_t
+from predictionio_tpu.templates import vanilla as vanilla_t
+
+UTC = dt.timezone.utc
+ctx = MeshContext()
+
+
+def _t(minute):
+    return dt.datetime(2026, 1, 1, 0, minute, tzinfo=UTC)
+
+
+def setup_app(storage, name):
+    app = storage.apps().insert(name)
+    storage.events().init(app.id)
+    return app
+
+
+def put(storage, app_id, event, etype, eid, tetype=None, teid=None, props=None, minute=0):
+    storage.events().insert(
+        Event(
+            event=event,
+            entity_type=etype,
+            entity_id=eid,
+            target_entity_type=tetype,
+            target_entity_id=teid,
+            properties=props or {},
+            event_time=_t(minute),
+        ),
+        app_id,
+    )
+
+
+@pytest.fixture()
+def simprod_app(memory_storage):
+    app = setup_app(memory_storage, "simprod")
+    users = ["u1", "u2", "u3", "u4"]
+    for u in users:
+        put(memory_storage, app.id, "$set", "user", u)
+    cats = {"i1": ["a"], "i2": ["a", "b"], "i3": ["b"], "i4": ["c"]}
+    for i, cs in cats.items():
+        put(memory_storage, app.id, "$set", "item", i, props={"categories": cs})
+    # u1,u2 view i1+i2 (similar); u3 views i3; u4 views everything
+    views = [
+        ("u1", "i1"), ("u1", "i2"), ("u2", "i1"), ("u2", "i2"),
+        ("u3", "i3"), ("u4", "i1"), ("u4", "i2"), ("u4", "i3"), ("u4", "i4"),
+    ]
+    for m, (u, i) in enumerate(views):
+        put(memory_storage, app.id, "view", "user", u, "item", i, minute=m)
+    likes = [
+        ("u1", "i1", "like"), ("u1", "i2", "like"), ("u2", "i1", "like"),
+        ("u2", "i2", "like"), ("u3", "i4", "dislike"), ("u4", "i3", "like"),
+    ]
+    for m, (u, i, e) in enumerate(likes):
+        put(memory_storage, app.id, e, "user", u, "item", i, minute=30 + m)
+    return app
+
+
+class TestSimilarProduct:
+    def test_datasource_reads(self, memory_storage, simprod_app):
+        ds = simprod_t.SimilarProductDataSource(
+            simprod_t.SimilarProductDSParams(app_name="simprod"))
+        td = ds.read_training(ctx)
+        assert td.users == ["u1", "u2", "u3", "u4"]
+        assert td.items == ["i1", "i2", "i3", "i4"]
+        assert td.item_categories["i2"] == ["a", "b"]
+        assert len(td.view_events) == 9
+        assert ("u3", "i4", False) in td.like_events
+
+    def test_train_and_similar(self, memory_storage, simprod_app):
+        engine = simprod_t.similar_product_engine()
+        ep = simprod_t.default_engine_params(
+            "simprod",
+            als_params=simprod_t.SimilarProductParams(rank=4, num_iterations=10),
+            like_params=simprod_t.SimilarProductParams(rank=4, num_iterations=10),
+        )
+        result = engine.train(ctx, ep)
+        assert len(result.models) == 2
+        als_model = result.models[0]
+        # i1 and i2 are co-viewed -> i2 should top the similar list for i1
+        recs = als_model.similar(["i1"], num=3)
+        assert recs, "expected nonempty similar items"
+        assert recs[0][0] == "i2"
+        # query item itself is never returned
+        assert all(item != "i1" for item, _ in recs)
+
+    def test_filters(self, memory_storage, simprod_app):
+        engine = simprod_t.similar_product_engine()
+        ep = simprod_t.default_engine_params(
+            "simprod",
+            als_params=simprod_t.SimilarProductParams(rank=4, num_iterations=10),
+        )
+        model = engine.train(ctx, ep).models[0]
+        # category filter: only items in category "b" (i2, i3) qualify
+        recs = model.similar(["i1"], num=4, categories={"b"})
+        assert recs and all(item in {"i2", "i3"} for item, _ in recs)
+        # whitelist
+        recs = model.similar(["i1"], num=4, white_list={"i3"})
+        assert all(item == "i3" for item, _ in recs)
+        # blacklist
+        recs = model.similar(["i1"], num=4, black_list={"i2"})
+        assert all(item != "i2" for item, _ in recs)
+        # unknown query items -> empty
+        assert model.similar(["zzz"], num=4) == []
+
+    def test_standardizing_serving(self):
+        serving = simprod_t.StandardizingServing.create()
+        preds = [
+            {"itemScores": [{"item": "a", "score": 10.0},
+                            {"item": "b", "score": 20.0},
+                            {"item": "c", "score": 30.0}]},
+            {"itemScores": [{"item": "b", "score": 1.0},
+                            {"item": "c", "score": 2.0},
+                            {"item": "d", "score": 3.0}]},
+        ]
+        out = serving.serve({"num": 2}, preds)
+        items = [s["item"] for s in out["itemScores"]]
+        # z-scores: list1 -> a=-1,b=0,c=1; list2 -> b=-1,c=0,d=1
+        # summed: c=1, d=1, b=-1, a=-1 -> top2 = c, d
+        assert items == ["c", "d"]
+        assert out["itemScores"][0]["score"] == pytest.approx(1.0, abs=1e-6)
+        assert out["itemScores"][1]["score"] == pytest.approx(1.0, abs=1e-6)
+        # num == 1 skips standardization (raw scores summed)
+        out1 = serving.serve({"num": 1}, preds)
+        assert [s["item"] for s in out1["itemScores"]] == ["c"]
+        assert out1["itemScores"][0]["score"] == pytest.approx(32.0)
+        # stddev 0 -> score 0
+        same = [{"itemScores": [{"item": "a", "score": 5.0},
+                                {"item": "b", "score": 5.0}]}]
+        out_same = serving.serve({"num": 2}, same)
+        assert all(s["score"] == 0.0 for s in out_same["itemScores"])
+
+
+@pytest.fixture()
+def ecom_app(memory_storage):
+    app = setup_app(memory_storage, "ecom")
+    for u in ["u1", "u2", "u3"]:
+        put(memory_storage, app.id, "$set", "user", u)
+    cats = {"i1": ["a"], "i2": ["a"], "i3": ["b"], "i4": ["b"]}
+    for i, cs in cats.items():
+        put(memory_storage, app.id, "$set", "item", i, props={"categories": cs})
+    rates = [
+        ("u1", "i1", 5.0, 0), ("u1", "i2", 4.0, 1),
+        ("u2", "i1", 4.0, 2), ("u2", "i2", 5.0, 3), ("u2", "i3", 1.0, 4),
+        ("u3", "i3", 5.0, 5), ("u3", "i4", 4.0, 6),
+        # u1 re-rates i1 later: latest value wins
+        ("u1", "i1", 1.0, 7),
+    ]
+    for u, i, r, m in rates:
+        put(memory_storage, app.id, "rate", "user", u, "item", i,
+            props={"rating": r}, minute=m)
+    return app
+
+
+def _ecom_model(memory_storage, **algo_kw):
+    engine = ecom_t.ecommerce_engine()
+    ep = ecom_t.default_engine_params(
+        "ecom",
+        algo_params=ecom_t.ECommAlgorithmParams(
+            app_name="ecom", rank=4, num_iterations=10, **algo_kw),
+    )
+    result = engine.train(ctx, ep)
+    algo = engine.make_algorithms(ep)[0]
+    return algo, result.models[0]
+
+
+class TestECommerce:
+    def test_datasource_and_latest_rating_dedupe(self, memory_storage, ecom_app):
+        ds = ecom_t.ECommDataSource(ecom_t.ECommDSParams(app_name="ecom"))
+        td = ds.read_training(ctx)
+        assert len(td.rate_events) == 8
+        algo, model = _ecom_model(memory_storage)
+        assert model.user_factors.shape == (3, 4)
+        assert model.item_factors.shape == (4, 4)
+
+    def test_predict_known_user(self, memory_storage, ecom_app):
+        algo, model = _ecom_model(memory_storage)
+        out = algo.predict(model, {"user": "u2", "num": 2})
+        assert out["itemScores"]
+        items = [s["item"] for s in out["itemScores"]]
+        assert len(items) <= 2
+
+    def test_category_and_blacklist(self, memory_storage, ecom_app):
+        algo, model = _ecom_model(memory_storage)
+        out = algo.predict(
+            model, {"user": "u1", "num": 4, "categories": ["b"]})
+        assert all(s["item"] in {"i3", "i4"} for s in out["itemScores"])
+        out = algo.predict(
+            model, {"user": "u1", "num": 4, "blackList": ["i1", "i2", "i3", "i4"]})
+        assert out["itemScores"] == []
+
+    def test_unseen_only_filters_seen_items(self, memory_storage, ecom_app):
+        # u1 "buys" i2 -> with unseen_only, i2 must not be recommended
+        put(memory_storage, ecom_app.id, "buy", "user", "u1", "item", "i2", minute=40)
+        algo, model = _ecom_model(memory_storage, unseen_only=True,
+                                  seen_events=["buy"])
+        out = algo.predict(model, {"user": "u1", "num": 4})
+        assert all(s["item"] != "i2" for s in out["itemScores"])
+
+    def test_unavailable_items_constraint(self, memory_storage, ecom_app):
+        put(memory_storage, ecom_app.id, "$set", "constraint", "unavailableItems",
+            props={"items": ["i1", "i2", "i3", "i4"]}, minute=41)
+        algo, model = _ecom_model(memory_storage)
+        assert algo.predict(model, {"user": "u2", "num": 4})["itemScores"] == []
+
+    def test_new_user_falls_back_to_recent_views(self, memory_storage, ecom_app):
+        # u9 was not in training but has viewed i1
+        put(memory_storage, ecom_app.id, "$set", "user", "u9")
+        put(memory_storage, ecom_app.id, "view", "user", "u9", "item", "i1", minute=42)
+        algo, model = _ecom_model(memory_storage)
+        out = algo.predict(model, {"user": "u9", "num": 3})
+        assert out["itemScores"], "new user with recent views should get recs"
+        assert all(s["item"] != "i1" or s["score"] > 0 for s in out["itemScores"])
+        # new user with no history -> empty
+        out = algo.predict(model, {"user": "u10", "num": 3})
+        assert out["itemScores"] == []
+
+
+@pytest.fixture()
+def cls_app(memory_storage):
+    app = setup_app(memory_storage, "cls")
+    rng = np.random.default_rng(0)
+    # two separable classes in count-feature space
+    for n in range(30):
+        label = float(n % 2)
+        base = np.array([8.0, 1.0, 1.0]) if label == 0 else np.array([1.0, 1.0, 8.0])
+        attrs = np.maximum(base + rng.integers(-1, 2, size=3), 0.0)
+        put(memory_storage, app.id, "$set", "user", f"u{n}",
+            props={"plan": label, "attr0": float(attrs[0]),
+                   "attr1": float(attrs[1]), "attr2": float(attrs[2])})
+    # an entity missing required properties is skipped (ref: required=...)
+    put(memory_storage, app.id, "$set", "user", "incomplete", props={"plan": 1.0})
+    return app
+
+
+class TestClassification:
+    def test_datasource_requires_all_properties(self, memory_storage, cls_app):
+        ds = cls_t.ClassificationDataSource(
+            cls_t.ClassificationDSParams(app_name="cls"))
+        td = ds.read_training(ctx)
+        assert td.features.shape == (30, 3)
+
+    def test_naive_bayes_end_to_end(self, memory_storage, cls_app):
+        engine = cls_t.classification_engine()
+        ep = cls_t.default_engine_params("cls")
+        model = engine.train(ctx, ep).models[0]
+        assert model.predict([8.0, 1.0, 1.0]) == 0.0
+        assert model.predict([1.0, 1.0, 8.0]) == 1.0
+
+    def test_logistic_end_to_end(self, memory_storage, cls_app):
+        from predictionio_tpu.core.params import EngineParams
+        from predictionio_tpu.models.classification import LogisticRegressionParams
+
+        engine = cls_t.classification_engine()
+        ep = EngineParams(
+            data_source_params=("", cls_t.ClassificationDSParams(app_name="cls")),
+            algorithm_params_list=[
+                ("logistic", LogisticRegressionParams(iterations=120)),
+            ],
+        )
+        model = engine.train(ctx, ep).models[0]
+        assert model.predict([8.0, 1.0, 1.0]) == 0.0
+        assert model.predict([1.0, 1.0, 8.0]) == 1.0
+
+    def test_eval_folds(self, memory_storage, cls_app):
+        engine = cls_t.classification_engine()
+        ep = cls_t.default_engine_params("cls", eval_k=3)
+        results = engine.eval(ctx, ep)
+        assert len(results) == 3
+        # NB on separable data should get most test points right
+        correct = total = 0
+        for _ei, qpa in results:
+            for _q, pred, actual in qpa:
+                total += 1
+                correct += pred["label"] == actual["label"]
+        assert total == 30  # the incomplete entity contributes no point
+        assert correct / total >= 0.8
+
+
+class TestVanilla:
+    def test_end_to_end(self, memory_storage):
+        engine = vanilla_t.vanilla_engine()
+        ep = vanilla_t.default_engine_params(mult=3)
+        result = engine.train(ctx, ep)
+        algo = engine.make_algorithms(ep)[0]
+        assert algo.predict(result.models[0], {"q": 2.0}) == {"p": 6.0}
