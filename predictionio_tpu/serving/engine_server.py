@@ -29,13 +29,13 @@ import threading
 import time
 import urllib.request
 import uuid
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 from urllib.parse import urlparse
 
 from predictionio_tpu.core.engine import Engine
 from predictionio_tpu.data.storage import Storage, get_storage
 from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.serving.http import HTTPServerBase, JSONRequestHandler
 from predictionio_tpu.workflow.deploy import Deployment, prepare_deploy
 
 log = logging.getLogger(__name__)
@@ -71,7 +71,7 @@ class ServingStats:
             }
 
 
-class EngineServer:
+class EngineServer(HTTPServerBase):
     """One deployed engine behind HTTP (ref: CreateServer.scala:100,106)."""
 
     def __init__(
@@ -100,19 +100,8 @@ class EngineServer:
         self._deployment_lock = threading.Lock()
         self.deployment: Deployment = self._load_latest()
 
-        handler = type("Handler", (_EngineRequestHandler,), {"server_ref": self})
-        attempts = max(1, bind_retries)
-        for attempt in range(attempts):
-            # bind retry x3 with 1s backoff (ref: CreateServer.scala:340-350)
-            try:
-                self.httpd = ThreadingHTTPServer((host, port), handler)
-                break
-            except OSError as e:
-                log.warning("bind attempt %d failed: %s", attempt + 1, e)
-                if attempt + 1 == attempts:
-                    raise
-                time.sleep(1)
-        self._thread: Optional[threading.Thread] = None
+        # bind retry x3 with 1s backoff (ref: CreateServer.scala:340-350)
+        super().__init__(host, port, _EngineRequestHandler, bind_retries=bind_retries)
 
     # -- deployment management ----------------------------------------------
     def _load_latest(self) -> Deployment:
@@ -190,44 +179,9 @@ class EngineServer:
             "stats": self.stats.snapshot(),
         }
 
-    # -- lifecycle ----------------------------------------------------------
-    @property
-    def port(self) -> int:
-        return self.httpd.server_address[1]
 
-    def start(self) -> "EngineServer":
-        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
-        self._thread.start()
-        log.info("engine server for %s listening on %s", self.engine_id, self.port)
-        return self
-
-    def serve_forever(self) -> None:
-        self.httpd.serve_forever()
-
-    def stop(self) -> None:
-        # shutdown must complete before the socket closes, and may not run
-        # on the serve thread — do both in order on a helper thread
-        def _shutdown():
-            self.httpd.shutdown()
-            self.httpd.server_close()
-
-        threading.Thread(target=_shutdown, daemon=True).start()
-
-
-class _EngineRequestHandler(BaseHTTPRequestHandler):
+class _EngineRequestHandler(JSONRequestHandler):
     server_version = "PIOEngineServer/0.1"
-    server_ref: EngineServer = None
-
-    def log_message(self, fmt, *args):
-        log.debug("engine-server: " + fmt, *args)
-
-    def _send(self, status: int, body: Any, content_type="application/json; charset=UTF-8"):
-        data = json.dumps(body).encode() if not isinstance(body, bytes) else body
-        self.send_response(status)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
 
     def do_GET(self):
         path = urlparse(self.path).path
@@ -245,9 +199,8 @@ class _EngineRequestHandler(BaseHTTPRequestHandler):
     def do_POST(self):
         path = urlparse(self.path).path
         if path == "/queries.json":
-            length = int(self.headers.get("Content-Length", 0))
             try:
-                payload = json.loads(self.rfile.read(length) or b"{}")
+                payload = self._read_json()
             except json.JSONDecodeError as e:
                 self._send(400, {"message": f"invalid JSON: {e}"})
                 return

@@ -30,14 +30,13 @@ from __future__ import annotations
 import datetime as _dt
 import json
 import logging
-import threading
 from dataclasses import dataclass
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from predictionio_tpu.data.event import Event, EventValidationError, validate_event, _parse_time
 from predictionio_tpu.data.storage import UNSET, Storage, StorageError, get_storage
+from predictionio_tpu.serving.http import HTTPServerBase, JSONRequestHandler
 from predictionio_tpu.serving.stats import Stats
 from predictionio_tpu.serving import webhooks as webhook_registry
 from predictionio_tpu.serving.webhooks import ConnectorError
@@ -207,21 +206,12 @@ def _parse_iso(s: Optional[str]) -> Optional[_dt.datetime]:
         raise ValueError(f"Invalid time string: {s}")
 
 
-class _EventRequestHandler(BaseHTTPRequestHandler):
+class _EventRequestHandler(JSONRequestHandler):
     server_version = "PIOEventServer/0.1"
-    core: EventServerCore = None  # set by EventServer
 
-    # -- plumbing -----------------------------------------------------------
-    def log_message(self, fmt, *args):
-        log.debug("event-server: " + fmt, *args)
-
-    def _send(self, status: int, body: Any) -> None:
-        data = json.dumps(body).encode()
-        self.send_response(status)
-        self.send_header("Content-Type", "application/json; charset=UTF-8")
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
+    @property
+    def core(self) -> EventServerCore:
+        return self.server_ref.core
 
     def _auth(self, params) -> AuthData:
         access_key = (params.get("accessKey") or [None])[0]
@@ -240,10 +230,6 @@ class _EventRequestHandler(BaseHTTPRequestHandler):
         channel = (params.get("channel") or [None])[0]
         return self.core.authenticate(access_key, channel)
 
-    def _read_body(self) -> bytes:
-        length = int(self.headers.get("Content-Length", 0))
-        return self.rfile.read(length) if length else b""
-
     def _dispatch(self, method: str) -> None:
         url = urlparse(self.path)
         path = url.path
@@ -260,7 +246,7 @@ class _EventRequestHandler(BaseHTTPRequestHandler):
                 auth = self._auth(params)
                 if method == "POST":
                     try:
-                        payload = json.loads(self._read_body() or b"{}")
+                        payload = self._read_json()
                     except json.JSONDecodeError as e:
                         self._send(400, {"message": f"invalid JSON: {e}"})
                         return
@@ -294,7 +280,7 @@ class _EventRequestHandler(BaseHTTPRequestHandler):
                     return
                 if is_json:
                     try:
-                        payload = json.loads(self._read_body() or b"{}")
+                        payload = self._read_json()
                     except json.JSONDecodeError as e:
                         self._send(400, {"message": f"invalid JSON: {e}"})
                         return
@@ -325,7 +311,7 @@ class _EventRequestHandler(BaseHTTPRequestHandler):
         self._dispatch("DELETE")
 
 
-class EventServer:
+class EventServer(HTTPServerBase):
     """ref: EventServer.createEventServer (EventAPI.scala:497)."""
 
     def __init__(
@@ -336,26 +322,7 @@ class EventServer:
         stats: Optional[Stats] = None,
     ):
         self.core = EventServerCore(storage, stats)
-        handler = type("Handler", (_EventRequestHandler,), {"core": self.core})
-        self.httpd = ThreadingHTTPServer((host, port), handler)
-        self._thread: Optional[threading.Thread] = None
-
-    @property
-    def port(self) -> int:
-        return self.httpd.server_address[1]
-
-    def start(self) -> "EventServer":
-        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
-        self._thread.start()
-        log.info("event server listening on %s", self.port)
-        return self
-
-    def serve_forever(self) -> None:
-        self.httpd.serve_forever()
-
-    def stop(self) -> None:
-        self.httpd.shutdown()
-        self.httpd.server_close()
+        super().__init__(host, port, _EventRequestHandler)
 
 
 def main(argv=None) -> None:

@@ -1,0 +1,102 @@
+"""Shared HTTP plumbing for the framework's servers.
+
+One copy of the JSON response writer, body reader, bind-retry loop and
+thread lifecycle used by the event server, engine server, dashboard and
+admin API (the reference gets this from spray; each server here is a
+stdlib ThreadingHTTPServer).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+log = logging.getLogger(__name__)
+
+
+class JSONRequestHandler(BaseHTTPRequestHandler):
+    """Base handler: JSON responses, body parsing, quiet logging."""
+
+    server_version = "PIOServer/0.1"
+    server_ref: Any = None  # set via subclass attribute by each server
+
+    def log_message(self, fmt, *args):
+        log.debug("%s: " + fmt, self.server_version, *args)
+
+    def _send(self, status: int, body: Any,
+              content_type: str = "application/json; charset=UTF-8",
+              extra_headers: Optional[dict] = None) -> None:
+        if isinstance(body, bytes):
+            data = body
+        elif isinstance(body, str):
+            data = body.encode()
+        else:
+            data = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        for name, value in (extra_headers or {}).items():
+            self.send_header(name, value)
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(length) if length else b""
+
+    def _read_json(self) -> Any:
+        """Parsed JSON body; raises json.JSONDecodeError."""
+        return json.loads(self._read_body() or b"{}")
+
+
+class HTTPServerBase:
+    """Bind (with retry), run on a daemon thread, stop cleanly.
+
+    Bind-retry contract from the reference engine server
+    (CreateServer.scala:340-350): ``bind_retries`` attempts, 1s apart.
+    """
+
+    def __init__(self, host: str, port: int, handler_cls: type,
+                 bind_retries: int = 1):
+        handler = type("Handler", (handler_cls,), {"server_ref": self})
+        attempts = max(1, bind_retries)
+        for attempt in range(attempts):
+            try:
+                self.httpd = ThreadingHTTPServer((host, port), handler)
+                break
+            except OSError as e:
+                log.warning("bind attempt %d failed: %s", attempt + 1, e)
+                if attempt + 1 == attempts:
+                    raise
+                time.sleep(1)
+        self._thread: Optional[threading.Thread] = None
+        self._serving = False
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(target=self.serve_forever, daemon=True)
+        self._thread.start()
+        log.info("%s listening on %s", type(self).__name__, self.port)
+        return self
+
+    def serve_forever(self) -> None:
+        self._serving = True
+        self.httpd.serve_forever()
+
+    def stop(self) -> None:
+        """Stop serving and close the socket; the port is free on return.
+
+        Safe from handler threads (they are daemons, so server_close
+        does not join them) and from threads that never started serving.
+        """
+        if self._serving:
+            self.httpd.shutdown()
+            self._serving = False
+        self.httpd.server_close()

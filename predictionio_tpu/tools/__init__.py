@@ -1,0 +1,11 @@
+"""CLI & ops tools (ref: tools/src/main/scala/io/prediction/tools/).
+
+  commands    — shared command client: app/accesskey/channel management,
+                status (ref: console/App.scala, AccessKey.scala,
+                admin/CommandClient.scala)
+  eventdata   — event import/export (ref: imprt/FileToEvents.scala,
+                export/EventsToFile.scala)
+  dashboard   — eval-results dashboard server (ref: dashboard/Dashboard.scala)
+  admin       — experimental admin REST API (ref: admin/AdminAPI.scala)
+  cli         — the `pio`-equivalent console (ref: console/Console.scala)
+"""

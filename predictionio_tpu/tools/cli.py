@@ -1,0 +1,443 @@
+"""The `pio`-equivalent console.
+
+Behavior contract from the reference CLI (tools/.../console/
+Console.scala:128-735 command surface; bin/pio:17-42 wrapper):
+
+  app new|list|show|delete|data-delete|channel-new|channel-delete
+  accesskey new|list|delete
+  build                 (register the engine manifest; no compile step —
+                         engines are Python, ref: RegisterEngine.scala:50)
+  train                 (ref: Console.scala:807 -> CreateWorkflow; here
+                         in-process — no spark-submit JVM hop)
+  eval                  (ref: evaluation branch, CreateWorkflow.scala:263)
+  deploy / undeploy     (ref: Console.scala:830 -> CreateServer)
+  eventserver / adminserver / dashboard
+  import / export       (ref: imprt/FileToEvents, export/EventsToFile)
+  template list|get     (egress-free: scaffolds the built-in templates
+                         instead of downloading from the gallery,
+                         ref: console/Template.scala:198-415)
+  status                (ref: Storage.verifyAllDataObjects)
+
+Run as ``python -m predictionio_tpu.tools.cli <command> ...``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import logging
+import sys
+from typing import List, Optional
+
+from predictionio_tpu.data.storage import get_storage
+from predictionio_tpu.tools import commands, eventdata
+from predictionio_tpu.tools.commands import CommandError
+
+log = logging.getLogger(__name__)
+
+BUILTIN_TEMPLATES = {
+    "recommendation": "predictionio_tpu.templates.recommendation",
+    "similarproduct": "predictionio_tpu.templates.similarproduct",
+    "ecommercerecommendation": "predictionio_tpu.templates.ecommerce",
+    "classification": "predictionio_tpu.templates.classification",
+    "vanilla": "predictionio_tpu.templates.vanilla",
+}
+
+TEMPLATE_FACTORIES = {
+    "recommendation": "recommendation_engine",
+    "similarproduct": "similar_product_engine",
+    "ecommercerecommendation": "ecommerce_engine",
+    "classification": "classification_engine",
+    "vanilla": "vanilla_engine",
+}
+
+
+def _p(*args, **kwargs):
+    print(*args, **kwargs)
+
+
+# -- app / accesskey -----------------------------------------------------------
+
+def cmd_app(args) -> int:
+    st = get_storage()
+    if args.app_command == "new":
+        info = commands.app_new(args.name, args.description, st)
+        _p("Created new app:")
+        _p(f"      Name: {info.app.name}")
+        _p(f"        ID: {info.app.id}")
+        _p(f"Access Key: {info.access_keys[0].key}")
+    elif args.app_command == "list":
+        infos = commands.app_list(st)
+        _p(f"{'Name':>20} | {'ID':>4} | {'Access Key':>64} | Allowed Event(s)")
+        for info in infos:
+            for k in info.access_keys:
+                events = ",".join(sorted(k.events)) if k.events else "(all)"
+                _p(f"{info.app.name:>20} | {info.app.id:>4} | {k.key:>64} | {events}")
+        _p(f"Finished listing {len(infos)} app(s).")
+    elif args.app_command == "show":
+        info = commands.app_show(args.name, st)
+        _p(f"    App Name: {info.app.name}")
+        _p(f"      App ID: {info.app.id}")
+        _p(f" Description: {info.app.description or ''}")
+        for k in info.access_keys:
+            events = ",".join(sorted(k.events)) if k.events else "(all)"
+            _p(f"  Access Key: {k.key} | {events}")
+        for c in info.channels:
+            _p(f"     Channel: {c.name} (id {c.id})")
+    elif args.app_command == "delete":
+        commands.app_delete(args.name, st)
+        _p(f"App deleted: {args.name}")
+    elif args.app_command == "data-delete":
+        commands.app_data_delete(args.name, args.channel, st)
+        _p(f"App data deleted: {args.name}")
+    elif args.app_command == "channel-new":
+        ch = commands.channel_new(args.name, args.channel, st)
+        _p(f"Channel created: {ch.name} (id {ch.id})")
+    elif args.app_command == "channel-delete":
+        commands.channel_delete(args.name, args.channel, st)
+        _p(f"Channel deleted: {args.channel}")
+    return 0
+
+
+def cmd_accesskey(args) -> int:
+    st = get_storage()
+    if args.ak_command == "new":
+        key = commands.accesskey_new(args.app, args.event, st)
+        _p(f"Created new access key: {key.key}")
+    elif args.ak_command == "list":
+        for k in commands.accesskey_list(args.app, st):
+            events = ",".join(sorted(k.events)) if k.events else "(all)"
+            _p(f"{k.key} | app {k.appid} | {events}")
+    elif args.ak_command == "delete":
+        commands.accesskey_delete(args.key, st)
+        _p(f"Deleted access key: {args.key}")
+    return 0
+
+
+# -- build / train / eval / deploy --------------------------------------------
+
+def _load_variant(path: str):
+    from predictionio_tpu.workflow.variant import EngineVariant
+
+    return EngineVariant.load(path)
+
+
+def cmd_build(args) -> int:
+    """Register the engine manifest (no compile step for Python engines)."""
+    from predictionio_tpu.data.metadata import EngineManifest
+
+    variant = _load_variant(args.engine_json)
+    engine_id = args.engine_id or variant.raw.get("engineId") or variant.engine_factory
+    st = get_storage()
+    manifest = EngineManifest(
+        id=engine_id,
+        version=args.engine_version,
+        name=variant.id,
+        description=variant.description,
+        files=[args.engine_json],
+        engine_factory=variant.engine_factory,
+    )
+    existing = st.engine_manifests().get(engine_id, args.engine_version)
+    if existing is None:
+        st.engine_manifests().insert(manifest)
+    else:
+        st.engine_manifests().update(manifest)
+    _p(f"Registered engine {engine_id} {args.engine_version} "
+       f"({variant.engine_factory})")
+    return 0
+
+
+def cmd_train(args) -> int:
+    from predictionio_tpu.workflow.config import WorkflowParams
+    from predictionio_tpu.workflow.train import run_train
+
+    variant = _load_variant(args.engine_json)
+    engine = variant.create_engine()
+    engine_params = variant.engine_params(engine)
+    engine_id = args.engine_id or variant.raw.get("engineId") or variant.engine_factory
+    wp = WorkflowParams(
+        batch=args.batch,
+        skip_sanity_check=args.skip_sanity_check,
+        stop_after_read=args.stop_after_read,
+        stop_after_prepare=args.stop_after_prepare,
+    )
+    instance = run_train(
+        engine,
+        engine_params,
+        engine_id=engine_id,
+        engine_version=args.engine_version,
+        engine_variant=variant.id,
+        engine_factory=variant.engine_factory,
+        batch=args.batch,
+        workflow_params=wp,
+    )
+    _p(f"Training completed: engine instance {instance.id} ({instance.status})")
+    return 0 if instance.status == "COMPLETED" else 1
+
+
+def cmd_eval(args) -> int:
+    from predictionio_tpu.core.evaluation import Evaluation, EngineParamsGenerator
+    from predictionio_tpu.workflow.evaluate import run_evaluation
+
+    def resolve(dotted: str):
+        module_name, _, attr = dotted.rpartition(".")
+        if not module_name:
+            raise CommandError(f"{dotted!r} must be a dotted module.Attr path")
+        try:
+            obj = getattr(importlib.import_module(module_name), attr)
+        except (ImportError, AttributeError) as e:
+            raise CommandError(f"cannot resolve {dotted!r}: {e}") from e
+        return obj() if isinstance(obj, type) else obj
+
+    evaluation = resolve(args.evaluation_class)
+    if not isinstance(evaluation, Evaluation):
+        raise CommandError(f"{args.evaluation_class} is not an Evaluation")
+    generator = None
+    if args.engine_params_generator_class:
+        generator = resolve(args.engine_params_generator_class)
+        if not isinstance(generator, EngineParamsGenerator):
+            raise CommandError(
+                f"{args.engine_params_generator_class} is not an EngineParamsGenerator"
+            )
+    result = run_evaluation(
+        evaluation,
+        generator=generator,
+        evaluation_class=args.evaluation_class,
+        generator_class=args.engine_params_generator_class or "",
+        batch=args.batch,
+    )
+    _p(result.to_one_liner())
+    return 0
+
+
+def cmd_deploy(args) -> int:
+    from predictionio_tpu.serving.engine_server import EngineServer
+
+    variant = _load_variant(args.engine_json)
+    engine = variant.create_engine()
+    engine_id = args.engine_id or variant.raw.get("engineId") or variant.engine_factory
+    server = EngineServer(
+        engine,
+        engine_id=engine_id,
+        engine_version=args.engine_version,
+        engine_variant=variant.id,
+        host=args.ip,
+        port=args.port,
+        feedback_url=args.feedback_url,
+        feedback_access_key=args.accesskey,
+    )
+    _p(f"Engine {engine_id} deployed on {args.ip}:{server.port}")
+    server.serve_forever()
+    return 0
+
+
+def cmd_undeploy(args) -> int:
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://{args.ip}:{args.port}/stop", method="POST", data=b""
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        _p(resp.read().decode())
+    return 0
+
+
+# -- servers -------------------------------------------------------------------
+
+def cmd_eventserver(args) -> int:
+    from predictionio_tpu.serving.event_server import EventServer
+
+    server = EventServer(host=args.ip, port=args.port)
+    _p(f"Event server running on {args.ip}:{server.port}")
+    server.serve_forever()
+    return 0
+
+
+def cmd_adminserver(args) -> int:
+    from predictionio_tpu.tools.admin import AdminServer
+
+    server = AdminServer(host=args.ip, port=args.port)
+    _p(f"Admin server running on {args.ip}:{server.port}")
+    server.serve_forever()
+    return 0
+
+
+def cmd_dashboard(args) -> int:
+    from predictionio_tpu.tools.dashboard import DashboardServer
+
+    server = DashboardServer(host=args.ip, port=args.port)
+    _p(f"Dashboard running on {args.ip}:{server.port}")
+    server.serve_forever()
+    return 0
+
+
+# -- data / misc ---------------------------------------------------------------
+
+def cmd_import(args) -> int:
+    n = eventdata.import_events(args.appname, args.input, args.channel)
+    _p(f"Imported {n} event(s).")
+    return 0
+
+
+def cmd_export(args) -> int:
+    n = eventdata.export_events(args.appname, args.output, args.channel)
+    _p(f"Exported {n} event(s).")
+    return 0
+
+
+def cmd_status(args) -> int:
+    results = commands.status()
+    ok = all(results.values())
+    for repo, good in sorted(results.items()):
+        _p(f"{repo}: {'OK' if good else 'FAILED'}")
+    _p("(sleeping)" if ok else "Unable to connect to all storage backends.")
+    return 0 if ok else 1
+
+
+def cmd_template(args) -> int:
+    if args.template_command == "list":
+        for name, module in sorted(BUILTIN_TEMPLATES.items()):
+            _p(f"{name:28} {module}")
+        return 0
+    # template get <name> <dir>: scaffold an engine.json pointing at the
+    # built-in template's factory (gallery download needs egress;
+    # ref behavior: Template.scala:226-415 materializes a working dir)
+    import os
+
+    name = args.name
+    if name not in BUILTIN_TEMPLATES:
+        raise CommandError(
+            f"Unknown template {name!r} (available: {sorted(BUILTIN_TEMPLATES)})"
+        )
+    os.makedirs(args.directory, exist_ok=True)
+    engine_json = {
+        "id": "default",
+        "description": f"{name} template",
+        "engineFactory": f"{BUILTIN_TEMPLATES[name]}.{TEMPLATE_FACTORIES[name]}",
+    }
+    path = os.path.join(args.directory, "engine.json")
+    with open(path, "w") as f:
+        json.dump(engine_json, f, indent=2)
+        f.write("\n")
+    _p(f"Created {path} — edit the params blocks, then "
+       f"`pio build|train|deploy --engine-json {path}`.")
+    return 0
+
+
+# -- parser --------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="pio", description="PredictionIO-TPU console"
+    )
+    parser.add_argument("-v", "--verbose", action="store_true")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_app = sub.add_parser("app", help="manage apps")
+    app_sub = p_app.add_subparsers(dest="app_command", required=True)
+    p = app_sub.add_parser("new"); p.add_argument("name")
+    p.add_argument("--description", default=None)
+    app_sub.add_parser("list")
+    p = app_sub.add_parser("show"); p.add_argument("name")
+    p = app_sub.add_parser("delete"); p.add_argument("name")
+    p = app_sub.add_parser("data-delete"); p.add_argument("name")
+    p.add_argument("--channel", default=None)
+    p = app_sub.add_parser("channel-new"); p.add_argument("name"); p.add_argument("channel")
+    p = app_sub.add_parser("channel-delete"); p.add_argument("name"); p.add_argument("channel")
+    p_app.set_defaults(func=cmd_app)
+
+    p_ak = sub.add_parser("accesskey", help="manage access keys")
+    ak_sub = p_ak.add_subparsers(dest="ak_command", required=True)
+    p = ak_sub.add_parser("new"); p.add_argument("app")
+    p.add_argument("event", nargs="*", help="allowed events (empty = all)")
+    p = ak_sub.add_parser("list"); p.add_argument("--app", default=None)
+    p = ak_sub.add_parser("delete"); p.add_argument("key")
+    p_ak.set_defaults(func=cmd_accesskey)
+
+    def add_engine_args(p):
+        p.add_argument("--engine-json", default="engine.json")
+        p.add_argument("--engine-id", default=None)
+        p.add_argument("--engine-version", default="0")
+
+    p = sub.add_parser("build", help="register the engine manifest")
+    add_engine_args(p); p.set_defaults(func=cmd_build)
+
+    p = sub.add_parser("train", help="train an engine")
+    add_engine_args(p)
+    p.add_argument("--batch", default="")
+    p.add_argument("--skip-sanity-check", action="store_true")
+    p.add_argument("--stop-after-read", action="store_true")
+    p.add_argument("--stop-after-prepare", action="store_true")
+    p.set_defaults(func=cmd_train)
+
+    p = sub.add_parser("eval", help="run an evaluation")
+    p.add_argument("evaluation_class")
+    p.add_argument("engine_params_generator_class", nargs="?", default=None)
+    p.add_argument("--batch", default="")
+    p.set_defaults(func=cmd_eval)
+
+    p = sub.add_parser("deploy", help="deploy the latest trained instance")
+    add_engine_args(p)
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--feedback-url", default=None)
+    p.add_argument("--accesskey", default=None)
+    p.set_defaults(func=cmd_deploy)
+
+    p = sub.add_parser("undeploy", help="stop a deployed engine server")
+    p.add_argument("--ip", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.set_defaults(func=cmd_undeploy)
+
+    p = sub.add_parser("eventserver")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7070)
+    p.set_defaults(func=cmd_eventserver)
+
+    p = sub.add_parser("adminserver")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7071)
+    p.set_defaults(func=cmd_adminserver)
+
+    p = sub.add_parser("dashboard")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9000)
+    p.set_defaults(func=cmd_dashboard)
+
+    p = sub.add_parser("import", help="import events from a JSONL file")
+    p.add_argument("--appname", required=True)
+    p.add_argument("--input", required=True)
+    p.add_argument("--channel", default=None)
+    p.set_defaults(func=cmd_import)
+
+    p = sub.add_parser("export", help="export events to a JSONL file")
+    p.add_argument("--appname", required=True)
+    p.add_argument("--output", required=True)
+    p.add_argument("--channel", default=None)
+    p.set_defaults(func=cmd_export)
+
+    p = sub.add_parser("status", help="verify storage configuration")
+    p.set_defaults(func=cmd_status)
+
+    p_t = sub.add_parser("template", help="list or scaffold templates")
+    t_sub = p_t.add_subparsers(dest="template_command", required=True)
+    t_sub.add_parser("list")
+    p = t_sub.add_parser("get"); p.add_argument("name"); p.add_argument("directory")
+    p_t.set_defaults(func=cmd_template)
+
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+    try:
+        return args.func(args)
+    except CommandError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,124 @@
+"""Evaluation dashboard server.
+
+Behavior contract from the reference (tools/.../dashboard/
+Dashboard.scala:37-141): an HTML index of completed evaluation
+instances (newest first) with per-instance result routes
+
+  GET /                                                -> HTML listing
+  GET /engine_instances/<id>/evaluator_results.txt     -> one-liner
+  GET /engine_instances/<id>/evaluator_results.html    -> HTML report
+  GET /engine_instances/<id>/evaluator_results.json    -> JSON report
+
+plus CORS headers (ref: CorsSupport.scala).
+"""
+
+from __future__ import annotations
+
+import html
+import logging
+from typing import Optional
+from urllib.parse import urlparse
+
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.serving.http import HTTPServerBase, JSONRequestHandler
+
+log = logging.getLogger(__name__)
+
+DEFAULT_PORT = 9000
+
+
+class _DashboardRequestHandler(JSONRequestHandler):
+    server_version = "PIODashboard/0.1"
+
+    def _send_cors(self, status, body, content_type):
+        # CORS on result routes (ref: CorsSupport.scala)
+        self._send(status, body, content_type,
+                   extra_headers={"Access-Control-Allow-Origin": "*"})
+
+    def do_GET(self):
+        path = urlparse(self.path).path
+        storage: Storage = self.server_ref.storage
+        if path == "/":
+            self._send_cors(200, self.server_ref.index_html(),
+                            "text/html; charset=UTF-8")
+            return
+        parts = [p for p in path.split("/") if p]
+        # path form: /engine_instances/<id>/evaluator_results.<fmt>
+        if len(parts) == 3 and parts[0] == "engine_instances":
+            instance = storage.evaluation_instances().get(parts[1])
+            if instance is None:
+                self._send(404, {"message": "Not Found"})
+                return
+            mapping = {
+                "evaluator_results.txt": (instance.evaluator_results,
+                                          "text/plain; charset=UTF-8"),
+                "evaluator_results.html": (instance.evaluator_results_html,
+                                           "text/html; charset=UTF-8"),
+                "evaluator_results.json": (instance.evaluator_results_json,
+                                           "application/json; charset=UTF-8"),
+            }
+            if parts[2] in mapping:
+                body, ctype = mapping[parts[2]]
+                self._send_cors(200, body, ctype)
+                return
+        self._send(404, {"message": "Not Found"})
+
+
+class DashboardServer(HTTPServerBase):
+    """ref: Dashboard.createDashboard (Dashboard.scala:58)."""
+
+    def __init__(
+        self,
+        storage: Optional[Storage] = None,
+        host: str = "0.0.0.0",
+        port: int = DEFAULT_PORT,
+    ):
+        self.storage = storage or get_storage()
+        super().__init__(host, port, _DashboardRequestHandler)
+
+    def index_html(self) -> str:
+        """Completed evaluations, newest first (ref: Dashboard.scala:76)."""
+        instances = sorted(
+            (
+                i
+                for i in self.storage.evaluation_instances().get_completed()
+            ),
+            key=lambda i: i.start_time,
+            reverse=True,
+        )
+        rows = "\n".join(
+            "<tr><td>{id}</td><td>{start}</td><td>{cls}</td><td>{batch}</td>"
+            '<td><a href="/engine_instances/{id}/evaluator_results.html">HTML</a> '
+            '<a href="/engine_instances/{id}/evaluator_results.json">JSON</a> '
+            '<a href="/engine_instances/{id}/evaluator_results.txt">TXT</a></td></tr>'.format(
+                id=html.escape(i.id),
+                start=html.escape(i.start_time.isoformat()),
+                cls=html.escape(i.evaluation_class),
+                batch=html.escape(i.batch),
+            )
+            for i in instances
+        )
+        return (
+            "<!DOCTYPE html><html><head><title>PredictionIO-TPU Dashboard"
+            "</title></head><body><h1>Evaluation Instances</h1>"
+            "<table border='1'><tr><th>ID</th><th>Started</th>"
+            "<th>Evaluation</th><th>Batch</th><th>Results</th></tr>"
+            f"{rows}</table></body></html>"
+        )
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="PIO-TPU dashboard")
+    parser.add_argument("--ip", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=DEFAULT_PORT)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = DashboardServer(host=args.ip, port=args.port)
+    log.info("dashboard running on %s:%s", args.ip, server.port)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

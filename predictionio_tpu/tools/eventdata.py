@@ -1,0 +1,69 @@
+"""Event import/export as JSON-lines files.
+
+Behavior contracts:
+
+  - export (ref: tools/.../export/EventsToFile.scala:39,92-98): read all
+    events of an app (+ optional channel), write one JSON object per
+    line in the Event API format.
+  - import (ref: tools/.../imprt/FileToEvents.scala:38,80-90): read a
+    JSONL file, validate each line as an Event, batch-write into the
+    app's event store.
+
+The reference also offers parquet via SparkSQL; here JSONL is the
+interchange format (parquet would add a hard dependency the image does
+not guarantee).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from predictionio_tpu.data.event import Event, validate_event
+from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.data.store import resolve_app
+
+
+def export_events(
+    app_name: str,
+    path: str,
+    channel_name: Optional[str] = None,
+    storage: Optional[Storage] = None,
+) -> int:
+    """Write all events to ``path`` (JSONL); returns the event count."""
+    st = storage or get_storage()
+    app_id, channel_id = resolve_app(app_name, channel_name, st)
+    events = st.events().find(app_id, channel_id=channel_id)
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e.to_dict(api_format=True)) + "\n")
+    return len(events)
+
+
+def import_events(
+    app_name: str,
+    path: str,
+    channel_name: Optional[str] = None,
+    storage: Optional[Storage] = None,
+) -> int:
+    """Read JSONL events from ``path`` into the store; returns the count.
+
+    Invalid lines raise ValueError with the line number (the reference
+    fails the whole Spark job on a malformed line).
+    """
+    st = storage or get_storage()
+    app_id, channel_id = resolve_app(app_name, channel_name, st)
+    count = 0
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                event = Event.from_dict(json.loads(line))
+                validate_event(event)
+            except Exception as e:
+                raise ValueError(f"{path}:{lineno}: invalid event: {e}") from e
+            st.events().insert(event, app_id, channel_id)
+            count += 1
+    return count

@@ -1,0 +1,202 @@
+"""Tools layer tests: commands, CLI, import/export, admin API, dashboard.
+
+Reference coverage model: tools/src/test/.../admin/AdminAPISpec.scala
+(route-level) plus console behaviors asserted in App.scala/AccessKey.scala
+docstrings (SURVEY.md §2.7).
+"""
+
+import datetime as dt
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.metadata import EvaluationInstance
+from predictionio_tpu.tools import commands, eventdata
+from predictionio_tpu.tools.admin import AdminServer
+from predictionio_tpu.tools.cli import main as cli_main
+from predictionio_tpu.tools.commands import CommandError
+from predictionio_tpu.tools.dashboard import DashboardServer
+
+UTC = dt.timezone.utc
+
+
+def http(method, url, body=None):
+    req = urllib.request.Request(
+        url, method=method,
+        data=None if body is None else json.dumps(body).encode(),
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            raw = resp.read()
+            return resp.status, json.loads(raw) if raw.startswith(b"{") or raw.startswith(b"[") else raw
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        return e.code, json.loads(raw) if raw else {}
+
+
+class TestCommands:
+    def test_app_lifecycle(self, memory_storage):
+        info = commands.app_new("myapp", "desc", memory_storage)
+        assert info.app.name == "myapp"
+        assert len(info.access_keys) == 1
+        assert len(info.access_keys[0].key) == 64
+        # duplicate name rejected (ref: App.scala:37)
+        with pytest.raises(CommandError):
+            commands.app_new("myapp", storage=memory_storage)
+        assert [i.app.name for i in commands.app_list(memory_storage)] == ["myapp"]
+        # event store was initialized: inserts work
+        memory_storage.events().insert(
+            Event(event="e", entity_type="user", entity_id="u"), info.app.id)
+        commands.app_delete("myapp", memory_storage)
+        assert commands.app_list(memory_storage) == []
+        with pytest.raises(CommandError):
+            commands.app_show("myapp", memory_storage)
+
+    def test_app_data_delete(self, memory_storage):
+        info = commands.app_new("a1", storage=memory_storage)
+        memory_storage.events().insert(
+            Event(event="e", entity_type="user", entity_id="u"), info.app.id)
+        assert len(memory_storage.events().find(info.app.id)) == 1
+        commands.app_data_delete("a1", storage=memory_storage)
+        assert memory_storage.events().find(info.app.id) == []
+
+    def test_channels(self, memory_storage):
+        info = commands.app_new("capp", storage=memory_storage)
+        ch = commands.channel_new("capp", "mobile", memory_storage)
+        assert ch.name == "mobile"
+        with pytest.raises(CommandError):
+            commands.channel_new("capp", "mobile", memory_storage)
+        memory_storage.events().insert(
+            Event(event="e", entity_type="user", entity_id="u"), info.app.id, ch.id)
+        assert len(memory_storage.events().find(info.app.id, channel_id=ch.id)) == 1
+        commands.app_data_delete("capp", "mobile", memory_storage)
+        assert memory_storage.events().find(info.app.id, channel_id=ch.id) == []
+        commands.channel_delete("capp", "mobile", memory_storage)
+        assert commands.app_show("capp", memory_storage).channels == []
+
+    def test_accesskeys(self, memory_storage):
+        commands.app_new("kapp", storage=memory_storage)
+        key = commands.accesskey_new("kapp", ["rate", "buy"], memory_storage)
+        assert sorted(key.events) == ["buy", "rate"]
+        keys = commands.accesskey_list("kapp", memory_storage)
+        assert len(keys) == 2  # default + new
+        commands.accesskey_delete(key.key, memory_storage)
+        assert len(commands.accesskey_list("kapp", memory_storage)) == 1
+        with pytest.raises(CommandError):
+            commands.accesskey_delete("nope", memory_storage)
+
+    def test_status(self, memory_storage):
+        assert commands.status(memory_storage) == {
+            "METADATA": True, "EVENTDATA": True, "MODELDATA": True}
+
+
+class TestImportExport:
+    def test_round_trip(self, memory_storage, tmp_path):
+        info = commands.app_new("ioapp", storage=memory_storage)
+        for n in range(5):
+            memory_storage.events().insert(
+                Event(event="rate", entity_type="user", entity_id=f"u{n}",
+                      target_entity_type="item", target_entity_id="i1",
+                      properties={"rating": n},
+                      event_time=dt.datetime(2026, 1, 1, 0, n, tzinfo=UTC)),
+                info.app.id)
+        out = tmp_path / "events.jsonl"
+        assert eventdata.export_events("ioapp", str(out), storage=memory_storage) == 5
+        assert len(out.read_text().strip().splitlines()) == 5
+
+        commands.app_new("ioapp2", storage=memory_storage)
+        assert eventdata.import_events("ioapp2", str(out), storage=memory_storage) == 5
+        app2 = memory_storage.apps().get_by_name("ioapp2")
+        events = memory_storage.events().find(app2.id)
+        assert {e.entity_id for e in events} == {f"u{n}" for n in range(5)}
+
+    def test_import_invalid_line(self, memory_storage, tmp_path):
+        commands.app_new("bad", storage=memory_storage)
+        f = tmp_path / "bad.jsonl"
+        f.write_text('{"event": "e", "entityType": "user", "entityId": "u"}\n'
+                     '{"event": "$set"}\n')
+        with pytest.raises(ValueError, match="bad.jsonl:2"):
+            eventdata.import_events("bad", str(f), storage=memory_storage)
+
+
+class TestCLI:
+    def test_app_and_template_commands(self, memory_storage, tmp_path, capsys):
+        assert cli_main(["app", "new", "cliapp"]) == 0
+        out = capsys.readouterr().out
+        assert "Access Key:" in out
+        assert cli_main(["app", "list"]) == 0
+        assert cli_main(["status"]) == 0
+        # duplicate app -> exit 1 with error message
+        assert cli_main(["app", "new", "cliapp"]) == 1
+        assert "already exists" in capsys.readouterr().err
+        # template scaffold
+        assert cli_main(["template", "list"]) == 0
+        tdir = str(tmp_path / "eng")
+        assert cli_main(["template", "get", "vanilla", tdir]) == 0
+        variant = json.load(open(f"{tdir}/engine.json"))
+        assert variant["engineFactory"].endswith("vanilla_engine")
+
+    def test_build_train_via_cli(self, memory_storage, tmp_path, capsys):
+        tdir = str(tmp_path / "eng")
+        cli_main(["template", "get", "vanilla", tdir])
+        ej = f"{tdir}/engine.json"
+        assert cli_main(["build", "--engine-json", ej]) == 0
+        assert cli_main(["train", "--engine-json", ej]) == 0
+        assert "COMPLETED" in capsys.readouterr().out
+        manifests = memory_storage.engine_manifests().get_all()
+        assert len(manifests) == 1
+        instances = memory_storage.engine_instances().get_all()
+        assert instances and instances[0].status == "COMPLETED"
+
+
+class TestAdminServer:
+    @pytest.fixture()
+    def admin(self, memory_storage):
+        server = AdminServer(storage=memory_storage, host="127.0.0.1", port=0)
+        server.start()
+        yield f"http://127.0.0.1:{server.port}"
+        server.stop()
+
+    def test_routes(self, admin, memory_storage):
+        assert http("GET", f"{admin}/")[1] == {"status": "alive"}
+        status, body = http("POST", f"{admin}/cmd/app", {"name": "adminapp"})
+        assert status == 200 and body["name"] == "adminapp"
+        assert body["accessKeys"]
+        # duplicate -> 409
+        assert http("POST", f"{admin}/cmd/app", {"name": "adminapp"})[0] == 409
+        status, body = http("GET", f"{admin}/cmd/app")
+        assert [a["name"] for a in body["apps"]] == ["adminapp"]
+        # wipe data then delete
+        assert http("DELETE", f"{admin}/cmd/app/adminapp/data")[0] == 200
+        assert http("DELETE", f"{admin}/cmd/app/adminapp")[0] == 200
+        assert http("GET", f"{admin}/cmd/app")[1]["apps"] == []
+        assert http("DELETE", f"{admin}/cmd/app/ghost")[0] == 404
+        assert http("POST", f"{admin}/cmd/app", {"nope": 1})[0] == 400
+
+
+class TestDashboard:
+    def test_listing_and_results(self, memory_storage):
+        memory_storage.evaluation_instances().insert(EvaluationInstance(
+            id="ev1", status="EVALCOMPLETED",
+            start_time=dt.datetime(2026, 1, 1, tzinfo=UTC),
+            end_time=dt.datetime(2026, 1, 1, 1, tzinfo=UTC),
+            evaluation_class="my.Eval", batch="b1",
+            evaluator_results="best: x",
+            evaluator_results_html="<html>r</html>",
+            evaluator_results_json='{"best": "x"}',
+        ))
+        server = DashboardServer(storage=memory_storage, host="127.0.0.1", port=0)
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            status, body = http("GET", f"{base}/")
+            assert status == 200 and b"ev1" in body
+            assert http("GET", f"{base}/engine_instances/ev1/evaluator_results.txt")[1] == b"best: x"
+            assert http("GET", f"{base}/engine_instances/ev1/evaluator_results.json")[1] == {"best": "x"}
+            assert http("GET", f"{base}/engine_instances/ev1/evaluator_results.html")[1] == b"<html>r</html>"
+            assert http("GET", f"{base}/engine_instances/ghost/evaluator_results.txt")[0] == 404
+        finally:
+            server.stop()
