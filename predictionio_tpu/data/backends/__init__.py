@@ -7,6 +7,9 @@ localfs/HDFS (model blobs). The TPU build ships:
                   has no such backend; its tests require live HBase)
   - ``localfs`` — JSONL event logs + JSON metadata + model-blob files,
                   the single-host default
+  - ``sqlite``  — one WAL-mode SQLite database: indexed event scans,
+                  ACID metadata, model blobs; the durable multi-process
+                  single-node tier
 
 Scale-out backends can be registered by third parties via
 ``predictionio_tpu.data.storage.register_backend``.

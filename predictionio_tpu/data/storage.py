@@ -70,6 +70,13 @@ class EventStore(abc.ABC):
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
         """Append one event, returning its assigned eventId."""
 
+    def insert_batch(
+        self, events: List[Event], app_id: int, channel_id: Optional[int] = None
+    ) -> List[str]:
+        """Bulk append (ref: PEvents.write:124). Backends with
+        transactions override this to commit once."""
+        return [self.insert(e, app_id, channel_id) for e in events]
+
     @abc.abstractmethod
     def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:
         ...
@@ -273,7 +280,7 @@ def register_backend(type_name: str, client_cls: type) -> None:
 
 def _load_backends() -> None:
     # import side-effect registers the built-in backends
-    from predictionio_tpu.data.backends import memory, localfs  # noqa: F401
+    from predictionio_tpu.data.backends import memory, localfs, sqlite  # noqa: F401
 
 
 _SOURCE_RE = re.compile(r"^PIO_STORAGE_SOURCES_([^_]+)_(.+)$")

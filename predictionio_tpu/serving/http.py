@@ -81,7 +81,11 @@ class HTTPServerBase:
         return self.httpd.server_address[1]
 
     def start(self):
-        self._thread = threading.Thread(target=self.serve_forever, daemon=True)
+        # flag set BEFORE the thread is scheduled so a stop() racing
+        # start() still runs shutdown() (which blocks until the serve
+        # loop has run and exited) instead of closing the socket under it
+        self._serving = True
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         self._thread.start()
         log.info("%s listening on %s", type(self).__name__, self.port)
         return self

@@ -30,7 +30,7 @@ import logging
 import sys
 from typing import List, Optional
 
-from predictionio_tpu.data.storage import get_storage
+from predictionio_tpu.data.storage import StorageError, get_storage
 from predictionio_tpu.tools import commands, eventdata
 from predictionio_tpu.tools.commands import CommandError
 
@@ -434,7 +434,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
     try:
         return args.func(args)
-    except CommandError as e:
+    except (CommandError, StorageError, RuntimeError, FileNotFoundError, ValueError) as e:
+        # operator errors (bad app name, unconfigured storage, no trained
+        # instance, missing engine.json) exit cleanly like the reference CLI
         print(f"ERROR: {e}", file=sys.stderr)
         return 1
 

@@ -53,7 +53,7 @@ def import_events(
     """
     st = storage or get_storage()
     app_id, channel_id = resolve_app(app_name, channel_name, st)
-    count = 0
+    events = []
     with open(path) as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
@@ -64,6 +64,8 @@ def import_events(
                 validate_event(event)
             except Exception as e:
                 raise ValueError(f"{path}:{lineno}: invalid event: {e}") from e
-            st.events().insert(event, app_id, channel_id)
-            count += 1
-    return count
+            events.append(event)
+    # validate-all-then-write: a malformed line aborts before any insert,
+    # and transactional backends commit the batch once
+    st.events().insert_batch(events, app_id, channel_id)
+    return len(events)

@@ -1,0 +1,103 @@
+"""SQLite backend specifics: durability across reopen, multi-process
+visibility, blob round-trip (the properties the localfs tier only
+approximates; ref role: hbase+elasticsearch persistence, SURVEY.md §2.5)."""
+
+import datetime as dt
+import json
+import subprocess
+import sys
+
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.metadata import Model
+from predictionio_tpu.data.storage import Storage
+
+from tests.test_storage import make_storage
+
+UTC = dt.timezone.utc
+
+
+def test_reopen_persistence(tmp_path):
+    st = make_storage("sqlite", tmp_path)
+    app = st.apps().insert("persist")
+    st.events().init(app.id)
+    st.events().insert(
+        Event(event="rate", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1",
+              properties={"rating": 4.5},
+              event_time=dt.datetime(2026, 3, 1, 12, 30, tzinfo=UTC)),
+        app.id,
+    )
+    st.models().insert(Model(id="m1", models=b"\x00\x01binary\xff"))
+    st.client_for("METADATA").close()
+
+    st2 = make_storage("sqlite", tmp_path)
+    assert st2.apps().get_by_name("persist").id == app.id
+    events = st2.events().find(app.id)
+    assert len(events) == 1
+    e = events[0]
+    assert e.properties.get("rating", float) == 4.5
+    # timezone fidelity through the payload round-trip
+    assert e.event_time == dt.datetime(2026, 3, 1, 12, 30, tzinfo=UTC)
+    assert st2.models().get("m1").models == b"\x00\x01binary\xff"
+
+
+def test_uninitialized_table_strict(tmp_path):
+    from predictionio_tpu.data.storage import StorageError
+
+    st = make_storage("sqlite", tmp_path)
+    app = st.apps().insert("strict")
+    with pytest.raises(StorageError):
+        st.events().find(app.id)
+    st.events().remove(app.id)  # removing a missing table is a no-op
+    st.events().init(app.id)
+    assert st.events().find(app.id) == []
+
+
+def test_cross_process_visibility(tmp_path):
+    """A second OS process sees committed writes (WAL multi-process)."""
+    st = make_storage("sqlite", tmp_path)
+    app = st.apps().insert("xproc")
+    st.events().init(app.id)
+    st.events().insert(
+        Event(event="view", entity_type="user", entity_id="u9"), app.id)
+
+    script = f"""
+import json
+from tests.test_storage import make_storage
+from pathlib import Path
+st = make_storage("sqlite", Path({str(tmp_path)!r}))
+app = st.apps().get_by_name("xproc")
+events = st.events().find(app.id)
+st.events().insert(events[0].with_id("child-written"), app.id)
+print(json.dumps({{"app_id": app.id, "n": len(events)}}))
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": "/root/repo"},
+        check=True,
+    )
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result == {"app_id": app.id, "n": 1}
+    # and the child's write is visible back in this process
+    assert st.events().get("child-written", app.id) is not None
+
+
+def test_find_uses_index_ordering(tmp_path):
+    st = make_storage("sqlite", tmp_path)
+    app = st.apps().insert("ord")
+    st.events().init(app.id)
+    for m in (5, 1, 3):
+        st.events().insert(
+            Event(event="e", entity_type="u", entity_id=f"x{m}",
+                  event_time=dt.datetime(2026, 1, 1, 0, m, tzinfo=UTC)),
+            app.id)
+    times = [e.event_time.minute for e in st.events().find(app.id)]
+    assert times == [1, 3, 5]
+    times = [e.event_time.minute for e in st.events().find(app.id, reversed=True)]
+    assert times == [5, 3, 1]
+    limited = st.events().find(app.id, limit=2)
+    assert [e.event_time.minute for e in limited] == [1, 3]

@@ -19,7 +19,7 @@ def make_storage(kind, tmp_path):
         env = {"PIO_STORAGE_SOURCES_S_TYPE": "memory"}
     else:
         env = {
-            "PIO_STORAGE_SOURCES_S_TYPE": "localfs",
+            "PIO_STORAGE_SOURCES_S_TYPE": kind,
             "PIO_STORAGE_SOURCES_S_PATH": str(tmp_path / "store"),
         }
     env.update(
@@ -35,7 +35,7 @@ def make_storage(kind, tmp_path):
     return Storage.from_env(env)
 
 
-@pytest.fixture(params=["memory", "localfs"])
+@pytest.fixture(params=["memory", "localfs", "sqlite"])
 def storage(request, tmp_path):
     return make_storage(request.param, tmp_path)
 
