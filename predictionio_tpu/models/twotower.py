@@ -1,0 +1,94 @@
+"""Two-tower neural retrieval as a DASE Algorithm.
+
+The deep-model counterpart of models.als: same PD (PreparedRatings),
+same model container / query surface (top-``num`` itemScores), so the
+recommendation engine can swap `"als"` for `"twotower"` — or run both
+and let Serving combine them, the reference's distinctive
+multi-algorithm contract (SURVEY.md §7 hard part (d), CreateServer
+serving combine :472–475). Compute core: ops.twotower (flax towers +
+in-batch softmax under jit on the mesh).
+
+Scores are cosine similarities (towers L2-normalize), so multi-algo
+averaging with ALS dot-products needs score-scale awareness — the same
+caveat the reference leaves to user Serving code.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.core import Algorithm
+from predictionio_tpu.core.params import Params
+from predictionio_tpu.models.als import ALSAlgorithm, ALSModel, PreparedRatings
+from predictionio_tpu.ops.als import ALSFactors
+from predictionio_tpu.ops.twotower import TwoTowerConfig, TwoTowerTrainer
+from predictionio_tpu.parallel.mesh import MeshContext
+
+
+@dataclass
+class TwoTowerParams(Params):
+    dim: int = 64
+    hidden: Tuple[int, ...] = ()
+    temperature: float = 0.07
+    learning_rate: float = 3e-3
+    weight_decay: float = 1e-6
+    epochs: int = 5
+    batch_size: int = 1024
+    seed: int = 11
+    min_rating: float = 0.0       # keep events with rating >= this as positives
+    weight_by_rating: bool = False
+    shard_embeddings: bool = False
+
+
+class TwoTowerModel(ALSModel):
+    """Same container as ALSModel: (user_vecs, item_vecs, id maps) +
+    TopKScorer serve path; vectors here are L2-normalized so scores are
+    cosine similarities."""
+
+
+class TwoTowerAlgorithm(Algorithm):
+    """DASE wrapper over ops.twotower."""
+
+    def __init__(self, params: TwoTowerParams):
+        super().__init__(params)
+
+    def train(self, ctx: MeshContext, pd: PreparedRatings) -> TwoTowerModel:
+        p: TwoTowerParams = self.params
+        keep = pd.ratings >= p.min_rating
+        u, i, r = pd.user_idx[keep], pd.item_idx[keep], pd.ratings[keep]
+        if len(u) == 0:
+            raise ValueError(
+                f"no events with rating >= {p.min_rating} — nothing to train on"
+            )
+        cfg = TwoTowerConfig(
+            dim=p.dim,
+            hidden=tuple(p.hidden),
+            temperature=p.temperature,
+            learning_rate=p.learning_rate,
+            weight_decay=p.weight_decay,
+            epochs=p.epochs,
+            batch_size=p.batch_size,
+            seed=p.seed,
+            shard_embeddings=p.shard_embeddings,
+        )
+        trainer = TwoTowerTrainer(
+            (u, i, r if p.weight_by_rating else None),
+            pd.n_users,
+            pd.n_items,
+            cfg,
+            mesh=ctx.mesh,
+        )
+        losses = trainer.run()
+        emb = trainer.embeddings(losses)
+        factors = ALSFactors(user_factors=emb.user_vecs, item_factors=emb.item_vecs)
+        model = TwoTowerModel(factors, pd.user_ids, pd.item_ids)
+        model.train_losses = emb.losses
+        return model
+
+    # identical model/query surface -> share ALS's serve and batched
+    # (matmul + top-k) evaluation paths
+    predict = ALSAlgorithm.predict
+    batch_predict = ALSAlgorithm.batch_predict

@@ -1,0 +1,235 @@
+"""Two-tower neural retrieval trained with in-batch softmax on the mesh.
+
+The stretch model proving DASE extends past factorization to deep
+models (SURVEY.md §7.7): a flax user tower and item tower (id embedding
+-> optional MLP -> L2-normalized vector) trained on positive
+(user, item) events with a symmetric in-batch sampled-softmax loss —
+the standard retrieval formulation. The reference has no neural models
+(Spark MLlib only), so the behavior contract is the recommendation
+template's (same query/result surface as ALS); the training loop is
+what a TPU-native framework adds.
+
+Mesh mapping:
+  - batch axis sharded over ``data`` (DP): each device computes tower
+    forward/backward on its batch shard; GSPMD inserts the gradient
+    all-reduce. The in-batch softmax needs every item vector in the
+    batch, so logits induce an all-gather over ``data`` — the TPU
+    analogue of the reference's Spark shuffle, riding ICI.
+  - optionally the embedding tables are row-sharded over ``model``
+    (TP) for catalogs too large to replicate; lookups then gather over
+    ICI (``shard_embeddings``).
+
+Everything under jit: fixed batch shapes (short tails padded with
+zero-weight rows), `lax`-free host loop driving compiled steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class TwoTowerConfig:
+    dim: int = 64                      # final embedding dimension
+    hidden: Tuple[int, ...] = ()       # MLP widths on top of the id embedding
+    embed_dim: Optional[int] = None    # id-embedding width (default: dim)
+    temperature: float = 0.07
+    learning_rate: float = 3e-3
+    weight_decay: float = 1e-6
+    epochs: int = 5
+    batch_size: int = 1024
+    seed: int = 11
+    shard_embeddings: bool = False     # row-shard tables over the "model" axis
+
+
+class Tower(nn.Module):
+    """Id embedding -> MLP -> L2-normalized vector on the MXU."""
+
+    n_ids: int
+    cfg: TwoTowerConfig
+
+    @nn.compact
+    def __call__(self, idx: jax.Array) -> jax.Array:
+        width = self.cfg.embed_dim or self.cfg.dim
+        x = nn.Embed(self.n_ids, width, dtype=jnp.float32)(idx)
+        for h in self.cfg.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        if self.cfg.hidden or width != self.cfg.dim:
+            x = nn.Dense(self.cfg.dim)(x)
+        return x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-8)
+
+
+@dataclasses.dataclass
+class TwoTowerEmbeddings:
+    user_vecs: np.ndarray    # [n_users, dim] float32, L2-normalized
+    item_vecs: np.ndarray    # [n_items, dim] float32, L2-normalized
+    losses: List[float]      # per-epoch mean loss
+
+
+def _param_shardings(params, mesh: Mesh, shard_embeddings: bool):
+    """Replicate everything except (optionally) embedding tables, which
+    row-shard over the ``model`` axis."""
+
+    def spec(path, leaf):
+        if (
+            shard_embeddings
+            and mesh.shape.get("model", 1) > 1
+            and any(getattr(p, "key", None) == "embedding" for p in path)
+        ):
+            return NamedSharding(mesh, P("model", None))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+class TwoTowerTrainer:
+    """Prepared training run over positive (user, item, weight) triples.
+
+    Mirrors ALSTrainer's shape: one-time costs (param init, device
+    placement, compile) in the constructor, `run()` drives compiled
+    steps, `embeddings()` materializes the serving tables.
+    """
+
+    def __init__(
+        self,
+        positives: Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]],
+        n_users: int,
+        n_items: int,
+        cfg: TwoTowerConfig,
+        mesh: Optional[Mesh] = None,
+    ):
+        u_idx, i_idx, w = positives
+        self.cfg = cfg
+        self.mesh = mesh
+        self.n_users, self.n_items = n_users, n_items
+        self._u = np.asarray(u_idx, dtype=np.int32)
+        self._i = np.asarray(i_idx, dtype=np.int32)
+        self._w = (np.ones(len(self._u), np.float32) if w is None
+                   else np.asarray(w, dtype=np.float32))
+
+        n_data = mesh.shape.get("data", 1) if mesh is not None else 1
+        # fixed step shape: full batches only, tails padded via zero weight
+        self.batch = max(cfg.batch_size - cfg.batch_size % max(n_data, 1), n_data)
+
+        self.user_tower = Tower(n_users, cfg)
+        self.item_tower = Tower(n_items, cfg)
+        k0, k1 = jax.random.split(jax.random.PRNGKey(cfg.seed))
+        probe = jnp.zeros((1,), jnp.int32)
+        params = {
+            "user": self.user_tower.init(k0, probe),
+            "item": self.item_tower.init(k1, probe),
+        }
+        self._tx = optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay)
+        opt_state = self._tx.init(params)
+        if mesh is not None:
+            pshard = _param_shardings(params, mesh, cfg.shard_embeddings)
+            params = jax.device_put(params, pshard)
+            opt_state = jax.device_put(
+                opt_state, _param_shardings(opt_state, mesh, cfg.shard_embeddings)
+            )
+            self._batch_sharding = NamedSharding(mesh, P("data"))
+        else:
+            self._batch_sharding = None
+        self._params, self._opt_state = params, opt_state
+        self._step = jax.jit(self._make_step(), donate_argnums=(0, 1))
+        self._epoch_rng = np.random.default_rng(cfg.seed)
+
+    def _make_step(self):
+        temp = self.cfg.temperature
+        user_apply, item_apply = self.user_tower.apply, self.item_tower.apply
+        tx = self._tx
+
+        def loss_fn(params, u_idx, i_idx, weight):
+            u = user_apply(params["user"], u_idx)           # [B, D]
+            v = item_apply(params["item"], i_idx)           # [B, D]
+            logits = (u @ v.T) / temp                       # [B, B] MXU
+            # mask in-batch false negatives: the same item (for the
+            # user->item direction) or the same user (item->user)
+            # elsewhere in the batch, and zero-weight padding rows whose
+            # (u0, i0) placeholders would otherwise act as real negatives
+            B = logits.shape[0]
+            eye = jnp.eye(B, dtype=bool)
+            pad_col = (weight <= 0.0)[None, :]
+            dup_i = ((i_idx[None, :] == i_idx[:, None]) | pad_col) & ~eye
+            dup_u = ((u_idx[None, :] == u_idx[:, None]) | pad_col) & ~eye
+            labels = jnp.arange(B)
+            l_ui = optax.softmax_cross_entropy_with_integer_labels(
+                jnp.where(dup_i, -1e9, logits), labels)
+            l_iu = optax.softmax_cross_entropy_with_integer_labels(
+                jnp.where(dup_u, -1e9, logits.T), labels)
+            wsum = jnp.maximum(weight.sum(), 1e-8)
+            return jnp.sum(0.5 * (l_ui + l_iu) * weight) / wsum
+
+        def step(params, opt_state, u_idx, i_idx, weight):
+            loss, grads = jax.value_and_grad(loss_fn)(params, u_idx, i_idx, weight)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return step
+
+    def _batches(self):
+        n = len(self._u)
+        order = self._epoch_rng.permutation(n)
+        for s in range(0, n, self.batch):
+            sel = order[s:s + self.batch]
+            pad = self.batch - len(sel)
+            u, i, w = self._u[sel], self._i[sel], self._w[sel]
+            if pad:
+                u = np.concatenate([u, np.zeros(pad, np.int32)])
+                i = np.concatenate([i, np.zeros(pad, np.int32)])
+                w = np.concatenate([w, np.zeros(pad, np.float32)])
+            yield u, i, w
+
+    def run(self, epochs: Optional[int] = None) -> List[float]:
+        losses = []
+        for _ in range(epochs if epochs is not None else self.cfg.epochs):
+            total, batches = 0.0, 0
+            for u, i, w in self._batches():
+                args = (jnp.asarray(u), jnp.asarray(i), jnp.asarray(w))
+                if self._batch_sharding is not None:
+                    args = tuple(jax.device_put(a, self._batch_sharding) for a in args)
+                self._params, self._opt_state, loss = self._step(
+                    self._params, self._opt_state, *args
+                )
+                total += float(loss)
+                batches += 1
+            losses.append(total / max(batches, 1))
+        return losses
+
+    def _all_vecs(self, tower: Tower, side: str, n: int) -> np.ndarray:
+        apply = jax.jit(tower.apply)
+        chunk = 8192
+        out = np.empty((n, self.cfg.dim), np.float32)
+        for s in range(0, n, chunk):
+            idx = jnp.arange(s, min(s + chunk, n), dtype=jnp.int32)
+            out[s:s + len(idx)] = np.asarray(apply(self._params[side], idx))
+        return out
+
+    def embeddings(self, losses: Optional[List[float]] = None) -> TwoTowerEmbeddings:
+        return TwoTowerEmbeddings(
+            user_vecs=self._all_vecs(self.user_tower, "user", self.n_users),
+            item_vecs=self._all_vecs(self.item_tower, "item", self.n_items),
+            losses=losses or [],
+        )
+
+
+def twotower_train(
+    positives: Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]],
+    n_users: int,
+    n_items: int,
+    cfg: TwoTowerConfig,
+    mesh: Optional[Mesh] = None,
+) -> TwoTowerEmbeddings:
+    """One-call train from positive (user_idx, item_idx, weight?) triples."""
+    trainer = TwoTowerTrainer(positives, n_users, n_items, cfg, mesh=mesh)
+    losses = trainer.run()
+    return trainer.embeddings(losses)

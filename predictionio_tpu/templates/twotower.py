@@ -1,0 +1,59 @@
+"""Two-tower neural recommendation engine template.
+
+Same data contract as the recommendation template (rate/buy events,
+ref: examples/scala-parallel-recommendation DataSource.scala:31), with
+the flax two-tower retrieval model in the Algorithm slot instead of
+ALS. `twotower_hybrid_engine` runs BOTH algorithms and averages their
+scores at serve time — exercising the reference's multi-algorithm
+Serving contract (CreateServer.scala:472–475) with a deep + linear
+ensemble no Spark template could express on one engine's hardware.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from predictionio_tpu.core import Engine, FirstServing, Serving
+from predictionio_tpu.models.als import ALSAlgorithm
+from predictionio_tpu.models.twotower import TwoTowerAlgorithm
+from predictionio_tpu.templates.recommendation import (
+    RecoDataSource,
+    RecoDataSourceParams,
+    RecoPreparator,
+)
+
+
+class ItemScoreAverageServing(Serving):
+    """Mean per-item score across algorithms (ref: LAverageServing.scala:25
+    semantics lifted to itemScores lists): items are merged by id, each
+    algorithm contributes its score, missing entries count as 0."""
+
+    def serve(self, query: Dict[str, Any], predictions: List[Dict[str, Any]]):
+        num = int(query.get("num", 10))
+        totals: Dict[str, float] = {}
+        for pred in predictions:
+            for entry in pred.get("itemScores", []):
+                totals[entry["item"]] = totals.get(entry["item"], 0.0) + entry["score"]
+        n = max(len(predictions), 1)
+        ranked = sorted(totals.items(), key=lambda kv: -kv[1])[:num]
+        return {"itemScores": [{"item": i, "score": s / n} for i, s in ranked]}
+
+
+def twotower_engine() -> Engine:
+    """Engine factory: two-tower retrieval only."""
+    return Engine(
+        data_source_classes=RecoDataSource,
+        preparator_classes=RecoPreparator,
+        algorithm_classes={"twotower": TwoTowerAlgorithm},
+        serving_classes=FirstServing,
+    )
+
+
+def twotower_hybrid_engine() -> Engine:
+    """ALS + two-tower ensemble combined by score averaging."""
+    return Engine(
+        data_source_classes=RecoDataSource,
+        preparator_classes=RecoPreparator,
+        algorithm_classes={"als": ALSAlgorithm, "twotower": TwoTowerAlgorithm},
+        serving_classes=ItemScoreAverageServing,
+    )

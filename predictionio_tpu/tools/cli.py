@@ -434,9 +434,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
     try:
         return args.func(args)
-    except (CommandError, StorageError, RuntimeError, FileNotFoundError, ValueError) as e:
+    except (CommandError, StorageError, FileNotFoundError) as e:
         # operator errors (bad app name, unconfigured storage, no trained
-        # instance, missing engine.json) exit cleanly like the reference CLI
+        # instance, missing engine.json) exit cleanly like the reference
+        # CLI; anything else (XLA/numpy RuntimeError/ValueError = genuine
+        # bugs) propagates with its traceback
+        if args.verbose:
+            import traceback
+
+            traceback.print_exc()
         print(f"ERROR: {e}", file=sys.stderr)
         return 1
 

@@ -1,0 +1,163 @@
+"""Two-tower retrieval: compute core + DASE template + hybrid serving."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models.twotower import TwoTowerAlgorithm, TwoTowerParams
+from predictionio_tpu.ops.twotower import (
+    TwoTowerConfig,
+    TwoTowerTrainer,
+    twotower_train,
+)
+from predictionio_tpu.parallel.mesh import MeshContext, create_mesh
+from predictionio_tpu.templates.twotower import (
+    ItemScoreAverageServing,
+    twotower_engine,
+    twotower_hybrid_engine,
+)
+from predictionio_tpu.workflow.deploy import prepare_deploy
+from predictionio_tpu.workflow.train import run_train
+
+from tests.test_als import _seed_events
+
+
+def _block_positives(n_users=40, n_items=16, per_user=6, seed=0):
+    """Users 0..n/2 interact with items 0..n/2, rest with the other half."""
+    rng = np.random.default_rng(seed)
+    u, i = [], []
+    half_u, half_i = n_users // 2, n_items // 2
+    for user in range(n_users):
+        lo, hi = (0, half_i) if user < half_u else (half_i, n_items)
+        for item in rng.integers(lo, hi, size=per_user):
+            u.append(user)
+            i.append(item)
+    return np.array(u), np.array(i), n_users, n_items
+
+
+def test_twotower_loss_decreases_and_learns_blocks():
+    u, i, n_users, n_items = _block_positives()
+    cfg = TwoTowerConfig(dim=8, epochs=30, batch_size=64, learning_rate=1e-2, seed=1)
+    emb = twotower_train((u, i, None), n_users, n_items, cfg)
+    assert emb.losses[-1] < emb.losses[0]
+    # vectors are L2-normalized
+    norms = np.linalg.norm(emb.item_vecs, axis=1)
+    np.testing.assert_allclose(norms, 1.0, atol=1e-4)
+    # block structure: user 0's best items should be in the first half
+    scores = emb.item_vecs @ emb.user_vecs[0]
+    top4 = np.argsort(-scores)[:4]
+    assert sum(1 for t in top4 if t < n_items // 2) >= 3
+
+
+def test_twotower_on_mesh_dp():
+    u, i, n_users, n_items = _block_positives(n_users=24, n_items=8, per_user=4)
+    mesh = create_mesh({"data": 8})
+    cfg = TwoTowerConfig(dim=4, epochs=3, batch_size=32, seed=2)
+    emb = twotower_train((u, i, None), n_users, n_items, cfg, mesh=mesh)
+    assert emb.user_vecs.shape == (n_users, 4)
+    assert np.all(np.isfinite(emb.user_vecs))
+
+
+def test_twotower_sharded_embeddings_tp():
+    """Row-sharding embedding tables over the model axis (TP) must
+    produce finite, normalized embeddings identical in shape."""
+    u, i, n_users, n_items = _block_positives(n_users=16, n_items=8, per_user=4)
+    mesh = create_mesh({"data": 4, "model": 2})
+    cfg = TwoTowerConfig(dim=4, epochs=2, batch_size=16, seed=3, shard_embeddings=True)
+    trainer = TwoTowerTrainer((u, i, None), n_users, n_items, cfg, mesh=mesh)
+    losses = trainer.run()
+    emb = trainer.embeddings(losses)
+    assert emb.item_vecs.shape == (n_items, 4)
+    assert np.all(np.isfinite(emb.item_vecs))
+
+
+def test_twotower_template_end_to_end(memory_storage):
+    _seed_events(memory_storage, "tt-app")
+    engine = twotower_engine()
+    ep = engine.engine_params_from_variant({
+        "engineFactory": "predictionio_tpu.templates.twotower.twotower_engine",
+        "datasource": {"name": "", "params": {"app_name": "tt-app"}},
+        "algorithms": [{"name": "twotower", "params": {
+            "dim": 8, "epochs": 25, "batch_size": 64, "learning_rate": 1e-2,
+            "min_rating": 3.0}}],
+    })
+    ctx = MeshContext(mesh=create_mesh({"data": 8}))
+    instance = run_train(engine, ep, engine_id="tt", storage=memory_storage, ctx=ctx)
+    assert instance.status == "COMPLETED"
+
+    deployment = prepare_deploy(engine, instance, ctx, memory_storage)
+    result = deployment.query({"user": "u3", "num": 4})
+    items = [r["item"] for r in result["itemScores"]]
+    assert len(items) == 4
+    # u3 rates block-0 items 5.0 and block-1 items 1.0; min_rating=3 keeps
+    # only the positives, so recommendations should be block-0 heavy
+    assert sum(1 for i in items if int(i[1:]) < 6) >= 3
+    assert deployment.query({"user": "nobody", "num": 3}) == {"itemScores": []}
+
+
+def test_twotower_batch_predict_matches_predict(memory_storage):
+    _seed_events(memory_storage, "tt-bp")
+    engine = twotower_engine()
+    ep = engine.engine_params_from_variant({
+        "engineFactory": "x",
+        "datasource": {"name": "", "params": {"app_name": "tt-bp"}},
+        "algorithms": [{"name": "twotower", "params": {
+            "dim": 4, "epochs": 4, "batch_size": 32}}],
+    })
+    result = engine.train(MeshContext(), ep)
+    algo = engine.make_algorithms(ep)[0]
+    model = result.models[0]
+    queries = [(0, {"user": "u1", "num": 3}), (1, {"user": "nobody", "num": 3})]
+    batch = dict(algo.batch_predict(model, queries))
+    assert [r["item"] for r in batch[0]["itemScores"]] == \
+        [r["item"] for r in algo.predict(model, {"user": "u1", "num": 3})["itemScores"]]
+    assert batch[1] == {"itemScores": []}
+
+
+def test_hybrid_engine_averages_scores(memory_storage):
+    _seed_events(memory_storage, "tt-hybrid")
+    engine = twotower_hybrid_engine()
+    ep = engine.engine_params_from_variant({
+        "engineFactory": "predictionio_tpu.templates.twotower.twotower_hybrid_engine",
+        "datasource": {"name": "", "params": {"app_name": "tt-hybrid"}},
+        "algorithms": [
+            {"name": "als", "params": {"rank": 4, "num_iterations": 4, "block_size": 32}},
+            {"name": "twotower", "params": {"dim": 4, "epochs": 4, "batch_size": 32}},
+        ],
+    })
+    ctx = MeshContext()
+    instance = run_train(engine, ep, engine_id="tt-h", storage=memory_storage, ctx=ctx)
+    assert instance.status == "COMPLETED"
+    deployment = prepare_deploy(engine, instance, ctx, memory_storage)
+    result = deployment.query({"user": "u1", "num": 3})
+    assert len(result["itemScores"]) == 3
+    scores = [r["score"] for r in result["itemScores"]]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_item_score_average_serving_merges():
+    serving = ItemScoreAverageServing()
+    out = serving.serve(
+        {"num": 2},
+        [
+            {"itemScores": [{"item": "a", "score": 1.0}, {"item": "b", "score": 0.5}]},
+            {"itemScores": [{"item": "a", "score": 0.0}, {"item": "c", "score": 0.9}]},
+        ],
+    )
+    assert out["itemScores"][0] == {"item": "a", "score": 0.5}
+    # c only appears in one algorithm: (0 + 0.9) / 2
+    assert {"item": "c", "score": 0.45} in out["itemScores"] or \
+        len(out["itemScores"]) == 2
+
+
+def test_min_rating_filters_all_raises():
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.models.als import PreparedRatings
+
+    pd = PreparedRatings(
+        user_ids=BiMap.string_int(["u"]), item_ids=BiMap.string_int(["i"]),
+        user_idx=np.array([0]), item_idx=np.array([0]),
+        ratings=np.array([1.0], dtype=np.float32),
+    )
+    algo = TwoTowerAlgorithm(TwoTowerParams(min_rating=3.0))
+    with pytest.raises(ValueError, match="nothing to train"):
+        algo.train(MeshContext(), pd)
