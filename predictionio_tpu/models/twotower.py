@@ -31,6 +31,7 @@ from predictionio_tpu.parallel.mesh import MeshContext
 @dataclass
 class TwoTowerParams(Params):
     dim: int = 64
+    embed_dim: Optional[int] = None   # id-embedding width (default: dim)
     hidden: Tuple[int, ...] = ()
     temperature: float = 0.07
     learning_rate: float = 3e-3
@@ -65,6 +66,7 @@ class TwoTowerAlgorithm(Algorithm):
             )
         cfg = TwoTowerConfig(
             dim=p.dim,
+            embed_dim=p.embed_dim,
             hidden=tuple(p.hidden),
             temperature=p.temperature,
             learning_rate=p.learning_rate,

@@ -275,13 +275,15 @@ def cmd_dashboard(args) -> int:
 # -- data / misc ---------------------------------------------------------------
 
 def cmd_import(args) -> int:
-    n = eventdata.import_events(args.appname, args.input, args.channel)
+    n = eventdata.import_events(args.appname, args.input, args.channel,
+                                format=args.format)
     _p(f"Imported {n} event(s).")
     return 0
 
 
 def cmd_export(args) -> int:
-    n = eventdata.export_events(args.appname, args.output, args.channel)
+    n = eventdata.export_events(args.appname, args.output, args.channel,
+                                format=args.format)
     _p(f"Exported {n} event(s).")
     return 0
 
@@ -405,16 +407,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=9000)
     p.set_defaults(func=cmd_dashboard)
 
-    p = sub.add_parser("import", help="import events from a JSONL file")
+    p = sub.add_parser("import", help="import events from a JSONL/parquet file")
     p.add_argument("--appname", required=True)
     p.add_argument("--input", required=True)
     p.add_argument("--channel", default=None)
+    p.add_argument("--format", default=None, choices=["json", "parquet"])
     p.set_defaults(func=cmd_import)
 
-    p = sub.add_parser("export", help="export events to a JSONL file")
+    p = sub.add_parser("export", help="export events to a JSONL/parquet file")
     p.add_argument("--appname", required=True)
     p.add_argument("--output", required=True)
     p.add_argument("--channel", default=None)
+    p.add_argument("--format", default=None, choices=["json", "parquet"])
     p.set_defaults(func=cmd_export)
 
     p = sub.add_parser("status", help="verify storage configuration")
@@ -434,11 +438,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
     try:
         return args.func(args)
-    except (CommandError, StorageError, FileNotFoundError) as e:
+    except (CommandError, StorageError, RuntimeError, FileNotFoundError, ValueError) as e:
         # operator errors (bad app name, unconfigured storage, no trained
-        # instance, missing engine.json) exit cleanly like the reference
-        # CLI; anything else (XLA/numpy RuntimeError/ValueError = genuine
-        # bugs) propagates with its traceback
+        # instance, malformed import line / engine.json) exit cleanly
+        # like the reference CLI; --verbose restores the traceback so
+        # framework bugs surfacing as ValueError/RuntimeError stay
+        # diagnosable
         if args.verbose:
             import traceback
 

@@ -113,6 +113,36 @@ class TestImportExport:
         events = memory_storage.events().find(app2.id)
         assert {e.entity_id for e in events} == {f"u{n}" for n in range(5)}
 
+    def test_parquet_round_trip(self, memory_storage, tmp_path):
+        info = commands.app_new("pqapp", storage=memory_storage)
+        for n in range(4):
+            memory_storage.events().insert(
+                Event(event="rate", entity_type="user", entity_id=f"u{n}",
+                      target_entity_type="item", target_entity_id="i1",
+                      properties={"rating": float(n), "tags_test": ["a", "b"]},
+                      tags=("t1", "t2"),
+                      event_time=dt.datetime(2026, 1, 1, 0, n, tzinfo=UTC)),
+                info.app.id)
+        # no target / no properties event too
+        memory_storage.events().insert(
+            Event(event="$set", entity_type="user", entity_id="u9",
+                  properties={"plan": "pro"},
+                  event_time=dt.datetime(2026, 1, 2, tzinfo=UTC)),
+            info.app.id)
+        out = tmp_path / "events.parquet"
+        assert eventdata.export_events("pqapp", str(out), storage=memory_storage) == 5
+
+        commands.app_new("pqapp2", storage=memory_storage)
+        assert eventdata.import_events("pqapp2", str(out), storage=memory_storage) == 5
+        app2 = memory_storage.apps().get_by_name("pqapp2")
+        events = {e.entity_id: e for e in memory_storage.events().find(app2.id)}
+        assert events["u2"].properties.get("rating") == 2.0
+        assert events["u2"].properties.get("tags_test") == ["a", "b"]
+        assert events["u2"].tags == ("t1", "t2")
+        assert events["u9"].event == "$set"
+        assert events["u9"].target_entity_type is None
+        assert events["u9"].event_time == dt.datetime(2026, 1, 2, tzinfo=UTC)
+
     def test_import_invalid_line(self, memory_storage, tmp_path):
         commands.app_new("bad", storage=memory_storage)
         f = tmp_path / "bad.jsonl"
