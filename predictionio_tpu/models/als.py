@@ -62,8 +62,14 @@ class ALSParams(Params):
     alpha: float = 1.0
     block_size: int = 4096
     seed: int = 3
-    max_ratings_per_user: Optional[int] = 512
-    max_ratings_per_item: Optional[int] = 2048
+    seg_len: int = 256                # virtual-row length (ops.ragged)
+    solver: str = "cg"               # "cg" | "direct"
+    cg_iters: int = 16
+    compute_dtype: str = "bfloat16"  # Gramian input dtype (f32 accumulate)
+    # optional hard caps (None = keep every rating; the segmented layout
+    # makes caps unnecessary except as an outlier guard)
+    max_ratings_per_user: Optional[int] = None
+    max_ratings_per_item: Optional[int] = None
 
 
 class ALSModel:
@@ -140,6 +146,10 @@ class ALSAlgorithm(Algorithm):
             alpha=p.alpha,
             block_size=p.block_size,
             seed=p.seed,
+            seg_len=p.seg_len,
+            solver=p.solver,
+            cg_iters=p.cg_iters,
+            compute_dtype=p.compute_dtype,
         )
         factors = als_train(
             (pd.user_idx, pd.item_idx, pd.ratings),

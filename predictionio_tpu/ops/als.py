@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from predictionio_tpu.ops.ragged import PaddedGroups, build_padded_groups, pad_to_multiple
+from predictionio_tpu.ops.ragged import SegmentedGroups, build_segmented_groups
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,27 +51,44 @@ class ALSConfig:
     seed: int = 7
     solver: str = "cg"        # "cg" (MXU-friendly, default) | "direct" (LU)
     cg_iters: int = 16        # CG steps; 16 reaches ~1e-3 rel err at K=64
+    compute_dtype: str = "bfloat16"  # gather/Gramian input dtype; accumulation
+                                     # is always f32 (MXU native bf16xbf16->f32)
+    seg_len: int = 256        # virtual-row length for the segmented layout
 
 
-def plan_blocks(n_groups: int, n_shards: int, block_size: int) -> Tuple[int, int]:
-    """(padded_group_count, block) so G = n_shards * n_blocks * block."""
-    per_shard = pad_to_multiple(max(1, -(-n_groups // n_shards)), 8)
-    block = min(block_size, per_shard)
-    per_shard = pad_to_multiple(per_shard, block)
-    return per_shard * n_shards, block
+def _build_side(
+    group_idx: np.ndarray,
+    item_idx: np.ndarray,
+    vals: np.ndarray,
+    n_groups: int,
+    cfg: ALSConfig,
+    n_shards: int,
+    max_len: Optional[int],
+) -> SegmentedGroups:
+    """Build one side's segmented layout (block planning lives in the
+    builder; both axes come back padded to exact block multiples)."""
+    return build_segmented_groups(
+        group_idx, item_idx, vals, n_groups, seg_len=cfg.seg_len,
+        max_len=max_len, n_shards=n_shards, block_size=cfg.block_size,
+    )
 
 
-def _batched_cg(A, b, iters: int):
+def _batched_cg(A, b, iters: int, x0=None):
     """Batched conjugate gradient for SPD K x K systems.
 
     TPU-shaped replacement for ``jnp.linalg.solve``: batched LU/Cholesky
     lowers poorly on TPU (~10x slower than the einsum work feeding it),
     while CG is pure batched matvecs the MXU eats. 16 iterations reach
     ~1e-3 relative error at K=64 — far below ALS's own convergence
-    tolerance.
+    tolerance. ``x0`` warm-starts from the previous outer iteration's
+    factors (they drift slowly), buying the same residual in fewer steps.
     """
-    x = jnp.zeros_like(b)
-    r = b
+    if x0 is None:
+        x = jnp.zeros_like(b)
+        r = b
+    else:
+        x = x0
+        r = b - jnp.einsum("bij,bj->bi", A, x0)
     p = r
     rs = jnp.einsum("bi,bi->b", r, r)
 
@@ -89,51 +106,104 @@ def _batched_cg(A, b, iters: int):
     return x
 
 
-def _solve_shard(Y, idx, val, mask, counts, *, rank, reg, implicit, alpha, block,
-                 solver, cg_iters):
-    """Solve all groups of one shard: [G_loc, L] -> [G_loc, K]."""
-    g_loc, L = idx.shape
-    nb = g_loc // block
-    idx = idx.reshape(nb, block, L)
-    val = val.reshape(nb, block, L)
-    mask = mask.reshape(nb, block, L)
-    counts = counts.reshape(nb, block)
-    eye = jnp.eye(rank, dtype=jnp.float32)
-    YtY = (Y.T @ Y) if implicit else None
+def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
+                 alpha, row_block, group_block, groups_loc, solver, cg_iters,
+                 compute_dtype):
+    """Solve all groups of one shard from segmented virtual rows.
+
+    Three stages, all static-shape:
+
+      1. per-row partial Gramians A_r = Yg^T Yg, b_r = Yg^T r over
+         fixed-length rows (``lax.map`` over row blocks bounds HBM).
+         The gather + einsums run in ``compute_dtype`` (bf16 by
+         default: native MXU input type, halves the HBM traffic of the
+         materialized [B, L, K] gather); accumulation stays float32.
+      2. segment-sum partials to groups (sorted local segment ids) —
+         Gramians are additive, so a group split across rows recombines
+         exactly; this is what removes the per-group length cap.
+      3. batched regularized solve per group block (CG warm-started
+         from the previous iteration's factors).
+    """
+    R_loc, L = idx.shape
+    nrb = R_loc // row_block
+    cdt = jnp.dtype(compute_dtype)
+    f32 = jnp.float32
+    Yc = Y.astype(cdt)
+
+    def partial_block(args):
+        idx_b, val_b, mask_b = args
+        Yg = Yc[idx_b] * mask_b[..., None].astype(cdt)  # [B, L, K] pad slots zeroed
+        if implicit:
+            # partials of: alpha * Yg^T diag(r) Yg  and  Yg^T (1 + alpha r)
+            A_r = alpha * jnp.einsum(
+                "blk,bl,blj->bkj", Yg, val_b.astype(cdt), Yg,
+                preferred_element_type=f32,
+            )
+            b_r = jnp.einsum(
+                "blk,bl->bk", Yg, ((1.0 + alpha * val_b) * mask_b).astype(cdt),
+                preferred_element_type=f32,
+            )
+        else:
+            A_r = jnp.einsum("blk,blj->bkj", Yg, Yg, preferred_element_type=f32)
+            b_r = jnp.einsum("blk,bl->bk", Yg, val_b.astype(cdt),
+                             preferred_element_type=f32)
+        return A_r, b_r
+
+    Ar, br = jax.lax.map(
+        partial_block,
+        (idx.reshape(nrb, row_block, L), val.reshape(nrb, row_block, L),
+         mask.reshape(nrb, row_block, L)),
+    )
+    Ar = Ar.reshape(R_loc, rank, rank)
+    br = br.reshape(R_loc, rank)
+
+    A = jax.ops.segment_sum(Ar, seg, num_segments=groups_loc,
+                            indices_are_sorted=True)
+    b = jax.ops.segment_sum(br, seg, num_segments=groups_loc,
+                            indices_are_sorted=True)
+
+    eye = jnp.eye(rank, dtype=f32)
+    YtY = (
+        jnp.einsum("lk,lj->kj", Yc, Yc, preferred_element_type=f32)
+        if implicit else None
+    )
+    ngb = groups_loc // group_block
+    A = A.reshape(ngb, group_block, rank, rank)
+    b = b.reshape(ngb, group_block, rank)
+    cnt = counts.reshape(ngb, group_block)
+    x0 = X_prev.reshape(ngb, group_block, rank)
 
     def solve_block(args):
-        idx_b, val_b, mask_b, cnt_b = args
-        Yg = Y[idx_b] * mask_b[..., None]          # [B, L, K] padded rows zeroed
+        A_b, b_b, cnt_b, x0_b = args
         if implicit:
-            # A = Y^T Y + alpha * Yg^T diag(r) Yg + reg*I ; b = Yg^T (1 + alpha r)
-            A = YtY + alpha * jnp.einsum("blk,bl,blj->bkj", Yg, val_b, Yg) + reg * eye
-            b = jnp.einsum("blk,bl->bk", Yg, (1.0 + alpha * val_b) * mask_b)
+            A_b = A_b + YtY + reg * eye
         else:
-            # ALS-WR: A = Yg^T Yg + reg * n_u * I ; b = Yg^T r
-            A = jnp.einsum("blk,blj->bkj", Yg, Yg)
-            n_u = jnp.maximum(cnt_b.astype(jnp.float32), 1.0)  # keep empty rows nonsingular
-            A = A + (reg * n_u)[:, None, None] * eye
-            b = jnp.einsum("blk,bl->bk", Yg, val_b)
+            # ALS-WR: reg * n_u * I ; empty groups stay nonsingular
+            n_u = jnp.maximum(cnt_b.astype(f32), 1.0)
+            A_b = A_b + (reg * n_u)[:, None, None] * eye
         if solver == "cg":
-            return _batched_cg(A, b, cg_iters)     # [B, K]
-        return jnp.linalg.solve(A, b[..., None])[..., 0]
+            return _batched_cg(A_b, b_b, cg_iters, x0=x0_b)   # [B, K]
+        return jnp.linalg.solve(A_b, b_b[..., None])[..., 0]
 
-    out = jax.lax.map(solve_block, (idx, val, mask, counts))  # [nb, B, K]
-    return out.reshape(g_loc, rank)
+    out = jax.lax.map(solve_block, (A, b, cnt, x0))  # [ngb, B, K]
+    return out.reshape(groups_loc, rank)
 
 
-def make_half_step(mesh: Optional[Mesh], cfg: ALSConfig, block: int):
+def make_half_step(mesh: Optional[Mesh], cfg: ALSConfig, row_block: int,
+                   group_block: int, groups_loc: int):
     """Compile one ALS half-step, sharded over the mesh ``data`` axis."""
     kwargs = dict(
-        rank=cfg.rank, reg=cfg.reg, implicit=cfg.implicit, alpha=cfg.alpha, block=block,
-        solver=cfg.solver, cg_iters=cfg.cg_iters,
+        rank=cfg.rank, reg=cfg.reg, implicit=cfg.implicit, alpha=cfg.alpha,
+        row_block=row_block, group_block=group_block, groups_loc=groups_loc,
+        solver=cfg.solver, cg_iters=cfg.cg_iters, compute_dtype=cfg.compute_dtype,
     )
     fn = functools.partial(_solve_shard, **kwargs)
     if mesh is not None and np.prod([mesh.shape[a] for a in mesh.axis_names]) > 1:
         fn = jax.shard_map(
             fn,
             mesh=mesh,
-            in_specs=(P(), P("data", None), P("data", None), P("data", None), P("data")),
+            in_specs=(P(), P("data", None), P("data", None), P("data", None),
+                      P("data", None), P("data"), P("data")),
             out_specs=P("data", None),
         )
     return jax.jit(fn)
@@ -175,21 +245,16 @@ class ALSTrainer:
         self.n_users, self.n_items = n_users, n_items
         n_shards = mesh.shape["data"] if mesh is not None else 1
 
-        self._g_users, block_u = plan_blocks(n_users, n_shards, cfg.block_size)
-        self._g_items, block_i = plan_blocks(n_items, n_shards, cfg.block_size)
-        # group_multiple == planned size pads the group axis straight to it
-        by_user = build_padded_groups(
-            u_idx, i_idx, vals, n_users, max_len=max_ratings_per_user,
-            group_multiple=self._g_users,
+        by_user = _build_side(
+            u_idx, i_idx, vals, n_users, cfg, n_shards, max_ratings_per_user
         )
-        by_item = build_padded_groups(
-            i_idx, u_idx, vals, n_items, max_len=max_ratings_per_item,
-            group_multiple=self._g_items,
+        by_item = _build_side(
+            i_idx, u_idx, vals, n_items, cfg, n_shards, max_ratings_per_item
         )
-        assert by_user.idx.shape[0] == self._g_users
-        assert by_item.idx.shape[0] == self._g_items
-        # entries actually processed per half-step after the per-group caps
-        # (rating-count truncation drops the tail of very long groups)
+        self._g_users = by_user.groups_per_shard * n_shards
+        self._g_items = by_item.groups_per_shard * n_shards
+        # entries actually processed per half-step (all of them unless an
+        # explicit max_ratings_per_* cap is set)
         self.kept_user_entries = int(by_user.counts.sum())
         self.kept_item_entries = int(by_item.counts.sum())
         self.total_entries = len(vals)
@@ -204,14 +269,20 @@ class ALSTrainer:
         self._X = X.at[n_users:].set(0.0) if self._g_users > n_users else X
         self._Y = Y.at[n_items:].set(0.0) if self._g_items > n_items else Y
 
-        self._user_step = make_half_step(mesh, cfg, block_u)
-        self._item_step = make_half_step(mesh, cfg, block_i)
+        self._user_step = make_half_step(
+            mesh, cfg, by_user.row_block, by_user.group_block,
+            by_user.groups_per_shard,
+        )
+        self._item_step = make_half_step(
+            mesh, cfg, by_item.row_block, by_item.group_block,
+            by_item.groups_per_shard,
+        )
         self._ud = self._to_device(by_user)
         self._it = self._to_device(by_item)
 
-    def _to_device(self, pg: PaddedGroups):
-        arrs = (jnp.asarray(pg.idx), jnp.asarray(pg.val), jnp.asarray(pg.mask),
-                jnp.asarray(pg.counts))
+    def _to_device(self, sg: SegmentedGroups):
+        arrs = (jnp.asarray(sg.idx), jnp.asarray(sg.val), jnp.asarray(sg.mask),
+                jnp.asarray(sg.seg), jnp.asarray(sg.counts))
         if self.mesh is not None:
             shardings = [
                 NamedSharding(self.mesh, P("data", None)) if a.ndim == 2
@@ -228,15 +299,15 @@ class ALSTrainer:
         ``block_until_ready`` can return before compilation/execution
         actually happens, so a host pull is the only reliable barrier.
         """
-        _force(self._user_step(self._Y, *self._ud))
-        _force(self._item_step(self._X, *self._it))
+        _force(self._user_step(self._Y, self._X, *self._ud))
+        _force(self._item_step(self._X, self._Y, *self._it))
         return self
 
     def run(self, iterations: Optional[int] = None) -> ALSFactors:
         X, Y = self._X, self._Y
         for _ in range(iterations if iterations is not None else self.cfg.iterations):
-            X = self._user_step(Y, *self._ud)
-            Y = self._item_step(X, *self._it)
+            X = self._user_step(Y, X, *self._ud)
+            Y = self._item_step(X, Y, *self._it)
         self._X, self._Y = X, Y
         return self.factors()  # np.asarray is the real sync barrier
 

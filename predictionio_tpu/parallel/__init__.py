@@ -14,6 +14,13 @@ from predictionio_tpu.parallel.mesh import (
     named_sharding,
     replicated,
 )
+from predictionio_tpu.parallel.multihost import (
+    all_hosts_sum,
+    global_array,
+    host_shard_by_entity,
+    host_shard_slice,
+    initialize_from_env,
+)
 
 __all__ = [
     "MeshContext",
@@ -21,4 +28,9 @@ __all__ = [
     "local_device_count",
     "named_sharding",
     "replicated",
+    "all_hosts_sum",
+    "global_array",
+    "host_shard_by_entity",
+    "host_shard_slice",
+    "initialize_from_env",
 ]

@@ -1,0 +1,149 @@
+"""Multi-host runtime: jax.distributed init + host-sharded data plane.
+
+The reference scales out through Spark's driver/executor runtime (each
+executor reads its HBase region slice, shuffles exchange blocks —
+SURVEY.md §2.9). The TPU-native equivalent (§7.9): every host runs this
+same program under a single-controller JAX runtime — `jax.distributed`
+coordinates over DCN, each host reads its own slice of the event store,
+and per-host arrays assemble into global `jax.Array`s over the full
+mesh so XLA collectives ride ICI within a slice and DCN across hosts.
+
+Single-host is the degenerate case (process_count == 1, every helper a
+cheap identity), so engines written against this module run unchanged
+from a laptop CPU mesh to a pod.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+from typing import Iterable, List, Optional, Sequence, TypeVar
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+_initialized = False
+
+
+def initialize_from_env() -> bool:
+    """Bring up jax.distributed from PIO_* / JAX env vars; idempotent.
+
+    Env contract (mirroring the reference's env-driven config shape,
+    conf/pio-env.sh.template):
+
+      PIO_COORDINATOR_ADDRESS  host:port of process 0 (required to opt in)
+      PIO_NUM_PROCESSES        world size
+      PIO_PROCESS_ID           this host's index
+
+    Returns True when running distributed (after this call), False for
+    single-process mode. JAX's own auto-detection (TPU pod metadata)
+    still applies when only PIO_COORDINATOR_ADDRESS is unset.
+    """
+    global _initialized
+    if _initialized:
+        return jax.process_count() > 1
+    addr = os.environ.get("PIO_COORDINATOR_ADDRESS")
+    if not addr:
+        return jax.process_count() > 1
+    num_s = os.environ.get("PIO_NUM_PROCESSES")
+    pid_s = os.environ.get("PIO_PROCESS_ID")
+    if num_s is None or pid_s is None:
+        raise RuntimeError(
+            "PIO_COORDINATOR_ADDRESS is set but PIO_NUM_PROCESSES / "
+            "PIO_PROCESS_ID are missing — all three are required for "
+            "multi-host mode"
+        )
+    num, pid = int(num_s), int(pid_s)
+    jax.distributed.initialize(
+        coordinator_address=addr, num_processes=num, process_id=pid
+    )
+    _initialized = True
+    logger.info("jax.distributed up: process %d/%d, %d global devices",
+                pid, num, jax.device_count())
+    return True
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def _stable_hash(s: str) -> int:
+    """Process-independent hash (builtin ``hash`` is salted per process)."""
+    return int.from_bytes(hashlib.md5(s.encode()).digest()[:8], "little")
+
+
+def host_shard_by_entity(
+    items: Iterable[T],
+    entity_id: "callable[[T], str]",
+    n_hosts: Optional[int] = None,
+    host: Optional[int] = None,
+) -> List[T]:
+    """This host's slice of an event/record stream, split by entity id.
+
+    Hash-partitioning on entity keeps all of one entity's events on one
+    host — the property PDataSources rely on for local aggregation
+    (the reference gets it from HBase rowkey prefix hashing,
+    hbase/HBEventsUtil.scala RowKey:81).
+    """
+    n = n_hosts if n_hosts is not None else process_count()
+    h = host if host is not None else process_index()
+    if n <= 1:
+        return list(items)
+    return [x for x in items if _stable_hash(entity_id(x)) % n == h]
+
+
+def host_shard_slice(n_total: int, n_hosts: Optional[int] = None,
+                     host: Optional[int] = None) -> slice:
+    """Contiguous [start, stop) slice of a length-``n_total`` axis owned
+    by this host (balanced to within 1)."""
+    n = n_hosts if n_hosts is not None else process_count()
+    h = host if host is not None else process_index()
+    base, extra = divmod(n_total, n)
+    start = h * base + min(h, extra)
+    return slice(start, start + base + (1 if h < extra else 0))
+
+
+def global_array(
+    local: np.ndarray,
+    mesh: Mesh,
+    *spec,
+) -> jax.Array:
+    """Assemble per-host shards into one global jax.Array.
+
+    ``local`` is this host's contiguous shard of axis 0 (as produced by
+    ``host_shard_slice``); ``spec`` is the PartitionSpec of the GLOBAL
+    array. Single-host: a plain device_put with that sharding.
+    """
+    sharding = NamedSharding(mesh, PartitionSpec(*spec))
+    if jax.process_count() == 1:
+        return jax.device_put(local, sharding)
+    return jax.make_array_from_process_local_data(sharding, local)
+
+
+def all_hosts_sum(x: np.ndarray, mesh: Mesh) -> np.ndarray:
+    """Sum a small host-local array across hosts (metadata reconciliation,
+    e.g. per-host event counts). Rides the mesh collectives so it works
+    wherever a mesh exists; trivial on one host."""
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    # every local device carries a copy of this host's x; the global sum
+    # over the device axis counts each host local_device_count times
+    stacked = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, PartitionSpec(mesh.axis_names[0])),
+        np.asarray(x)[None, ...].repeat(jax.local_device_count(), 0),
+    )
+    summed = jax.jit(
+        lambda a: a.sum(axis=0) / jax.local_device_count(),
+        out_shardings=NamedSharding(mesh, PartitionSpec()),
+    )(stacked)
+    return np.asarray(summed)

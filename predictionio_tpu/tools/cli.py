@@ -42,6 +42,8 @@ BUILTIN_TEMPLATES = {
     "ecommercerecommendation": "predictionio_tpu.templates.ecommerce",
     "classification": "predictionio_tpu.templates.classification",
     "vanilla": "predictionio_tpu.templates.vanilla",
+    "twotower": "predictionio_tpu.templates.twotower",
+    "twotower-hybrid": "predictionio_tpu.templates.twotower",
 }
 
 TEMPLATE_FACTORIES = {
@@ -50,6 +52,8 @@ TEMPLATE_FACTORIES = {
     "ecommercerecommendation": "ecommerce_engine",
     "classification": "classification_engine",
     "vanilla": "vanilla_engine",
+    "twotower": "twotower_engine",
+    "twotower-hybrid": "twotower_hybrid_engine",
 }
 
 

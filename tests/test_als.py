@@ -41,6 +41,54 @@ def test_padded_groups_basic():
     assert pg.mask[1].sum() == 0
 
 
+def test_segmented_groups_splits_long_groups():
+    from predictionio_tpu.ops.ragged import build_segmented_groups
+
+    # 3 groups: sizes 5, 0, 11; L=8 -> rows 1, 0, 2
+    g = np.array([0] * 5 + [2] * 11)
+    i = np.arange(16)
+    v = np.arange(16, dtype=float)
+    sg = build_segmented_groups(g, i, v, n_groups=3, seg_len=8)
+    assert sg.counts.tolist() == [5, 0, 11] + [0] * (len(sg.counts) - 3)
+    assert sg.idx[0, :5].tolist() == [0, 1, 2, 3, 4]
+    assert sg.idx[1].tolist() == list(range(5, 13))     # group 2 part 1
+    assert sg.idx[2, :3].tolist() == [13, 14, 15]       # group 2 part 2
+    assert sg.seg[:3].tolist() == [0, 2, 2]
+    # seg nondecreasing (sorted-scatter invariant), incl. padded rows
+    assert all(a <= b for a, b in zip(sg.seg, sg.seg[1:]))
+    assert sg.rows_per_shard % sg.row_block == 0
+    assert sg.groups_per_shard % sg.group_block == 0
+
+
+def test_segmented_groups_sharded_layout():
+    from predictionio_tpu.ops.ragged import build_segmented_groups
+
+    g = np.array([0] * 5 + [2] * 11)
+    i = np.arange(16)
+    v = np.ones(16, dtype=float)
+    sg = build_segmented_groups(g, i, v, n_groups=3, seg_len=8, n_shards=2)
+    # shard 0 owns groups [0, g_per_shard), shard 1 the rest; every
+    # shard sees the same (padded) row count and local segment ids
+    assert sg.idx.shape[0] == 2 * sg.rows_per_shard
+    s1 = slice(sg.rows_per_shard, 2 * sg.rows_per_shard)
+    for shard_seg in (sg.seg[: sg.rows_per_shard], sg.seg[s1]):
+        assert all(a <= b for a, b in zip(shard_seg, shard_seg[1:]))
+        assert shard_seg.max() < sg.groups_per_shard
+    # all 16 entries present exactly once
+    assert int(sg.mask.sum()) == 16
+
+
+def test_segmented_groups_max_len_keeps_latest():
+    from predictionio_tpu.ops.ragged import build_segmented_groups
+
+    g = np.zeros(10, dtype=int)
+    i = np.arange(10)
+    v = np.arange(10, dtype=float)
+    sg = build_segmented_groups(g, i, v, n_groups=1, seg_len=8, max_len=6)
+    assert sg.counts[0] == 6
+    assert sg.idx[0, :6].tolist() == [4, 5, 6, 7, 8, 9]
+
+
 def test_padded_groups_truncation_keeps_latest():
     g = np.zeros(10, dtype=int)
     i = np.arange(10)

@@ -1,0 +1,64 @@
+"""Multi-host runtime helpers (single-process degenerate paths + the
+pieces that are testable without real multi-process: stable hashing,
+slice balance, global array assembly on the 8-device CPU mesh)."""
+
+import numpy as np
+
+from predictionio_tpu.parallel.mesh import create_mesh
+from predictionio_tpu.parallel import multihost as mh
+
+
+def test_initialize_without_env_is_single_process(monkeypatch):
+    monkeypatch.delenv("PIO_COORDINATOR_ADDRESS", raising=False)
+    assert mh.initialize_from_env() is False
+    assert mh.process_count() == 1
+    assert mh.process_index() == 0
+
+
+def test_stable_hash_is_process_independent():
+    # regression pin: must never fall back to the salted builtin hash
+    assert mh._stable_hash("u1") == mh._stable_hash("u1")
+    assert mh._stable_hash("u1") != mh._stable_hash("u2")
+
+
+def test_host_shard_by_entity_partitions_completely():
+    events = [{"eid": f"u{n}"} for n in range(100)]
+    shards = [
+        mh.host_shard_by_entity(events, lambda e: e["eid"], n_hosts=4, host=h)
+        for h in range(4)
+    ]
+    total = [e["eid"] for s in shards for e in s]
+    assert sorted(total) == sorted(e["eid"] for e in events)
+    # same entity always lands on the same host
+    again = mh.host_shard_by_entity(events, lambda e: e["eid"], n_hosts=4, host=2)
+    assert [e["eid"] for e in again] == [e["eid"] for e in shards[2]]
+    # single host keeps everything
+    assert len(mh.host_shard_by_entity(events, lambda e: e["eid"],
+                                       n_hosts=1, host=0)) == 100
+
+
+def test_host_shard_slice_covers_and_balances():
+    for n_total in (0, 1, 7, 8, 100):
+        slices = [mh.host_shard_slice(n_total, n_hosts=3, host=h) for h in range(3)]
+        covered = []
+        for s in slices:
+            covered.extend(range(s.start, s.stop))
+        assert covered == list(range(n_total))
+        sizes = [s.stop - s.start for s in slices]
+        assert max(sizes) - min(sizes) <= 1
+
+
+def test_global_array_single_host_shards_over_mesh():
+    mesh = create_mesh({"data": 8})
+    x = np.arange(64, dtype=np.float32).reshape(16, 4)
+    arr = mh.global_array(x, mesh, "data", None)
+    assert arr.shape == (16, 4)
+    np.testing.assert_array_equal(np.asarray(arr), x)
+    # actually device-sharded: each of the 8 devices owns 2 rows
+    assert len(arr.sharding.device_set) == 8
+
+
+def test_all_hosts_sum_single_host_identity():
+    mesh = create_mesh({"data": 8})
+    x = np.array([3.0, 4.0])
+    np.testing.assert_array_equal(mh.all_hosts_sum(x, mesh), x)

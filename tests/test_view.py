@@ -1,0 +1,73 @@
+"""Batch view filter/fold surface (ref: view/LBatchView.scala behavior)."""
+
+import datetime as dt
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data.view import BatchView, EventSeq, datamap_aggregator
+
+UTC = dt.timezone.utc
+T0 = dt.datetime(2026, 1, 1, tzinfo=UTC)
+
+
+def _ev(event, eid, props=None, minutes=0, etype="user"):
+    return Event(event=event, entity_type=etype, entity_id=eid,
+                 properties=props or {},
+                 event_time=T0 + dt.timedelta(minutes=minutes))
+
+
+def test_eventseq_filters_compose():
+    seq = EventSeq([
+        _ev("rate", "u1", minutes=0),
+        _ev("buy", "u1", minutes=5),
+        _ev("rate", "u2", minutes=10, etype="account"),
+        _ev("rate", "u3", minutes=20),
+    ])
+    assert len(seq.filter(event="rate")) == 3
+    assert len(seq.filter(event="rate", entity_type="user")) == 2
+    # half-open [start, until): start inclusive, until exclusive
+    win = seq.filter(start_time=T0 + dt.timedelta(minutes=5),
+                     until_time=T0 + dt.timedelta(minutes=20))
+    assert [e.entity_id for e in win] == ["u1", "u2"]
+    assert len(seq.filter(predicate=lambda e: e.entity_id == "u3")) == 1
+
+
+def test_aggregate_by_entity_ordered_is_time_sorted():
+    # insert out of order; fold must see event-time order
+    seq = EventSeq([
+        _ev("$set", "u1", {"a": 2}, minutes=10),
+        _ev("$set", "u1", {"a": 1}, minutes=0),
+    ])
+    out = seq.aggregate_by_entity_ordered([], lambda acc, e: acc + [e.properties["a"]])
+    assert out["u1"] == [1, 2]
+
+
+def test_datamap_aggregator_set_unset_delete():
+    op = datamap_aggregator()
+    p = op(None, _ev("$set", "u", {"a": 1, "b": 2}))
+    p = op(p, _ev("$set", "u", {"b": 3, "c": 4}))
+    assert p == {"a": 1, "b": 3, "c": 4}
+    p = op(p, _ev("$unset", "u", {"a": 0}))
+    assert p == {"b": 3, "c": 4}
+    p = op(p, _ev("rate", "u", {"x": 9}))      # non-$ events don't touch props
+    assert p == {"b": 3, "c": 4}
+    assert op(p, _ev("$delete", "u")) is None
+    assert op(None, _ev("$unset", "u", {"a": 0})) is None
+
+
+def test_batch_view_aggregate_properties(memory_storage):
+    app = memory_storage.apps().insert("viewapp")
+    memory_storage.events().init(app.id)
+    for e in [
+        _ev("$set", "u1", {"plan": "free"}, minutes=0),
+        _ev("$set", "u1", {"plan": "pro"}, minutes=5),
+        _ev("$set", "u2", {"plan": "free"}, minutes=6),
+        _ev("$delete", "u2", minutes=7),
+        _ev("$set", "i1", {"cat": "a"}, minutes=1, etype="item"),
+    ]:
+        memory_storage.events().insert(e, app.id)
+    view = BatchView("viewapp", storage=memory_storage)
+    props = view.aggregate_properties(entity_type="user")
+    assert props == {"u1": {"plan": "pro"}}       # u2 deleted
+    assert view.aggregate_properties(entity_type="item") == {"i1": {"cat": "a"}}
+    # unfiltered: both entity types
+    assert set(view.aggregate_properties()) == {"u1", "i1"}
