@@ -66,6 +66,7 @@ class ALSParams(Params):
     solver: str = "cg"               # "cg" | "direct"
     cg_iters: int = 16
     compute_dtype: str = "bfloat16"  # Gramian input dtype (f32 accumulate)
+    use_pallas: str = "never"        # fused gather+Gramian kernel (ops.gramian)
     # optional hard caps (None = keep every rating; the segmented layout
     # makes caps unnecessary except as an outlier guard)
     max_ratings_per_user: Optional[int] = None
@@ -150,6 +151,7 @@ class ALSAlgorithm(Algorithm):
             solver=p.solver,
             cg_iters=p.cg_iters,
             compute_dtype=p.compute_dtype,
+            use_pallas=p.use_pallas,
         )
         factors = als_train(
             (pd.user_idx, pd.item_idx, pd.ratings),

@@ -54,6 +54,12 @@ class ALSConfig:
     compute_dtype: str = "bfloat16"  # gather/Gramian input dtype; accumulation
                                      # is always f32 (MXU native bf16xbf16->f32)
     seg_len: int = 256        # virtual-row length for the segmented layout
+    use_pallas: str = "never"  # "never" | "auto" | "always" — fused
+                               # gather+Gramian kernel (ops.gramian) for
+                               # the partial stage when the opposing
+                               # table fits VMEM; "auto" gates on a TPU
+                               # backend, "always" uses the interpreter
+                               # elsewhere (tests)
 
 
 def _build_side(
@@ -108,7 +114,7 @@ def _batched_cg(A, b, iters: int, x0=None):
 
 def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
                  alpha, row_block, group_block, groups_loc, solver, cg_iters,
-                 compute_dtype):
+                 compute_dtype, pallas_mode=0):
     """Solve all groups of one shard from segmented virtual rows.
 
     Three stages, all static-shape:
@@ -129,6 +135,16 @@ def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
     cdt = jnp.dtype(compute_dtype)
     f32 = jnp.float32
     Yc = Y.astype(cdt)
+
+    if pallas_mode:  # 1 = compiled kernel, 2 = interpreter (tests)
+        from predictionio_tpu.ops.gramian import rowwise_gramians
+
+        Ar, br = rowwise_gramians(Yc, idx, val, mask,
+                                  interpret=pallas_mode == 2)
+        return _solve_groups(Ar, br, X_prev, seg, counts, Yc, rank=rank,
+                             reg=reg, implicit=implicit, group_block=group_block,
+                             groups_loc=groups_loc, solver=solver,
+                             cg_iters=cg_iters)
 
     def partial_block(args):
         idx_b, val_b, mask_b = args
@@ -156,7 +172,15 @@ def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
     )
     Ar = Ar.reshape(R_loc, rank, rank)
     br = br.reshape(R_loc, rank)
+    return _solve_groups(Ar, br, X_prev, seg, counts, Yc, rank=rank, reg=reg,
+                         implicit=implicit, group_block=group_block,
+                         groups_loc=groups_loc, solver=solver, cg_iters=cg_iters)
 
+
+def _solve_groups(Ar, br, X_prev, seg, counts, Yc, *, rank, reg, implicit,
+                  group_block, groups_loc, solver, cg_iters):
+    """Stages 2+3: segment-sum row partials to groups, regularize, solve."""
+    f32 = jnp.float32
     A = jax.ops.segment_sum(Ar, seg, num_segments=groups_loc,
                             indices_are_sorted=True)
     b = jax.ops.segment_sum(br, seg, num_segments=groups_loc,
@@ -189,13 +213,35 @@ def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
     return out.reshape(groups_loc, rank)
 
 
+def _pallas_mode(cfg: ALSConfig, n_table_rows: Optional[int]) -> int:
+    """0 = XLA path, 1 = compiled Pallas kernel, 2 = interpreter."""
+    if cfg.use_pallas not in ("never", "auto", "always"):
+        raise ValueError(
+            f"use_pallas must be 'never', 'auto' or 'always', got "
+            f"{cfg.use_pallas!r}"
+        )
+    if cfg.use_pallas == "never" or n_table_rows is None:
+        return 0
+    from predictionio_tpu.ops.gramian import supported
+
+    dtype_bytes = jnp.dtype(cfg.compute_dtype).itemsize
+    if not supported(n_table_rows, cfg.rank, cfg.implicit, dtype_bytes):
+        return 0
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    if on_tpu:
+        return 1
+    return 2 if cfg.use_pallas == "always" else 0
+
+
 def make_half_step(mesh: Optional[Mesh], cfg: ALSConfig, row_block: int,
-                   group_block: int, groups_loc: int):
+                   group_block: int, groups_loc: int,
+                   n_table_rows: Optional[int] = None):
     """Compile one ALS half-step, sharded over the mesh ``data`` axis."""
     kwargs = dict(
         rank=cfg.rank, reg=cfg.reg, implicit=cfg.implicit, alpha=cfg.alpha,
         row_block=row_block, group_block=group_block, groups_loc=groups_loc,
         solver=cfg.solver, cg_iters=cfg.cg_iters, compute_dtype=cfg.compute_dtype,
+        pallas_mode=_pallas_mode(cfg, n_table_rows),
     )
     fn = functools.partial(_solve_shard, **kwargs)
     if mesh is not None and np.prod([mesh.shape[a] for a in mesh.axis_names]) > 1:
@@ -271,11 +317,11 @@ class ALSTrainer:
 
         self._user_step = make_half_step(
             mesh, cfg, by_user.row_block, by_user.group_block,
-            by_user.groups_per_shard,
+            by_user.groups_per_shard, n_table_rows=self._g_items,
         )
         self._item_step = make_half_step(
             mesh, cfg, by_item.row_block, by_item.group_block,
-            by_item.groups_per_shard,
+            by_item.groups_per_shard, n_table_rows=self._g_users,
         )
         self._ud = self._to_device(by_user)
         self._it = self._to_device(by_item)

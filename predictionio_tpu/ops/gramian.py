@@ -1,0 +1,131 @@
+"""Fused gather + Gramian Pallas kernel for ALS partial solves.
+
+The hottest loop of ALS training (ops.als stage 1) is, per virtual row:
+gather L opposing-factor rows Y[idx] and reduce them to a K x K Gramian
+A = Yg^T Yg and a K-vector b = Yg^T v. The XLA path materializes the
+gathered [rows, L, K] tensor to HBM between the gather and the einsum
+(gather and dot-general do not fuse), paying ~2 x rows*L*K of HBM
+traffic. This kernel keeps the whole factor table VMEM-resident across
+the grid (BlockSpec with a constant index map), streams each row's L
+gathers VMEM->VMEM into a scratch tile, and feeds the MXU directly —
+the gathered tensor never exists in HBM.
+
+Applicability (checked by ``supported``): explicit-feedback solves with
+an opposing table small enough for VMEM (items side of typical
+recommender workloads: e.g. 27k x 64 f32 = 7 MB). The implicit path and
+huge tables fall back to the XLA einsum path in ops.als.
+
+See /opt/skills/guides/pallas_guide.md for the kernel idioms used here.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# leave headroom for scratch, outputs and double buffering in ~16 MB VMEM
+VMEM_TABLE_BUDGET_BYTES = 8 * 1024 * 1024
+
+
+def supported(n_table_rows: int, rank: int, implicit: bool,
+              table_dtype_bytes: int = 4) -> bool:
+    """Whether the kernel applies: explicit solves, table fits VMEM,
+    MXU-friendly rank."""
+    return (
+        not implicit
+        and n_table_rows * rank * table_dtype_bytes <= VMEM_TABLE_BUDGET_BYTES
+        and rank % 8 == 0
+    )
+
+
+def _kernel(idx_ref, val_ref, mask_ref, y_ref, A_ref, b_ref, yg_scratch):
+    """One grid step: TR rows' Gramians.
+
+    idx_ref  [TR, L] int32 (SMEM)   gather indices
+    val_ref  [TR, L] f32            ratings (0 on padding)
+    mask_ref [TR, L] f32            1/0 validity
+    y_ref    [G, K]                 the full factor table (VMEM-resident)
+    A_ref    [TR, K, K] f32 out     Yg^T Yg
+    b_ref    [TR, K]    f32 out     Yg^T v
+    yg_scratch [L, K]               gathered rows
+    """
+    TR, L = val_ref.shape
+
+    for r in range(TR):  # static unroll over the program's rows
+        def gather_one(l, _):
+            i = idx_ref[r, l]
+            # cast back: f32 mask * bf16 row promotes to f32, which the
+            # bf16 scratch ref would reject at trace time
+            yg_scratch[pl.ds(l, 1), :] = (
+                y_ref[pl.ds(i, 1), :] * mask_ref[r, l]
+            ).astype(yg_scratch.dtype)
+            return 0
+
+        jax.lax.fori_loop(0, L, gather_one, 0)
+        yg = yg_scratch[:]
+        A_ref[r] = jax.lax.dot_general(
+            yg, yg, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        b_ref[r] = jnp.dot(val_ref[r, :], yg, preferred_element_type=jnp.float32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("rows_per_program", "interpret")
+)
+def rowwise_gramians(
+    Y: jax.Array,      # [G, K] float32/bfloat16
+    idx: jax.Array,    # [R, L] int32
+    val: jax.Array,    # [R, L] float32
+    mask: jax.Array,   # [R, L] float32
+    rows_per_program: int = 8,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """(A [R, K, K] f32, b [R, K] f32) — fused gather+Gramian partials.
+
+    ``interpret=True`` runs the Pallas interpreter (CPU tests)."""
+    R, L = idx.shape
+    G, K = Y.shape
+    TR = rows_per_program
+    while R % TR:
+        TR //= 2
+    TR = max(TR, 1)
+
+    grid = (R // TR,)
+    return pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TR, L), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((TR, L), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((TR, L), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            # constant index map: the table stays loaded across the grid
+            pl.BlockSpec((G, K), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((TR, K, K), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TR, K), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, K, K), jnp.float32),
+            jax.ShapeDtypeStruct((R, K), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((L, K), Y.dtype)],
+        interpret=interpret,
+    )(idx, val, mask, Y)
+
+
+def rowwise_gramians_xla(
+    Y: jax.Array, idx: jax.Array, val: jax.Array, mask: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Reference XLA implementation (gather + einsum) for testing."""
+    Yg = Y[idx] * mask[..., None]
+    A = jnp.einsum("rlk,rlj->rkj", Yg, Yg, preferred_element_type=jnp.float32)
+    b = jnp.einsum("rlk,rl->rk", Yg, val, preferred_element_type=jnp.float32)
+    return A, b

@@ -292,6 +292,28 @@ def cmd_export(args) -> int:
     return 0
 
 
+def cmd_shell(args) -> int:
+    """REPL with storage + event store + mesh context bound
+    (ref: bin/pio-shell — a Spark shell on the PIO classpath)."""
+    import code
+
+    from predictionio_tpu.data import store
+    from predictionio_tpu.parallel.mesh import MeshContext
+
+    ns = {
+        "storage": get_storage(),
+        "store": store,
+        "ctx": MeshContext(),
+        "commands": commands,
+    }
+    banner = (
+        "predictionio-tpu shell — bound: storage (Storage), store "
+        "(PEventStore/LEventStore API), ctx (MeshContext), commands"
+    )
+    code.interact(banner=banner, local=ns)
+    return 0
+
+
 def cmd_status(args) -> int:
     results = commands.status()
     ok = all(results.values())
@@ -427,6 +449,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("status", help="verify storage configuration")
     p.set_defaults(func=cmd_status)
+
+    p = sub.add_parser("shell", help="interactive Python shell with the "
+                                     "framework preloaded (ref: bin/pio-shell)")
+    p.set_defaults(func=cmd_shell)
 
     p_t = sub.add_parser("template", help="list or scaffold templates")
     t_sub = p_t.add_subparsers(dest="template_command", required=True)
