@@ -100,6 +100,12 @@ class EngineServer(HTTPServerBase):
         self._deployment_lock = threading.Lock()
         self.deployment: Deployment = self._load_latest()
 
+        # daily version check, no-op unless PIO_UPDATE_URL is configured
+        # (ref: UpgradeActor, CreateServer.scala:163-170,246)
+        from predictionio_tpu.tools.upgrade import start_upgrade_daemon
+
+        start_upgrade_daemon("engine-server")
+
         # bind retry x3 with 1s backoff (ref: CreateServer.scala:340-350)
         super().__init__(host, port, _EngineRequestHandler, bind_retries=bind_retries)
 

@@ -1,0 +1,59 @@
+"""Opt-in version check.
+
+Behavior contract from the reference: ``WorkflowUtils.checkUpgrade``
+(workflow/WorkflowUtils.scala:220) and the engine server's daily
+``UpgradeActor`` (workflow/CreateServer.scala:163-170,246) phone
+``update.prediction.io`` to compare versions. Here the check is **off by
+default** (no egress unless the operator sets ``PIO_UPDATE_URL``), never
+raises, and never blocks callers for more than a couple of seconds.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import urllib.request
+
+from predictionio_tpu import __version__
+
+log = logging.getLogger(__name__)
+
+
+def check_upgrade(component: str = "pio", timeout: float = 2.0) -> None:
+    """Compare ``__version__`` against the JSON at ``PIO_UPDATE_URL``.
+
+    Expected payload: ``{"version": "X.Y.Z"}``. Logs (never raises); a
+    no-op when PIO_UPDATE_URL is unset.
+    """
+    url = os.environ.get("PIO_UPDATE_URL", "")
+    if not url:
+        return
+    try:
+        with urllib.request.urlopen(f"{url}?component={component}", timeout=timeout) as r:
+            latest = json.loads(r.read().decode("utf-8")).get("version", "")
+        if latest and latest != __version__:
+            log.info(
+                "a newer version is available: %s (running %s)", latest, __version__
+            )
+    except Exception as exc:  # network failure must never affect the caller
+        log.debug("version check skipped: %s", exc)
+
+
+def start_upgrade_daemon(component: str = "pio", interval_sec: float = 86400.0) -> None:
+    """Daily background check (ref: UpgradeActor, CreateServer.scala:246).
+
+    A daemon thread; exits with the process. No-op unless PIO_UPDATE_URL set.
+    """
+    if not os.environ.get("PIO_UPDATE_URL"):
+        return
+
+    def loop() -> None:
+        import time
+
+        while True:
+            check_upgrade(component)
+            time.sleep(interval_sec)
+
+    threading.Thread(target=loop, name="pio-upgrade-check", daemon=True).start()

@@ -1,0 +1,110 @@
+"""FakeWorkflow: run an arbitrary function through the evaluation plumbing.
+
+Behavior contract from the reference (workflow/FakeWorkflow.scala):
+
+  - ``FakeRun`` (FakeWorkflow.scala:66) wraps a ``SparkContext => Unit``
+    function as an Evaluation so tests/templates can exercise the full
+    evaluation harness (instance bookkeeping, evaluator dispatch)
+    without a real engine.  Here the function takes the SparkContext
+    analogue, a :class:`~predictionio_tpu.parallel.mesh.MeshContext`.
+  - ``FakeEvalResult`` (FakeWorkflow.scala:47) carries ``noSave=true``
+    (:60) so CoreWorkflow skips persisting evaluator results.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Tuple
+
+from predictionio_tpu.core.controller import DataSource, IdentityPreparator, Algorithm, Serving
+from predictionio_tpu.core.engine import Engine
+from predictionio_tpu.core.evaluation import Evaluation, Metric
+from predictionio_tpu.core.params import EngineParams
+from predictionio_tpu.parallel.mesh import MeshContext
+
+
+@dataclass
+class FakeEvalResult:
+    """ref: FakeWorkflow.scala:47 — result with no_save so nothing persists."""
+
+    no_save: bool = True
+
+    def to_one_liner(self) -> str:
+        return "FakeEvalResult"
+
+    def to_json(self) -> str:
+        return '"FakeEvalResult"'
+
+    def to_html(self) -> str:
+        return "FakeEvalResult"
+
+
+class _FakeDataSource(DataSource):
+    def read_training(self, ctx):
+        return None
+
+    def read_eval(self, ctx):
+        # one empty fold so Engine.eval traverses the full pipeline
+        return [(None, None, [])]
+
+
+class _FakeAlgorithm(Algorithm):
+    def train(self, ctx, prepared_data):
+        return None
+
+    def predict(self, model, query):
+        return None
+
+
+class _FakeServing(Serving):
+    def serve(self, query, predictions):
+        return None
+
+
+class _FakeMetric(Metric):
+    """Runs the wrapped function when the evaluator computes the score
+    (ref: FakeRun routing the fn through evaluateBase, FakeWorkflow.scala:36)."""
+
+    def __init__(self, fn: Callable[[MeshContext], Any]):
+        self.fn = fn
+        self.result: Any = None
+
+    def calculate(self, ctx: MeshContext, eval_data) -> float:
+        self.result = self.fn(ctx)
+        return 0.0
+
+    def header(self) -> str:
+        return "FakeRun"
+
+
+class FakeRun:
+    """ref: FakeWorkflow.scala:66 — evaluation wrapper around a plain function.
+
+    Usage::
+
+        out = FakeRun(lambda ctx: do_stuff(ctx)).run()
+    """
+
+    def __init__(self, fn: Callable[[MeshContext], Any]):
+        self.metric = _FakeMetric(fn)
+        engine = Engine(
+            data_source_classes=_FakeDataSource,
+            preparator_classes=IdentityPreparator,
+            algorithm_classes=_FakeAlgorithm,
+            serving_classes=_FakeServing,
+        )
+        self.evaluation = Evaluation(engine=engine, metric=self.metric)
+
+    def run(self, ctx: Optional[MeshContext] = None) -> Any:
+        """Run through MetricEvaluator + Engine.eval; return fn's result."""
+        from predictionio_tpu.core.evaluation import MetricEvaluator
+
+        ctx = ctx or MeshContext()
+        ep = EngineParams(algorithm_params_list=[("", None)])
+        MetricEvaluator().evaluate(ctx, self.evaluation, [ep], eval_fn=None)
+        return self.metric.result
+
+
+def fake_run(fn: Callable[[MeshContext], Any], ctx: Optional[MeshContext] = None) -> Any:
+    """Convenience: ``fake_run(lambda ctx: ...)`` — ref FakeWorkflow.scala:36."""
+    return FakeRun(fn).run(ctx)
