@@ -1,0 +1,382 @@
+"""``eventlog`` storage backend: C++ append-only event log.
+
+The scale-out EVENTDATA tier — the role HBase plays in the reference
+(conf/pio-env.sh.template:43 makes HBase the default event store; scans
+come from hbase/HBEventsUtil.scala:286 partial-rowkey + column filters).
+Events live in a native append-only log with an in-memory index
+(predictionio_tpu/native/eventlog.cpp); metadata/model repositories
+delegate to the localfs backend rooted at the same path, mirroring how
+the reference pairs HBase (events) with Elasticsearch (metadata).
+
+Config (PIO_STORAGE_SOURCES_<NAME>_*):
+  TYPE=eventlog
+  PATH=<base dir>         (default ~/.pio_store/eventlog)
+  FSYNC=1                 (optional: fdatasync per append batch)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import datetime as _dt
+import hashlib
+import json
+import os
+import shutil
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from predictionio_tpu.data import storage as S
+from predictionio_tpu.data.backends.localfs import LocalFSStorageClient
+from predictionio_tpu.data.datamap import DataMap
+from predictionio_tpu.data.event import Event
+
+UTC = _dt.timezone.utc
+_EPOCH = _dt.datetime(1970, 1, 1, tzinfo=UTC)
+_US = _dt.timedelta(microseconds=1)
+_I64_MIN = -(2**63)
+_I64_MAX = 2**63 - 1
+_ABSENT = 0xFFFF
+
+
+class _FindReq(ctypes.Structure):
+    _fields_ = [
+        ("start_us", ctypes.c_int64),
+        ("until_us", ctypes.c_int64),
+        ("entity_type", ctypes.c_char_p),
+        ("entity_id", ctypes.c_char_p),
+        ("target_type_mode", ctypes.c_int32),
+        ("target_id_mode", ctypes.c_int32),
+        ("target_entity_type", ctypes.c_char_p),
+        ("target_entity_id", ctypes.c_char_p),
+        ("event_names", ctypes.c_char_p),
+        ("n_event_names", ctypes.c_int32),
+        ("reversed", ctypes.c_int32),
+        ("limit", ctypes.c_int64),
+    ]
+
+
+def _load():
+    from predictionio_tpu import native
+
+    lib = native.load_library("eventlog")
+    lib.el_open.restype = ctypes.c_void_p
+    lib.el_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.el_close.argtypes = [ctypes.c_void_p]
+    lib.el_count.restype = ctypes.c_int64
+    lib.el_count.argtypes = [ctypes.c_void_p]
+    lib.el_append_batch.restype = ctypes.c_int64
+    lib.el_append_batch.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.el_delete.restype = ctypes.c_int
+    lib.el_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.el_get.restype = ctypes.c_int64
+    lib.el_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+    lib.el_find.restype = ctypes.c_int64
+    lib.el_find.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(_FindReq),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.el_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    return lib
+
+
+# ---------------------------------------------------------------------------
+# record (de)serialization — wire format documented in eventlog.cpp
+# ---------------------------------------------------------------------------
+
+def _id16(event_id: str) -> bytes:
+    """32-hex ids (the framework's uuid4().hex) map to their raw bytes;
+    anything else maps through MD5 — same trick as the reference's
+    rowkey MD5(entityType-entityId) (HBEventsUtil.scala:96)."""
+    try:
+        raw = bytes.fromhex(event_id)
+        if len(raw) == 16:
+            return raw
+    except ValueError:
+        pass
+    return hashlib.md5(event_id.encode("utf-8")).digest()
+
+
+def _us(t: _dt.datetime) -> int:
+    return (t.astimezone(UTC) - _EPOCH) // _US
+
+
+def _pack(e: Event) -> bytes:
+    # extra carries everything the filterable header doesn't: properties,
+    # tags, prId, exact ISO times (tz offsets survive the round trip),
+    # and the original id when it isn't canonical 16-byte hex
+    extra: Dict[str, Any] = {
+        "et": e.event_time.isoformat(),
+        "ct": e.creation_time.isoformat(),
+    }
+    if len(e.properties):
+        extra["p"] = e.properties.to_dict()
+    if e.tags:
+        extra["t"] = list(e.tags)
+    if e.pr_id is not None:
+        extra["pr"] = e.pr_id
+    id16 = _id16(e.event_id)
+    if id16.hex() != e.event_id:
+        extra["id"] = e.event_id
+    extra_b = json.dumps(extra, separators=(",", ":")).encode("utf-8")
+
+    ev = e.event.encode("utf-8")
+    et = e.entity_type.encode("utf-8")
+    ei = e.entity_id.encode("utf-8")
+    tt = e.target_entity_type.encode("utf-8") if e.target_entity_type is not None else None
+    ti = e.target_entity_id.encode("utf-8") if e.target_entity_id is not None else None
+
+    body = struct.pack(
+        "<16sqqHHHHHI",
+        id16,
+        _us(e.event_time),
+        _us(e.creation_time),
+        len(ev),
+        len(et),
+        len(ei),
+        _ABSENT if tt is None else len(tt),
+        _ABSENT if ti is None else len(ti),
+        len(extra_b),
+    ) + ev + et + ei + (tt or b"") + (ti or b"") + extra_b
+    return struct.pack("<I", len(body)) + body
+
+
+def _unpack_records(buf: bytes) -> List[Event]:
+    events = []
+    off = 0
+    n = len(buf)
+    while off + 4 <= n:
+        (rlen,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        id16, t_us, c_us, l_ev, l_et, l_ei, l_tt, l_ti, l_ex = struct.unpack_from(
+            "<16sqqHHHHHI", buf, off
+        )
+        p = off + 46
+        ev = buf[p : p + l_ev].decode("utf-8"); p += l_ev
+        et = buf[p : p + l_et].decode("utf-8"); p += l_et
+        ei = buf[p : p + l_ei].decode("utf-8"); p += l_ei
+        if l_tt != _ABSENT:
+            tt = buf[p : p + l_tt].decode("utf-8"); p += l_tt
+        else:
+            tt = None
+        if l_ti != _ABSENT:
+            ti = buf[p : p + l_ti].decode("utf-8"); p += l_ti
+        else:
+            ti = None
+        extra = json.loads(buf[p : p + l_ex].decode("utf-8")) if l_ex else {}
+        off += rlen
+
+        event_time = (
+            _dt.datetime.fromisoformat(extra["et"])
+            if "et" in extra
+            else _EPOCH + t_us * _US
+        )
+        creation_time = (
+            _dt.datetime.fromisoformat(extra["ct"])
+            if "ct" in extra
+            else _EPOCH + c_us * _US
+        )
+        events.append(
+            Event(
+                event=ev,
+                entity_type=et,
+                entity_id=ei,
+                target_entity_type=tt,
+                target_entity_id=ti,
+                properties=DataMap(extra.get("p") or {}),
+                event_time=event_time,
+                tags=tuple(extra.get("t") or ()),
+                pr_id=extra.get("pr"),
+                event_id=extra.get("id") or id16.hex(),
+                creation_time=creation_time,
+            )
+        )
+    return events
+
+
+# ---------------------------------------------------------------------------
+# EventStore over the native log
+# ---------------------------------------------------------------------------
+
+class EventLogEventStore(S.EventStore):
+    def __init__(self, base_path: str, fsync: bool = False):
+        self._lib = _load()
+        self._base = base_path
+        self._fsync = fsync
+        self._handles: Dict[Tuple[int, Optional[int]], int] = {}
+        self._lock = threading.Lock()
+        os.makedirs(base_path, exist_ok=True)
+
+    def _dir(self, app_id: int, channel_id: Optional[int]) -> str:
+        name = f"events_{app_id}" if channel_id is None else f"events_{app_id}_{channel_id}"
+        return os.path.join(self._base, name)
+
+    def _handle(self, app_id: int, channel_id: Optional[int], create: bool = False) -> int:
+        key = (app_id, channel_id)
+        with self._lock:
+            h = self._handles.get(key)
+            if h:
+                return h
+            path = self._dir(app_id, channel_id)
+            if not create and not os.path.isdir(path):
+                raise S.StorageError(
+                    f"event log for app {app_id} channel {channel_id} not initialized"
+                )
+            h = self._lib.el_open(path.encode(), 1 if self._fsync else 0)
+            if not h:
+                raise S.StorageError(
+                    f"cannot open event log at {path} (is another process "
+                    "holding its LOCK? concurrent access goes through the "
+                    "event server REST API)"
+                )
+            self._handles[key] = h
+            return h
+
+    def init(self, app_id, channel_id=None):
+        self._handle(app_id, channel_id, create=True)
+
+    def remove(self, app_id, channel_id=None):
+        key = (app_id, channel_id)
+        with self._lock:
+            h = self._handles.pop(key, None)
+            if h:
+                self._lib.el_close(h)
+            shutil.rmtree(self._dir(app_id, channel_id), ignore_errors=True)
+
+    def insert(self, event: Event, app_id, channel_id=None) -> str:
+        return self.insert_batch([event], app_id, channel_id)[0]
+
+    def insert_batch(self, events, app_id, channel_id=None) -> List[str]:
+        h = self._handle(app_id, channel_id)
+        out_ids: List[str] = []
+        parts: List[bytes] = []
+        for e in events:
+            e = e if e.event_id else e.with_id()
+            out_ids.append(e.event_id)
+            parts.append(_pack(e))
+        buf = b"".join(parts)
+        n = self._lib.el_append_batch(h, buf, len(buf))
+        if n != len(events):
+            raise S.StorageError(f"append failed ({n} of {len(events)} written)")
+        return out_ids
+
+    def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
+        h = self._handle(app_id, channel_id)
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        nbytes = self._lib.el_get(h, _id16(event_id), ctypes.byref(out))
+        if nbytes <= 0:
+            return None
+        try:
+            buf = ctypes.string_at(out, nbytes)
+        finally:
+            self._lib.el_free(out)
+        events = _unpack_records(buf)
+        return events[0] if events else None
+
+    def delete(self, event_id, app_id, channel_id=None) -> bool:
+        h = self._handle(app_id, channel_id)
+        return self._lib.el_delete(h, _id16(event_id)) == 1
+
+    def find(
+        self,
+        app_id,
+        channel_id=None,
+        start_time=None,
+        until_time=None,
+        entity_type=None,
+        entity_id=None,
+        event_names=None,
+        target_entity_type=S.UNSET,
+        target_entity_id=S.UNSET,
+        limit=None,
+        reversed=False,
+    ) -> List[Event]:
+        h = self._handle(app_id, channel_id)
+
+        def target_mode(v) -> Tuple[int, Optional[bytes]]:
+            if v is S.UNSET:
+                return 0, None
+            if v is None:
+                return 1, None
+            return 2, str(v).encode("utf-8")
+
+        tt_mode, tt_val = target_mode(target_entity_type)
+        ti_mode, ti_val = target_mode(target_entity_id)
+        names = list(event_names) if event_names is not None else []
+
+        req = _FindReq(
+            start_us=_us(start_time) if start_time is not None else _I64_MIN,
+            until_us=_us(until_time) if until_time is not None else _I64_MAX,
+            entity_type=entity_type.encode() if entity_type is not None else None,
+            entity_id=entity_id.encode() if entity_id is not None else None,
+            target_type_mode=tt_mode,
+            target_id_mode=ti_mode,
+            target_entity_type=tt_val,
+            target_entity_id=ti_val,
+            event_names=b"\0".join(n.encode() for n in names) + b"\0" if names else None,
+            n_event_names=len(names),
+            reversed=1 if reversed else 0,
+            limit=limit if limit is not None and limit >= 0 else -1,
+        )
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_bytes = ctypes.c_uint64()
+        n = self._lib.el_find(h, ctypes.byref(req), ctypes.byref(out), ctypes.byref(out_bytes))
+        if n < 0:
+            raise S.StorageError("find failed in native event log")
+        if n == 0:
+            return []
+        try:
+            buf = ctypes.string_at(out, out_bytes.value)
+        finally:
+            self._lib.el_free(out)
+        return _unpack_records(buf)
+
+    def close(self) -> None:
+        with self._lock:
+            for h in self._handles.values():
+                self._lib.el_close(h)
+            self._handles.clear()
+
+
+class EventLogStorageClient(S.StorageClient):
+    """events → native log; metadata/models → localfs at the same root
+    (the HBase-for-events + ES-for-metadata pairing, single-binary)."""
+
+    def __init__(self, config: Dict[str, str]):
+        super().__init__(config)
+        base = os.path.expanduser(
+            config.get("PATH", os.path.join("~", ".pio_store", "eventlog"))
+        )
+        self._events = EventLogEventStore(
+            os.path.join(base, "events"), fsync=config.get("FSYNC", "0") == "1"
+        )
+        self._meta = LocalFSStorageClient({"PATH": os.path.join(base, "meta")})
+
+    def events(self):
+        return self._events
+
+    def apps(self):
+        return self._meta.apps()
+
+    def access_keys(self):
+        return self._meta.access_keys()
+
+    def channels(self):
+        return self._meta.channels()
+
+    def engine_manifests(self):
+        return self._meta.engine_manifests()
+
+    def engine_instances(self):
+        return self._meta.engine_instances()
+
+    def evaluation_instances(self):
+        return self._meta.evaluation_instances()
+
+    def models(self):
+        return self._meta.models()
+
+
+S.register_backend("eventlog", EventLogStorageClient)

@@ -279,8 +279,9 @@ def register_backend(type_name: str, client_cls: type) -> None:
 
 
 def _load_backends() -> None:
-    # import side-effect registers the built-in backends
-    from predictionio_tpu.data.backends import memory, localfs, sqlite  # noqa: F401
+    # import side-effect registers the built-in backends (the native
+    # eventlog backend compiles lazily — importing it is cheap)
+    from predictionio_tpu.data.backends import memory, localfs, sqlite, eventlog  # noqa: F401
 
 
 _SOURCE_RE = re.compile(r"^PIO_STORAGE_SOURCES_([^_]+)_(.+)$")

@@ -1,0 +1,83 @@
+"""Native (C++) runtime components.
+
+The reference delegates its heavy lifting to external JVM systems
+(SURVEY.md §0: Spark, HBase, ES); this package holds the single-binary
+native equivalents: the event-log storage engine (eventlog.cpp) and the
+host-side ragged-data binning used by the training input pipeline.
+
+Libraries are compiled on first use with the system toolchain and cached
+under ``_build/``; loading is via ctypes (no pybind11 dependency).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.environ.get("PIO_NATIVE_BUILD_DIR", os.path.join(_HERE, "_build"))
+_CXX = os.environ.get("PIO_CXX", "g++")
+
+_lock = threading.Lock()
+_cache: dict = {}
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def build_library(name: str, extra_flags: Optional[list] = None) -> str:
+    """Compile ``<name>.cpp`` to ``_build/_<name>.so`` (mtime-cached).
+
+    Returns the .so path; raises NativeBuildError if the toolchain is
+    missing or compilation fails (callers degrade gracefully).
+    """
+    src = os.path.join(_HERE, f"{name}.cpp")
+    out = os.path.join(_BUILD_DIR, f"_{name}.so")
+    with _lock:
+        if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+            return out
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        cmd = [
+            _CXX, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            *(extra_flags or []), src, "-o", out + ".tmp",
+        ]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        except FileNotFoundError:
+            raise NativeBuildError(f"C++ compiler {_CXX!r} not found") from None
+        except subprocess.TimeoutExpired:
+            raise NativeBuildError(f"compiling {name} timed out") from None
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"compiling {name} failed:\n{proc.stderr[-2000:]}"
+            )
+        os.replace(out + ".tmp", out)
+        return out
+
+
+def load_library(name: str, extra_flags: Optional[list] = None) -> ctypes.CDLL:
+    """Build (if needed) and dlopen a native library; process-cached."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+    path = build_library(name, extra_flags)
+    lib = ctypes.CDLL(path)
+    with _lock:
+        _cache[name] = lib
+    return lib
+
+
+def native_available(name: str) -> bool:
+    try:
+        load_library(name)
+        return True
+    except NativeBuildError as exc:
+        log.debug("native %s unavailable: %s", name, exc)
+        return False

@@ -1,0 +1,469 @@
+// eventlog: append-only binary event log with in-memory index.
+//
+// The native data plane of the EVENTDATA storage tier — the role HBase
+// plays in the reference (data/.../storage/hbase/HBEventsUtil.scala:47:
+// rowkey = MD5(entity) || time || uuid, scans via partial row keys +
+// column filters). Same design pressures, single-binary execution:
+//   - append-only log per (app, channel), like an HBase region's WAL+store
+//   - in-memory index of (time, entity-hash, name-hash) per record, so
+//     filtered scans (PEvents.find semantics, storage/PEvents.scala:70)
+//     touch only the index until materialization
+//   - deletes are tombstones (HBase delete markers) carrying the log
+//     offset at delete time, so they mask only earlier records — an id
+//     re-inserted after a delete is live again
+//   - single writer process: an flock(2) on <dir>/LOCK is held for the
+//     handle's lifetime; a second process gets a clean open error
+//     instead of silent corruption (concurrent access goes through the
+//     event server REST API, as HBase clients go through the region
+//     server)
+//
+// Record wire format (little-endian), produced by the Python binding:
+//   u32  record_len            (bytes after this field)
+//   u8   id[16]                (event id, raw uuid bytes)
+//   i64  event_time_us         (epoch micros, UTC)
+//   i64  creation_time_us
+//   u16  len_event
+//   u16  len_entity_type
+//   u16  len_entity_id
+//   u16  len_target_type       (0xFFFF = absent)
+//   u16  len_target_id         (0xFFFF = absent)
+//   u32  len_extra             (opaque JSON: properties/tags/prId/tz)
+//   bytes: event, entity_type, entity_id, [target_type], [target_id], extra
+//
+// Tombstone file format: 24-byte entries, u8 id[16] + u64 cutoff_offset.
+//
+// Concurrency (in-process): one writer at a time (exclusive lock on
+// append/delete), many readers (shared lock on find/get). The file is
+// mmap'ed in 64 MiB-rounded chunks so most appends need no remap; only
+// bytes below file_size are ever dereferenced.
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC -pthread eventlog.cpp -o _eventlog.so
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <shared_mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/file.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kHeaderLen = 46;  // bytes after record_len, before strings
+constexpr uint16_t kAbsent = 0xFFFF;
+constexpr uint64_t kMapChunk = 64ULL << 20;  // mapping granularity
+
+inline uint64_t fnv1a(const uint8_t* data, size_t n, uint64_t h = 1469598103934665603ULL) {
+  for (size_t i = 0; i < n; ++i) {
+    h ^= data[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+struct RecMeta {
+  uint64_t offset;    // offset of the u32 record_len field
+  uint32_t len;       // record_len
+  int64_t time_us;
+  int64_t ctime_us;
+  uint64_t etype_hash;
+  uint64_t eid_hash;
+  uint64_t name_hash;
+  uint64_t ttype_hash;  // 0 when absent
+  uint64_t tid_hash;    // 0 when absent
+  uint8_t has_target_type;
+  uint8_t has_target_id;
+};
+
+struct Header {
+  const uint8_t* id;
+  int64_t time_us;
+  int64_t ctime_us;
+  uint16_t len_event, len_etype, len_eid, len_ttype, len_tid;
+  uint32_t len_extra;
+  const uint8_t *event, *etype, *eid, *ttype, *tid;
+};
+
+// parse one record payload (the bytes after record_len); returns false on corruption
+bool parse(const uint8_t* p, uint32_t len, Header* h) {
+  if (len < kHeaderLen) return false;
+  h->id = p;
+  memcpy(&h->time_us, p + 16, 8);
+  memcpy(&h->ctime_us, p + 24, 8);
+  memcpy(&h->len_event, p + 32, 2);
+  memcpy(&h->len_etype, p + 34, 2);
+  memcpy(&h->len_eid, p + 36, 2);
+  memcpy(&h->len_ttype, p + 38, 2);
+  memcpy(&h->len_tid, p + 40, 2);
+  memcpy(&h->len_extra, p + 42, 4);
+  uint64_t need = kHeaderLen;
+  need += h->len_event + h->len_etype + h->len_eid;
+  uint16_t ltt = (h->len_ttype == kAbsent) ? 0 : h->len_ttype;
+  uint16_t lti = (h->len_tid == kAbsent) ? 0 : h->len_tid;
+  need += ltt + lti + h->len_extra;
+  if (need != len) return false;
+  const uint8_t* s = p + kHeaderLen;
+  h->event = s;
+  s += h->len_event;
+  h->etype = s;
+  s += h->len_etype;
+  h->eid = s;
+  s += h->len_eid;
+  h->ttype = (h->len_ttype == kAbsent) ? nullptr : s;
+  s += ltt;
+  h->tid = (h->len_tid == kAbsent) ? nullptr : s;
+  return true;
+}
+
+struct Log {
+  int fd = -1;
+  int tomb_fd = -1;
+  int lock_fd = -1;
+  uint64_t file_size = 0;
+  uint8_t* map = nullptr;
+  uint64_t map_size = 0;
+  bool broken = false;  // mapping failed after a durable append; reads error
+  std::vector<RecMeta> recs;
+  std::unordered_map<std::string, uint64_t> by_id;  // raw 16-byte id -> rec index
+  std::unordered_map<std::string, uint64_t> tombs;  // id -> max cutoff offset
+  bool fsync_on_append = false;
+  mutable std::shared_mutex mu;
+
+  ~Log() {
+    if (map) munmap(map, map_size);
+    if (fd >= 0) close(fd);
+    if (tomb_fd >= 0) close(tomb_fd);
+    if (lock_fd >= 0) close(lock_fd);  // releases the flock
+  }
+
+  // (re)map so that [0, file_size) is addressable; rounds the mapping up
+  // to kMapChunk so appends rarely remap. Call with exclusive lock held.
+  bool ensure_mapped() {
+    if (file_size <= map_size && map) return true;
+    if (file_size == 0) return true;
+    uint64_t want = ((file_size + kMapChunk - 1) / kMapChunk) * kMapChunk;
+    if (map) {
+      munmap(map, map_size);
+      map = nullptr;
+      map_size = 0;
+    }
+    void* m = mmap(nullptr, want, PROT_READ, MAP_SHARED, fd, 0);
+    if (m == MAP_FAILED) return false;
+    map = static_cast<uint8_t*>(m);
+    map_size = want;
+    return true;
+  }
+
+  bool dead(const std::string& id, uint64_t offset) const {
+    auto it = tombs.find(id);
+    return it != tombs.end() && it->second > offset;
+  }
+
+  void index_record(uint64_t offset, uint32_t len, const Header& h) {
+    RecMeta m;
+    m.offset = offset;
+    m.len = len;
+    m.time_us = h.time_us;
+    m.ctime_us = h.ctime_us;
+    m.etype_hash = fnv1a(h.etype, h.len_etype);
+    m.eid_hash = fnv1a(h.eid, h.len_eid);
+    m.name_hash = fnv1a(h.event, h.len_event);
+    m.has_target_type = h.ttype != nullptr;
+    m.has_target_id = h.tid != nullptr;
+    m.ttype_hash = h.ttype ? fnv1a(h.ttype, h.len_ttype) : 0;
+    m.tid_hash = h.tid ? fnv1a(h.tid, h.len_tid) : 0;
+    std::string id(reinterpret_cast<const char*>(h.id), 16);
+    if (!dead(id, offset)) by_id[id] = recs.size();
+    recs.push_back(m);
+  }
+};
+
+struct FindReq {
+  int64_t start_us;   // INT64_MIN = unbounded
+  int64_t until_us;   // INT64_MAX = unbounded
+  const char* entity_type;  // nullptr = no filter
+  const char* entity_id;
+  int32_t target_type_mode;  // 0 = no filter, 1 = must be absent, 2 = equals
+  int32_t target_id_mode;
+  const char* target_entity_type;
+  const char* target_entity_id;
+  const char* event_names;  // '\0'-joined
+  int32_t n_event_names;    // 0 = no filter
+  int32_t reversed;
+  int64_t limit;  // -1 = all
+};
+
+bool bytes_eq(const uint8_t* a, uint32_t alen, const char* b) {
+  return alen == strlen(b) && memcmp(a, b, alen) == 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void el_free(uint8_t* p) { free(p); }
+
+void* el_open(const char* dir, int fsync_on_append) {
+  std::string base(dir);
+  if (mkdir(base.c_str(), 0755) != 0 && errno != EEXIST) return nullptr;
+  auto log = std::make_unique<Log>();
+  log->fsync_on_append = fsync_on_append != 0;
+
+  // single-writer-process guard: held until el_close
+  std::string lock_path = base + "/LOCK";
+  log->lock_fd = open(lock_path.c_str(), O_RDWR | O_CREAT, 0644);
+  if (log->lock_fd < 0) return nullptr;
+  if (flock(log->lock_fd, LOCK_EX | LOCK_NB) != 0) return nullptr;
+
+  std::string log_path = base + "/log.bin";
+  log->fd = open(log_path.c_str(), O_RDWR | O_CREAT | O_APPEND, 0644);
+  if (log->fd < 0) return nullptr;
+  std::string tomb_path = base + "/tombstones.bin";
+  log->tomb_fd = open(tomb_path.c_str(), O_RDWR | O_CREAT | O_APPEND, 0644);
+  if (log->tomb_fd < 0) return nullptr;
+
+  // load tombstones first: cutoffs decide liveness during log replay
+  struct stat st;
+  if (fstat(log->tomb_fd, &st) != 0) return nullptr;
+  for (off_t off = 0; off + 24 <= st.st_size; off += 24) {
+    uint8_t entry[24];
+    if (pread(log->tomb_fd, entry, 24, off) != 24) return nullptr;
+    std::string id(reinterpret_cast<const char*>(entry), 16);
+    uint64_t cutoff;
+    memcpy(&cutoff, entry + 16, 8);
+    uint64_t& slot = log->tombs[id];
+    if (cutoff > slot) slot = cutoff;
+  }
+
+  if (fstat(log->fd, &st) != 0) return nullptr;
+  log->file_size = static_cast<uint64_t>(st.st_size);
+  if (!log->ensure_mapped()) return nullptr;
+
+  // replay the log into the index; a torn tail (crash mid-append) is
+  // truncated away, mirroring WAL replay semantics
+  uint64_t off = 0;
+  while (off + 4 <= log->file_size) {
+    uint32_t len;
+    memcpy(&len, log->map + off, 4);
+    if (off + 4 + len > log->file_size) break;  // torn tail
+    Header h;
+    if (!parse(log->map + off + 4, len, &h)) break;
+    log->index_record(off, len, h);
+    off += 4 + len;
+  }
+  if (off < log->file_size) {
+    if (ftruncate(log->fd, off) != 0) return nullptr;
+    log->file_size = off;
+  }
+  return log.release();
+}
+
+void el_close(void* h) { delete static_cast<Log*>(h); }
+
+int64_t el_count(void* h) {
+  Log* log = static_cast<Log*>(h);
+  std::shared_lock lk(log->mu);
+  return static_cast<int64_t>(log->by_id.size());
+}
+
+// Appends a batch of pre-packed records. Validates the whole batch before
+// writing anything (all-or-nothing). Returns records appended, or -1.
+// The append is durable even if the subsequent remap fails (the handle
+// then reports errors on reads until reopened, rather than crashing).
+int64_t el_append_batch(void* h, const uint8_t* buf, uint64_t nbytes) {
+  Log* log = static_cast<Log*>(h);
+  // validation pass (no lock needed; reads only the input)
+  uint64_t off = 0;
+  int64_t n = 0;
+  Header hdr;
+  while (off < nbytes) {
+    if (off + 4 > nbytes) return -1;
+    uint32_t len;
+    memcpy(&len, buf + off, 4);
+    if (off + 4 + len > nbytes) return -1;
+    if (!parse(buf + off + 4, len, &hdr)) return -1;
+    off += 4 + len;
+    ++n;
+  }
+
+  std::unique_lock lk(log->mu);
+  if (log->broken) return -1;
+  uint64_t written = 0;
+  while (written < nbytes) {
+    ssize_t w = write(log->fd, buf + written, nbytes - written);
+    if (w < 0) {
+      // partial batch on disk: re-truncate to the pre-batch size
+      if (ftruncate(log->fd, log->file_size) != 0) {}
+      return -1;
+    }
+    written += static_cast<uint64_t>(w);
+  }
+  if (log->fsync_on_append) fdatasync(log->fd);
+
+  uint64_t base = log->file_size;
+  log->file_size += nbytes;
+  // index from the caller's buffer (already validated) so indexing does
+  // not depend on the remap succeeding
+  off = 0;
+  while (off < nbytes) {
+    uint32_t len;
+    memcpy(&len, buf + off, 4);
+    Header h2;
+    parse(buf + off + 4, len, &h2);
+    log->index_record(base + off, len, h2);
+    off += 4 + len;
+  }
+  if (!log->ensure_mapped()) log->broken = true;
+  return n;
+}
+
+int el_delete(void* h, const uint8_t* id16) {
+  Log* log = static_cast<Log*>(h);
+  std::unique_lock lk(log->mu);
+  std::string id(reinterpret_cast<const char*>(id16), 16);
+  auto it = log->by_id.find(id);
+  if (it == log->by_id.end()) return 0;
+  // cutoff = current end of log: masks every existing record with this
+  // id, while a future re-insert (offset >= cutoff) is live again
+  uint8_t entry[24];
+  memcpy(entry, id16, 16);
+  memcpy(entry + 16, &log->file_size, 8);
+  if (write(log->tomb_fd, entry, 24) != 24) return -1;
+  if (log->fsync_on_append) fdatasync(log->tomb_fd);
+  uint64_t& slot = log->tombs[id];
+  if (log->file_size > slot) slot = log->file_size;
+  log->by_id.erase(it);
+  return 1;
+}
+
+// Copies the record with the given id into *out (u32 len + payload).
+// Returns total bytes, 0 if absent, -1 on error.
+int64_t el_get(void* h, const uint8_t* id16, uint8_t** out) {
+  Log* log = static_cast<Log*>(h);
+  std::shared_lock lk(log->mu);
+  if (log->broken) return -1;
+  auto it = log->by_id.find(std::string(reinterpret_cast<const char*>(id16), 16));
+  if (it == log->by_id.end()) return 0;
+  const RecMeta& m = log->recs[it->second];
+  uint64_t total = 4 + m.len;
+  uint8_t* buf = static_cast<uint8_t*>(malloc(total));
+  if (!buf) return -1;
+  memcpy(buf, log->map + m.offset, total);
+  *out = buf;
+  return static_cast<int64_t>(total);
+}
+
+// Filtered scan with PEvents.find semantics: half-open [start, until)
+// time window, hash-prefiltered string matches confirmed byte-wise,
+// results ordered by (event_time, creation_time, arrival), optional
+// reverse + limit. Output: concatenated records; returns the count.
+int64_t el_find(void* h, const FindReq* req, uint8_t** out, uint64_t* out_bytes) {
+  Log* log = static_cast<Log*>(h);
+  std::shared_lock lk(log->mu);
+  if (log->broken) return -1;
+
+  uint64_t etype_h = req->entity_type
+      ? fnv1a(reinterpret_cast<const uint8_t*>(req->entity_type), strlen(req->entity_type))
+      : 0;
+  uint64_t eid_h = req->entity_id
+      ? fnv1a(reinterpret_cast<const uint8_t*>(req->entity_id), strlen(req->entity_id))
+      : 0;
+  uint64_t ttype_h = (req->target_type_mode == 2)
+      ? fnv1a(reinterpret_cast<const uint8_t*>(req->target_entity_type),
+              strlen(req->target_entity_type))
+      : 0;
+  uint64_t tid_h = (req->target_id_mode == 2)
+      ? fnv1a(reinterpret_cast<const uint8_t*>(req->target_entity_id),
+              strlen(req->target_entity_id))
+      : 0;
+  std::vector<std::pair<uint64_t, const char*>> name_hashes;
+  {
+    const char* p = req->event_names;
+    for (int32_t i = 0; i < req->n_event_names; ++i) {
+      size_t l = strlen(p);
+      name_hashes.emplace_back(fnv1a(reinterpret_cast<const uint8_t*>(p), l), p);
+      p += l + 1;
+    }
+  }
+
+  std::vector<uint64_t> hits;
+  for (uint64_t i = 0; i < log->recs.size(); ++i) {
+    const RecMeta& m = log->recs[i];
+    if (m.time_us < req->start_us || m.time_us >= req->until_us) continue;
+    if (req->entity_type && m.etype_hash != etype_h) continue;
+    if (req->entity_id && m.eid_hash != eid_h) continue;
+    if (req->target_type_mode == 1 && m.has_target_type) continue;
+    if (req->target_type_mode == 2 && (!m.has_target_type || m.ttype_hash != ttype_h)) continue;
+    if (req->target_id_mode == 1 && m.has_target_id) continue;
+    if (req->target_id_mode == 2 && (!m.has_target_id || m.tid_hash != tid_h)) continue;
+    if (req->n_event_names > 0) {
+      bool any = false;
+      for (const auto& nh : name_hashes) {
+        if (nh.first == m.name_hash) { any = true; break; }
+      }
+      if (!any) continue;
+    }
+    // materialize the header to (a) confirm string matches byte-wise
+    // (hash-collision guard), (b) drop tombstoned/superseded records:
+    // a record is live only if it is the current by_id entry for its id
+    Header hd;
+    parse(log->map + m.offset + 4, m.len, &hd);
+    auto live = log->by_id.find(std::string(reinterpret_cast<const char*>(hd.id), 16));
+    if (live == log->by_id.end() || live->second != i) continue;
+    if (req->entity_type && !bytes_eq(hd.etype, hd.len_etype, req->entity_type)) continue;
+    if (req->entity_id && !bytes_eq(hd.eid, hd.len_eid, req->entity_id)) continue;
+    if (req->target_type_mode == 2 &&
+        !bytes_eq(hd.ttype, hd.len_ttype, req->target_entity_type)) continue;
+    if (req->target_id_mode == 2 &&
+        !bytes_eq(hd.tid, hd.len_tid, req->target_entity_id)) continue;
+    if (req->n_event_names > 0) {
+      bool any = false;
+      for (const auto& nh : name_hashes) {
+        if (bytes_eq(hd.event, hd.len_event, nh.second)) { any = true; break; }
+      }
+      if (!any) continue;
+    }
+    hits.push_back(i);
+  }
+
+  auto key_less = [&](uint64_t a, uint64_t b) {
+    const RecMeta& ma = log->recs[a];
+    const RecMeta& mb = log->recs[b];
+    if (ma.time_us != mb.time_us) return ma.time_us < mb.time_us;
+    if (ma.ctime_us != mb.ctime_us) return ma.ctime_us < mb.ctime_us;
+    return a < b;
+  };
+  if (req->reversed)
+    std::sort(hits.begin(), hits.end(), [&](uint64_t a, uint64_t b) { return key_less(b, a); });
+  else
+    std::sort(hits.begin(), hits.end(), key_less);
+  if (req->limit >= 0 && hits.size() > static_cast<uint64_t>(req->limit))
+    hits.resize(req->limit);
+
+  uint64_t total = 0;
+  for (uint64_t i : hits) total += 4 + log->recs[i].len;
+  uint8_t* buf = total ? static_cast<uint8_t*>(malloc(total)) : nullptr;
+  if (total && !buf) return -1;
+  uint64_t w = 0;
+  for (uint64_t i : hits) {
+    const RecMeta& m = log->recs[i];
+    memcpy(buf + w, log->map + m.offset, 4 + m.len);
+    w += 4 + m.len;
+  }
+  *out = buf;
+  *out_bytes = total;
+  return static_cast<int64_t>(hits.size());
+}
+
+}  // extern "C"

@@ -85,9 +85,13 @@ def run_evaluation(
         )
         instance.status = "EVALCOMPLETED"
         instance.end_time = _now()
-        instance.evaluator_results = result.to_one_liner()
-        instance.evaluator_results_json = result.to_json()
-        instance.evaluator_results_html = result.to_html()
+        # a result carrying no_save (FakeEvalResult, workflow/fake.py)
+        # keeps its renderings out of the metadata store
+        # (ref: CoreWorkflow checking evaluatorResult.noSave)
+        if not getattr(result, "no_save", False):
+            instance.evaluator_results = result.to_one_liner()
+            instance.evaluator_results_json = result.to_json()
+            instance.evaluator_results_html = result.to_html()
         storage.evaluation_instances().update(instance)
         return result
     except Exception:

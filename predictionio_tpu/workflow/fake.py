@@ -7,16 +7,17 @@ Behavior contract from the reference (workflow/FakeWorkflow.scala):
     evaluation harness (instance bookkeeping, evaluator dispatch)
     without a real engine.  Here the function takes the SparkContext
     analogue, a :class:`~predictionio_tpu.parallel.mesh.MeshContext`.
-  - ``FakeEvalResult`` (FakeWorkflow.scala:47) carries ``noSave=true``
-    (:60) so CoreWorkflow skips persisting evaluator results.
+  - ``FakeEvalResult`` (FakeWorkflow.scala:47) carries ``no_save``
+    (:60) so the evaluation workflow skips persisting evaluator results
+    (honored in workflow/evaluate.py).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable, List, Optional, Tuple
+from typing import Any, Callable, Optional
 
-from predictionio_tpu.core.controller import DataSource, IdentityPreparator, Algorithm, Serving
+from predictionio_tpu.core.controller import Algorithm, DataSource, IdentityPreparator, Serving
 from predictionio_tpu.core.engine import Engine
 from predictionio_tpu.core.evaluation import Evaluation, Metric
 from predictionio_tpu.core.params import EngineParams
@@ -25,7 +26,8 @@ from predictionio_tpu.parallel.mesh import MeshContext
 
 @dataclass
 class FakeEvalResult:
-    """ref: FakeWorkflow.scala:47 — result with no_save so nothing persists."""
+    """ref: FakeWorkflow.scala:47 — result whose no_save keeps it out of
+    the metadata store (checked in workflow/evaluate.py)."""
 
     no_save: bool = True
 
@@ -61,50 +63,74 @@ class _FakeServing(Serving):
         return None
 
 
-class _FakeMetric(Metric):
-    """Runs the wrapped function when the evaluator computes the score
-    (ref: FakeRun routing the fn through evaluateBase, FakeWorkflow.scala:36)."""
+class _NullMetric(Metric):
+    def calculate(self, ctx, eval_data) -> float:
+        return 0.0
+
+
+class _FakeEvaluator:
+    """Evaluator that drives the engine's eval pipeline once, then runs
+    the wrapped function (ref: FakeRun routing fn through evaluateBase,
+    FakeWorkflow.scala:36). Same call signature as MetricEvaluator."""
 
     def __init__(self, fn: Callable[[MeshContext], Any]):
         self.fn = fn
         self.result: Any = None
 
-    def calculate(self, ctx: MeshContext, eval_data) -> float:
-        self.result = self.fn(ctx)
-        return 0.0
+    def evaluate(self, ctx, evaluation, engine_params_list, workflow_params=None, eval_fn=None):
+        from predictionio_tpu.workflow.config import WorkflowParams
 
-    def header(self) -> str:
-        return "FakeRun"
+        wp = workflow_params or WorkflowParams()
+        run = eval_fn or (lambda c, ep: evaluation.engine.eval(c, ep, wp))
+        for ep in engine_params_list:
+            run(ctx, ep)
+        self.result = self.fn(ctx)
+        return FakeEvalResult()
 
 
 class FakeRun:
     """ref: FakeWorkflow.scala:66 — evaluation wrapper around a plain function.
 
+    ``run()`` goes through the real evaluation workflow
+    (:func:`predictionio_tpu.workflow.evaluate.run_evaluation`): an
+    EvaluationInstance is created and completed, but — because
+    FakeEvalResult.no_save — no evaluator results are persisted.
+
     Usage::
 
-        out = FakeRun(lambda ctx: do_stuff(ctx)).run()
+        out = FakeRun(lambda ctx: do_stuff(ctx)).run(storage=storage)
     """
 
     def __init__(self, fn: Callable[[MeshContext], Any]):
-        self.metric = _FakeMetric(fn)
+        self.evaluator = _FakeEvaluator(fn)
         engine = Engine(
             data_source_classes=_FakeDataSource,
             preparator_classes=IdentityPreparator,
             algorithm_classes=_FakeAlgorithm,
             serving_classes=_FakeServing,
         )
-        self.evaluation = Evaluation(engine=engine, metric=self.metric)
+        self.evaluation = Evaluation(engine=engine, metric=_NullMetric())
 
-    def run(self, ctx: Optional[MeshContext] = None) -> Any:
-        """Run through MetricEvaluator + Engine.eval; return fn's result."""
-        from predictionio_tpu.core.evaluation import MetricEvaluator
+    def run(self, ctx: Optional[MeshContext] = None, storage=None) -> Any:
+        from predictionio_tpu.workflow.evaluate import run_evaluation
 
-        ctx = ctx or MeshContext()
         ep = EngineParams(algorithm_params_list=[("", None)])
-        MetricEvaluator().evaluate(ctx, self.evaluation, [ep], eval_fn=None)
-        return self.metric.result
+        run_evaluation(
+            self.evaluation,
+            engine_params_list=[ep],
+            evaluation_class="FakeRun",
+            ctx=ctx or MeshContext(),
+            storage=storage,
+            evaluator=self.evaluator,
+            use_fast_eval=False,
+        )
+        return self.evaluator.result
 
 
-def fake_run(fn: Callable[[MeshContext], Any], ctx: Optional[MeshContext] = None) -> Any:
+def fake_run(
+    fn: Callable[[MeshContext], Any],
+    ctx: Optional[MeshContext] = None,
+    storage=None,
+) -> Any:
     """Convenience: ``fake_run(lambda ctx: ...)`` — ref FakeWorkflow.scala:36."""
-    return FakeRun(fn).run(ctx)
+    return FakeRun(fn).run(ctx, storage=storage)

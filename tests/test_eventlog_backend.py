@@ -1,0 +1,182 @@
+"""Native (C++) eventlog backend specifics: durability across reopen,
+torn-tail WAL recovery, tombstone persistence, non-canonical id mapping.
+The generic EventStore contract runs via tests/test_storage.py's
+parametrized suite; these cover what only the native tier does.
+Reference role: the HBase event store (SURVEY.md §2.5)."""
+
+import datetime as dt
+import os
+
+import pytest
+
+from predictionio_tpu.data.event import Event
+from tests.test_storage import make_storage
+
+UTC = dt.timezone.utc
+
+
+def _mk(tmp_path):
+    return make_storage("eventlog", tmp_path)
+
+
+def ev(uid, minute=0, name="rate"):
+    return Event(
+        event=name,
+        entity_type="user",
+        entity_id=uid,
+        target_entity_type="item",
+        target_entity_id="i1",
+        properties={"rating": 4.0},
+        event_time=dt.datetime(2026, 3, 1, 12, minute, tzinfo=UTC),
+    )
+
+
+def test_reopen_persistence_and_tombstones(tmp_path):
+    st = _mk(tmp_path)
+    app = st.apps().insert("native")
+    st.events().init(app.id)
+    ids = st.events().insert_batch([ev("u1"), ev("u2", 1), ev("u3", 2)], app.id)
+    assert st.events().delete(ids[1], app.id)
+    st.events().close()
+
+    st2 = _mk(tmp_path)
+    got = st2.events().find(app.id)
+    assert [e.entity_id for e in got] == ["u1", "u3"]
+    # tz fidelity survives the binary round trip
+    assert got[0].event_time == dt.datetime(2026, 3, 1, 12, 0, tzinfo=UTC)
+    assert st2.events().get(ids[1], app.id) is None
+    st2.events().close()
+
+
+def test_torn_tail_recovery(tmp_path):
+    """A crash mid-append leaves a partial record; reopen truncates it
+    (WAL replay semantics, eventlog.cpp)."""
+    st = _mk(tmp_path)
+    app = st.apps().insert("torn")
+    st.events().init(app.id)
+    st.events().insert_batch([ev("u1"), ev("u2", 1)], app.id)
+    st.events().close()
+
+    log_dir = tmp_path / "store" / "events" / f"events_{app.id}"
+    log_file = log_dir / "log.bin"
+    with open(log_file, "ab") as f:
+        f.write(b"\xff\xff\xff\x7f partial garbage")
+
+    st2 = _mk(tmp_path)
+    assert [e.entity_id for e in st2.events().find(app.id)] == ["u1", "u2"]
+    # appends still work after recovery
+    st2.events().insert(ev("u3", 2), app.id)
+    assert len(st2.events().find(app.id)) == 3
+    st2.events().close()
+
+
+def test_non_hex_event_id_round_trip(tmp_path):
+    st = _mk(tmp_path)
+    app = st.apps().insert("ids")
+    st.events().init(app.id)
+    e = ev("u1").with_id("custom-id-not-hex")
+    st.events().insert(e, app.id)
+    got = st.events().get("custom-id-not-hex", app.id)
+    assert got is not None and got.event_id == "custom-id-not-hex"
+    assert st.events().find(app.id)[0].event_id == "custom-id-not-hex"
+    st.events().close()
+
+
+def test_time_window_and_limit(tmp_path):
+    st = _mk(tmp_path)
+    app = st.apps().insert("win")
+    st.events().init(app.id)
+    st.events().insert_batch([ev(f"u{i}", i) for i in range(10)], app.id)
+    es = st.events()
+    start = dt.datetime(2026, 3, 1, 12, 3, tzinfo=UTC)
+    until = dt.datetime(2026, 3, 1, 12, 7, tzinfo=UTC)
+    got = es.find(app.id, start_time=start, until_time=until)
+    assert [e.entity_id for e in got] == ["u3", "u4", "u5", "u6"]  # half-open
+    got = es.find(app.id, limit=3, reversed=True)
+    assert [e.entity_id for e in got] == ["u9", "u8", "u7"]
+    st.events().close()
+
+
+def test_reinsert_after_delete_is_live(tmp_path):
+    """Tombstones carry a log-offset cutoff: deleting id X then inserting
+    a new event with id X must keep the new event visible — matching the
+    memory/localfs/sqlite backends."""
+    st = _mk(tmp_path)
+    app = st.apps().insert("resurrect")
+    es = st.events().__class__  # noqa: F841 (readability)
+    st.events().init(app.id)
+    e1 = ev("u1").with_id()
+    st.events().insert(e1, app.id)
+    assert st.events().delete(e1.event_id, app.id)
+    assert st.events().get(e1.event_id, app.id) is None
+
+    e2 = ev("u1-v2", 5).with_id(e1.event_id)
+    st.events().insert(e2, app.id)
+    got = st.events().get(e1.event_id, app.id)
+    assert got is not None and got.entity_id == "u1-v2"
+    assert [e.entity_id for e in st.events().find(app.id)] == ["u1-v2"]
+    st.events().close()
+
+    # survives reopen (tombstone cutoff is persistent)
+    st2 = _mk(tmp_path)
+    assert [e.entity_id for e in st2.events().find(app.id)] == ["u1-v2"]
+    st2.events().close()
+
+
+def test_second_process_gets_clean_lock_error(tmp_path):
+    """A second OS process opening the same log fails with StorageError
+    (flock single-writer guard) instead of corrupting the index."""
+    import subprocess
+    import sys
+    import textwrap
+
+    st = _mk(tmp_path)
+    app = st.apps().insert("locked")
+    st.events().init(app.id)
+    st.events().insert(ev("u1"), app.id)
+
+    code = textwrap.dedent(
+        f"""
+        from predictionio_tpu.data.backends.eventlog import EventLogEventStore
+        from predictionio_tpu.data.storage import StorageError
+        st = EventLogEventStore({str(tmp_path / "store" / "events")!r})
+        try:
+            st.find({app.id})
+        except StorageError as e:
+            assert "LOCK" in str(e), e
+            print("LOCKED-OK")
+        else:
+            print("NO-LOCK")
+        """
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, cwd="/root/repo"
+    )
+    assert "LOCKED-OK" in proc.stdout, (proc.stdout, proc.stderr)
+    st.events().close()
+
+
+def test_bulk_throughput_sanity(tmp_path):
+    """50k events in one batch append + filtered scan — exercises the
+    native index path at a size where Python-side filtering would show."""
+    st = _mk(tmp_path)
+    app = st.apps().insert("bulk")
+    st.events().init(app.id)
+    batch = [
+        Event(
+            event="buy" if i % 3 == 0 else "view",
+            entity_type="user",
+            entity_id=f"u{i % 500}",
+            target_entity_type="item",
+            target_entity_id=f"i{i % 100}",
+            event_time=dt.datetime(2026, 3, 1, tzinfo=UTC) + dt.timedelta(seconds=i),
+        )
+        for i in range(50_000)
+    ]
+    ids = st.events().insert_batch(batch, app.id)
+    assert len(set(ids)) == 50_000
+    buys = st.events().find(app.id, event_names=["buy"])
+    assert len(buys) == len([e for e in batch if e.event == "buy"])
+    one_user = st.events().find(app.id, entity_type="user", entity_id="u7")
+    assert len(one_user) == 100
+    st.events().close()

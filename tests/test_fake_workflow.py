@@ -11,7 +11,7 @@ from predictionio_tpu.workflow.fake import FakeEvalResult, FakeRun, fake_run
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_fake_run_executes_fn_through_eval_plumbing():
+def test_fake_run_executes_fn_through_eval_plumbing(memory_storage):
     seen = []
 
     def fn(ctx):
@@ -19,12 +19,18 @@ def test_fake_run_executes_fn_through_eval_plumbing():
         seen.append("ran")
         return 42
 
-    assert fake_run(fn) == 42
+    assert fake_run(fn, storage=memory_storage) == 42
     assert seen == ["ran"]
+    # the run went through the real evaluation workflow: an instance was
+    # created and completed, but no_save kept results out of the store
+    instances = memory_storage.evaluation_instances().get_all()
+    assert len(instances) == 1
+    assert instances[0].status == "EVALCOMPLETED"
+    assert instances[0].evaluator_results == ""
 
 
-def test_fake_run_class_api():
-    assert FakeRun(lambda ctx: "ok").run() == "ok"
+def test_fake_run_class_api(memory_storage):
+    assert FakeRun(lambda ctx: "ok").run(storage=memory_storage) == "ok"
 
 
 def test_fake_eval_result_no_save():

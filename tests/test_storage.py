@@ -15,6 +15,11 @@ UTC = dt.timezone.utc
 
 
 def make_storage(kind, tmp_path):
+    if kind == "eventlog":
+        from predictionio_tpu.native import native_available
+
+        if not native_available("eventlog"):
+            pytest.skip("C++ toolchain unavailable for the native eventlog backend")
     if kind == "memory":
         env = {"PIO_STORAGE_SOURCES_S_TYPE": "memory"}
     else:
@@ -35,7 +40,7 @@ def make_storage(kind, tmp_path):
     return Storage.from_env(env)
 
 
-@pytest.fixture(params=["memory", "localfs", "sqlite"])
+@pytest.fixture(params=["memory", "localfs", "sqlite", "eventlog"])
 def storage(request, tmp_path):
     return make_storage(request.param, tmp_path)
 
