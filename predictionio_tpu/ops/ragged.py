@@ -23,10 +23,54 @@ device buffers directly.
 
 from __future__ import annotations
 
+import ctypes
+import logging
+import os
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
 import numpy as np
+
+log = logging.getLogger(__name__)
+
+_NATIVE_MIN_NNZ = 200_000  # below this the numpy path wins (no call overhead)
+
+
+def _native_lib():
+    """ctypes handle to the native binning pass, or None (numpy fallback).
+
+    Gated by PIO_NATIVE_RAGGED=0 to force the numpy path."""
+    if os.environ.get("PIO_NATIVE_RAGGED", "1") == "0":
+        return None
+    global _LIB
+    try:
+        return _LIB
+    except NameError:
+        pass
+    try:
+        from predictionio_tpu import native
+
+        lib = native.load_library("raggedbin")
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.rb_fill_segmented.restype = ctypes.c_int
+        lib.rb_fill_segmented.argtypes = [
+            i64p, i64p, f32p, ctypes.c_int64, ctypes.c_int64,
+            i64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            i32p, f32p, f32p, i32p,
+        ]
+        lib.rb_fill_padded.restype = ctypes.c_int
+        lib.rb_fill_padded.argtypes = [
+            i64p, i64p, f32p, ctypes.c_int64, ctypes.c_int64,
+            i64p, ctypes.c_int64,
+            i32p, f32p, f32p,
+        ]
+        _LIB = lib
+    except Exception as exc:  # missing toolchain -> numpy path
+        log.debug("native ragged binning unavailable: %s", exc)
+        _LIB = None
+    return _LIB
 
 
 @dataclass
@@ -150,7 +194,24 @@ def build_segmented_groups(
     # the segment-sum depends on it. Real rows overwrite below.
     seg = np.full(n_shards * R_s, g_per_shard - 1, dtype=np.int32)
 
-    if nnz:
+    lib = _native_lib() if nnz >= _NATIVE_MIN_NNZ else None
+    if nnz and lib is not None:
+        # native single-pass cursor walk (raggedbin.cpp): no argsort, no
+        # scattered fancy-index writes
+        rc = lib.rb_fill_segmented(
+            np.ascontiguousarray(group_idx),
+            np.ascontiguousarray(item_idx),
+            np.ascontiguousarray(values),
+            nnz, n_groups,
+            np.ascontiguousarray(group_row_start[:n_groups]),
+            np.ascontiguousarray(counts_true[:n_groups]),
+            -1 if max_len is None else max_len,
+            L, g_per_shard,
+            idx.reshape(-1), val.reshape(-1), mask.reshape(-1), seg,
+        )
+        if rc != 0:
+            raise ValueError("group index out of range in native binning")
+    elif nnz:
         order = np.argsort(group_idx, kind="stable")
         g_sorted = group_idx[order]
         i_sorted = item_idx[order]
@@ -214,7 +275,20 @@ def build_padded_groups(
     val = np.zeros((G, L), dtype=np.float32)
     mask = np.zeros((G, L), dtype=np.float32)
 
-    if nnz:
+    lib = _native_lib() if nnz >= _NATIVE_MIN_NNZ else None
+    if nnz and lib is not None:
+        rc = lib.rb_fill_padded(
+            np.ascontiguousarray(group_idx),
+            np.ascontiguousarray(item_idx),
+            np.ascontiguousarray(values),
+            nnz, n_groups,
+            np.ascontiguousarray(counts_true[:n_groups]),
+            L,
+            idx.reshape(-1), val.reshape(-1), mask.reshape(-1),
+        )
+        if rc != 0:
+            raise ValueError("group index out of range in native binning")
+    elif nnz:
         # stable sort by group keeps original (chronological) order within
         # a group; truncation below then keeps the latest entries
         order = np.argsort(group_idx, kind="stable")

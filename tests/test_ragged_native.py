@@ -1,0 +1,63 @@
+"""Native binning pass (raggedbin.cpp) must produce byte-identical
+output to the numpy reference path, including truncation and sharding."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops import ragged
+
+pytestmark = pytest.mark.skipif(
+    not __import__("predictionio_tpu.native", fromlist=["native_available"]).native_available("raggedbin"),
+    reason="C++ toolchain unavailable",
+)
+
+
+def _coo(n=500_000, n_groups=3_000, n_items=800, seed=0):
+    rng = np.random.default_rng(seed)
+    g = rng.integers(0, n_groups, size=n, dtype=np.int64)
+    i = (rng.zipf(1.3, size=n) % n_items).astype(np.int64)
+    v = rng.normal(size=n).astype(np.float32)
+    return g, i, v
+
+
+def _force(monkeypatch, native: bool):
+    monkeypatch.setenv("PIO_NATIVE_RAGGED", "1" if native else "0")
+    monkeypatch.setattr(ragged, "_NATIVE_MIN_NNZ", 0 if native else 10**18)
+
+
+@pytest.mark.parametrize("max_len", [None, 64])
+@pytest.mark.parametrize("n_shards", [1, 4])
+def test_segmented_parity(monkeypatch, max_len, n_shards):
+    g, i, v = _coo()
+    n_groups = 3_000
+    _force(monkeypatch, False)
+    ref = ragged.build_segmented_groups(g, i, v, n_groups, max_len=max_len, n_shards=n_shards)
+    _force(monkeypatch, True)
+    got = ragged.build_segmented_groups(g, i, v, n_groups, max_len=max_len, n_shards=n_shards)
+    np.testing.assert_array_equal(ref.idx, got.idx)
+    np.testing.assert_array_equal(ref.val, got.val)
+    np.testing.assert_array_equal(ref.mask, got.mask)
+    np.testing.assert_array_equal(ref.seg, got.seg)
+    np.testing.assert_array_equal(ref.counts, got.counts)
+
+
+@pytest.mark.parametrize("max_len", [None, 32])
+def test_padded_parity(monkeypatch, max_len):
+    g, i, v = _coo(n=200_000, n_groups=1_000)
+    _force(monkeypatch, False)
+    ref = ragged.build_padded_groups(g, i, v, 1_000, max_len=max_len, group_multiple=8)
+    _force(monkeypatch, True)
+    got = ragged.build_padded_groups(g, i, v, 1_000, max_len=max_len, group_multiple=8)
+    np.testing.assert_array_equal(ref.idx, got.idx)
+    np.testing.assert_array_equal(ref.val, got.val)
+    np.testing.assert_array_equal(ref.mask, got.mask)
+    np.testing.assert_array_equal(ref.counts, got.counts)
+
+
+def test_bad_group_id_raises(monkeypatch):
+    _force(monkeypatch, True)
+    g = np.array([0, 1, 99], dtype=np.int64)  # 99 >= n_groups
+    i = np.zeros(3, dtype=np.int64)
+    v = np.zeros(3, dtype=np.float32)
+    with pytest.raises(ValueError):
+        ragged.build_segmented_groups(g, i, v, n_groups=2)
