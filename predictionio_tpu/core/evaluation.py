@@ -108,6 +108,17 @@ class SumMetric(QPAMetric):
         return float(scores.sum())
 
 
+class MeanSquareError(AverageMetric):
+    """Mean of (prediction - actual)^2 over float-valued triples
+    (ref: controller/Evaluator.scala:126 — evaluateSet's
+    ``mean((p - a)^2)``); lower is better."""
+
+    higher_is_better = False
+
+    def calculate_qpa(self, q, p, a):
+        return (float(p) - float(a)) ** 2
+
+
 class FunctionMetric(AverageMetric):
     """Sugar: wrap a plain (q, p, a) -> float function as an AverageMetric."""
 
@@ -228,11 +239,14 @@ class MetricEvaluator:
             results.append(MetricScores(engine_params=ep, score=score, other_scores=others))
 
         sign = 1.0 if evaluation.metric.higher_is_better else -1.0
+
+        def rank_key(score: float) -> float:
+            # non-finite scores (no eval data) rank worst for BOTH
+            # orderings: -inf must be applied after the sign flip
+            return sign * score if np.isfinite(score) else -np.inf
+
         best_idx = int(
-            max(
-                range(len(results)),
-                key=lambda i: sign * (results[i].score if np.isfinite(results[i].score) else -np.inf),
-            )
+            max(range(len(results)), key=lambda i: rank_key(results[i].score))
         )
         best = results[best_idx]
         result = MetricEvaluatorResult(
@@ -244,7 +258,7 @@ class MetricEvaluator:
             engine_params_scores=results,
         )
         # leaderboard log (ref: MetricEvaluator printing the ranking)
-        order = sorted(results, key=lambda s: sign * s.score, reverse=True)
+        order = sorted(results, key=lambda s: rank_key(s.score), reverse=True)
         for rank, s in enumerate(order):
             log.info("leaderboard #%d: score=%s", rank + 1, s.score)
         if self.best_json_path:

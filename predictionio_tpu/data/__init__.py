@@ -3,7 +3,7 @@
 from predictionio_tpu.data.event import Event, EventValidationError, validate_event
 from predictionio_tpu.data.datamap import DataMap, PropertyMap
 from predictionio_tpu.data.aggregation import aggregate_properties_from_events
-from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.bimap import BiMap, EntityIdIxMap, EntityMap
 
 __all__ = [
     "Event",
@@ -13,4 +13,6 @@ __all__ = [
     "PropertyMap",
     "aggregate_properties_from_events",
     "BiMap",
+    "EntityIdIxMap",
+    "EntityMap",
 ]

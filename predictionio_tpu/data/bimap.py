@@ -23,11 +23,15 @@ class BiMap(Generic[K, V]):
     """Immutable bidirectional map; values must be unique."""
 
     def __init__(self, forward: Dict[K, V], _inverse: Optional[Dict[V, K]] = None):
-        self._f = dict(forward)
         if _inverse is None:
+            self._f = dict(forward)
             _inverse = {v: k for k, v in self._f.items()}
             if len(_inverse) != len(self._f):
                 raise ValueError("BiMap values must be unique")
+        else:
+            # private fast path (inverse()): both dicts already exist and
+            # stay immutable — no O(n) copy
+            self._f = forward
         self._i = _inverse
 
     # -- access -------------------------------------------------------------
@@ -73,6 +77,12 @@ class BiMap(Generic[K, V]):
         """Vectorized key->int conversion (requires an int-valued BiMap)."""
         return np.fromiter((self._f[k] for k in keys), dtype=np.int64, count=len(keys))
 
+    def take_n(self, n: int) -> "BiMap[K, V]":
+        """Sub-map of the first ``n`` entries (ref: BiMap.scala take(n))."""
+        import itertools
+
+        return BiMap(dict(itertools.islice(self._f.items(), n)))
+
     # -- constructors (ref: BiMap.scala stringInt/stringLong) ----------------
     @staticmethod
     def string_int(keys: Iterable[str]) -> "BiMap[str, int]":
@@ -84,3 +94,92 @@ class BiMap(Generic[K, V]):
         return BiMap(forward)
 
     string_long = string_int
+
+
+class EntityIdIxMap:
+    """Entity-id <-> dense-index map (ref: storage/EntityMap.scala:27
+    ``EntityIdIxMap``): a thin wrapper around an int-valued BiMap that
+    answers lookups in both directions through one object."""
+
+    def __init__(self, id_to_ix: BiMap):
+        self.id_to_ix = id_to_ix
+        self.ix_to_id = id_to_ix.inverse()
+
+    @staticmethod
+    def from_keys(keys: Iterable[str]) -> "EntityIdIxMap":
+        return EntityIdIxMap(BiMap.string_long(keys))
+
+    @staticmethod
+    def _as_ix(key) -> int:
+        """Strict integer coercion: floats/None are lookup bugs, not
+        indices — reject instead of truncating."""
+        import operator
+
+        return operator.index(key)
+
+    def __call__(self, key):
+        """id -> ix for str keys, ix -> id for int keys (the reference's
+        overloaded ``apply``)."""
+        if isinstance(key, str):
+            return self.id_to_ix[key]
+        return self.ix_to_id[self._as_ix(key)]
+
+    def __contains__(self, key) -> bool:
+        if isinstance(key, str):
+            return key in self.id_to_ix
+        try:
+            return self._as_ix(key) in self.ix_to_id
+        except TypeError:
+            return False
+
+    def get(self, key, default=None):
+        if isinstance(key, str):
+            return self.id_to_ix.get(key, default)
+        try:
+            return self.ix_to_id.get(self._as_ix(key), default)
+        except TypeError:
+            return default
+
+    def to_dict(self) -> Dict[str, int]:
+        return self.id_to_ix.to_dict()
+
+    def __len__(self) -> int:
+        return len(self.id_to_ix)
+
+    def take(self, n: int) -> "EntityIdIxMap":
+        return EntityIdIxMap(self.id_to_ix.take_n(n))
+
+
+class EntityMap(EntityIdIxMap, Generic[V]):
+    """EntityIdIxMap + per-entity payload (ref: storage/EntityMap.scala:68
+    ``EntityMap[A]``): id->data plus the dense index, so factor-matrix
+    rows and entity payloads stay aligned. Used by engines that need
+    per-entity features next to the index (experimental
+    scala-parallel-recommendation-entitymap example)."""
+
+    def __init__(self, id_to_data: Dict[str, V],
+                 id_to_ix: Optional[BiMap] = None):
+        if id_to_ix is None:
+            id_to_ix = BiMap.string_long(id_to_data.keys())
+        super().__init__(id_to_ix)
+        self.id_to_data = dict(id_to_data)
+
+    def data(self, key) -> V:
+        if isinstance(key, str):
+            return self.id_to_data[key]
+        return self.id_to_data[self.ix_to_id[self._as_ix(key)]]
+
+    def get_data(self, key, default=None):
+        if isinstance(key, str):
+            return self.id_to_data.get(key, default)
+        try:
+            rid = self.ix_to_id.get(self._as_ix(key))
+        except TypeError:
+            return default
+        return default if rid is None else self.id_to_data.get(rid, default)
+
+    def take(self, n: int) -> "EntityMap[V]":
+        sub = self.id_to_ix.take_n(n)
+        return EntityMap(
+            {k: self.id_to_data[k] for k in sub.keys()}, sub
+        )

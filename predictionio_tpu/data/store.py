@@ -99,6 +99,33 @@ def aggregate_properties(
     )
 
 
+def extract_entity_map(
+    app_name: str,
+    entity_type: str,
+    extract,
+    channel_name: Optional[str] = None,
+    start_time: Optional[_dt.datetime] = None,
+    until_time: Optional[_dt.datetime] = None,
+    required: Optional[List[str]] = None,
+    storage: Optional[Storage] = None,
+):
+    """Aggregate properties, then index entities into an EntityMap whose
+    payload is ``extract(PropertyMap)`` per entity
+    (ref: PEvents.extractEntityMap:109)."""
+    from predictionio_tpu.data.bimap import EntityMap
+
+    props = aggregate_properties(
+        app_name,
+        entity_type,
+        channel_name=channel_name,
+        start_time=start_time,
+        until_time=until_time,
+        required=required,
+        storage=storage,
+    )
+    return EntityMap({eid: extract(pm) for eid, pm in props.items()})
+
+
 def find_by_entity(
     app_name: str,
     entity_type: str,

@@ -12,3 +12,23 @@ a DASE Algorithm wrapper:
   markov         — top-N transition chains (ref: e2/.../MarkovChain.scala)
   two_tower      — flax neural recommender (stretch config in BASELINE.json)
 """
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+import numpy as np
+
+
+def batch_predict_dense(
+    model: Any,
+    queries: Sequence[Tuple[int, Any]],
+    wrap: Callable[[float], Any] = float,
+) -> List[Tuple[int, Any]]:
+    """Shared glue for algorithms over dense ``{"features": [...]}``
+    queries: stack the batch into one [B, D] matrix, score it with the
+    model's vectorized ``predict_batch``, and wrap each output. Handles
+    the empty fold ``engine.eval`` can produce (dataset rows < eval_k)."""
+    if not queries:
+        return []
+    feats = np.array([q["features"] for _, q in queries], dtype=np.float32)
+    preds = model.predict_batch(feats)
+    return [(i, wrap(p)) for (i, _q), p in zip(queries, preds)]

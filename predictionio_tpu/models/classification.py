@@ -121,9 +121,9 @@ class NaiveBayesAlgorithm(Algorithm):
         return {"label": model.predict([float(v) for v in query["features"]])}
 
     def batch_predict(self, model, queries):
-        feats = np.array([q["features"] for _, q in queries], dtype=np.float32)
-        labels = model.predict_batch(feats)
-        return [(i, {"label": float(l)}) for (i, _q), l in zip(queries, labels)]
+        from predictionio_tpu.models import batch_predict_dense
+
+        return batch_predict_dense(model, queries, lambda l: {"label": float(l)})
 
 
 # -- softmax regression (optax) ----------------------------------------------
@@ -213,6 +213,6 @@ class LogisticRegressionAlgorithm(Algorithm):
         return {"label": model.predict([float(v) for v in query["features"]])}
 
     def batch_predict(self, model, queries):
-        feats = np.array([q["features"] for _, q in queries], dtype=np.float32)
-        labels = model.predict_batch(feats)
-        return [(i, {"label": float(l)}) for (i, _q), l in zip(queries, labels)]
+        from predictionio_tpu.models import batch_predict_dense
+
+        return batch_predict_dense(model, queries, lambda l: {"label": float(l)})

@@ -15,4 +15,6 @@ engine.json variants.
   ecommerce      — ALS + serve-time business-rule filters
                    (ref: examples/scala-parallel-ecommercerecommendation)
   vanilla        — skeleton for new engines (ref: template gallery vanilla)
+  regression     — linear regression over text-file features
+                   (ref: examples/experimental/scala-parallel-regression)
 """

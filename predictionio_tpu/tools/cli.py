@@ -42,6 +42,7 @@ BUILTIN_TEMPLATES = {
     "ecommercerecommendation": "predictionio_tpu.templates.ecommerce",
     "classification": "predictionio_tpu.templates.classification",
     "vanilla": "predictionio_tpu.templates.vanilla",
+    "regression": "predictionio_tpu.templates.regression",
     "twotower": "predictionio_tpu.templates.twotower",
     "twotower-hybrid": "predictionio_tpu.templates.twotower",
 }
@@ -52,6 +53,7 @@ TEMPLATE_FACTORIES = {
     "ecommercerecommendation": "ecommerce_engine",
     "classification": "classification_engine",
     "vanilla": "vanilla_engine",
+    "regression": "regression_engine",
     "twotower": "twotower_engine",
     "twotower-hybrid": "twotower_hybrid_engine",
 }

@@ -44,3 +44,56 @@ def test_get_and_contains():
     assert "a" in m
     assert m.get("missing") is None
     assert m.contains_value(0)
+
+
+def test_entity_id_ix_map_bidirectional():
+    from predictionio_tpu.data.bimap import EntityIdIxMap
+
+    m = EntityIdIxMap.from_keys(["u3", "u1", "u2"])
+    assert m("u3") == 0 and m("u2") == 2          # id -> ix
+    assert m(0) == "u3" and m(2) == "u2"          # ix -> id
+    assert "u1" in m and 1 in m and "zz" not in m and 9 not in m
+    assert m.get("zz") is None and m.get(9) is None
+    assert len(m) == 3
+    sub = m.take(2)
+    assert sub.to_dict() == {"u3": 0, "u1": 1}
+
+
+def test_entity_map_payload_lookup():
+    from predictionio_tpu.data.bimap import EntityMap
+
+    m = EntityMap({"a": 10, "b": 20, "c": 30})
+    assert m.data("b") == 20
+    assert m.data(m("c")) == 30                    # by dense index
+    assert m.get_data("zz", -1) == -1 and m.get_data(99, -1) == -1
+    sub = m.take(2)
+    assert len(sub) == 2 and sub.data("a") == 10
+
+
+def test_extract_entity_map_from_events():
+    import datetime
+
+    from predictionio_tpu.data import store
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.data.storage import Storage
+
+    st = Storage.from_env({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "meta",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "events",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "models",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+    app = st.apps().insert("emapp")
+    st.events().init(app.id)
+    t = datetime.datetime(2015, 1, 1, tzinfo=datetime.timezone.utc)
+    for i, rating in enumerate([3.5, 4.0]):
+        st.events().insert(
+            Event(event="$set", entity_type="item", entity_id=f"i{i}",
+                  properties={"rating": rating}, event_time=t), app.id)
+    em = store.extract_entity_map(
+        "emapp", "item", lambda pm: pm["rating"], storage=st)
+    assert len(em) == 2
+    assert em.data("i0") == 3.5 and em.data(em("i1")) == 4.0

@@ -183,3 +183,30 @@ def test_run_evaluation_failure_marks_instance(memory_storage):
         run_evaluation(evaluation, engine_params_list=[make_params(1)], storage=memory_storage)
     instances = memory_storage.evaluation_instances().get_all()
     assert instances[0].status == "FAILED"
+
+
+def test_nan_score_never_wins_lower_is_better():
+    """A NaN-scored candidate (no eval data) must rank worst even when
+    higher_is_better=False (sign flip must not turn NaN into +inf)."""
+
+    class LossMetric(FunctionMetric):
+        higher_is_better = False
+
+    metric = LossMetric(lambda q, p, a: float(p.algo_id), name="loss")
+    evaluation = Evaluation(engine=make_engine(), metric=metric)
+
+    calls = {"n": 0}
+    real_engine_eval = make_engine().eval
+
+    def eval_fn(c, ep):
+        # candidate 0 yields no eval data -> NaN score
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return []
+        return evaluation.engine.eval(c, ep)
+
+    result = MetricEvaluator().evaluate(
+        ctx, evaluation, [make_params(9), make_params(4)], eval_fn=eval_fn
+    )
+    assert result.best_idx == 1
+    assert result.best_score == 4.0

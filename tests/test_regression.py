@@ -1,0 +1,129 @@
+"""Regression engine template tests (ref: examples/experimental/
+scala-parallel-regression/Run.scala behavior: file data source, SGD
+linear regression, AverageServing fan-out, MeanSquareError eval)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.evaluation import MeanSquareError
+from predictionio_tpu.core.params import EngineParams
+from predictionio_tpu.models.regression import (
+    RegressionData,
+    RidgeRegressionParams,
+    SGDRegressionParams,
+    train_ridge_regression,
+    train_sgd_regression,
+)
+from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.templates import regression as reg_t
+
+ctx = MeshContext()
+
+TRUE_W = np.array([2.0, -1.0, 0.5], dtype=np.float32)
+
+
+def _make_points(n=120, seed=0, noise=0.01):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    y = x @ TRUE_W + noise * rng.normal(size=n).astype(np.float32)
+    return x, y
+
+
+@pytest.fixture()
+def data_file(tmp_path):
+    x, y = _make_points()
+    path = tmp_path / "lr_data.txt"
+    with open(path, "w") as f:
+        for yi, xi in zip(y, x):
+            f.write(f"{yi} " + " ".join(str(v) for v in xi) + "\n")
+    return str(path)
+
+
+def test_sgd_recovers_weights():
+    x, y = _make_points()
+    model = train_sgd_regression(
+        RegressionData(x, y), SGDRegressionParams(iterations=400, step_size=0.2))
+    np.testing.assert_allclose(model.weights, TRUE_W, atol=0.05)
+    assert model.intercept == 0.0
+
+
+def test_ridge_recovers_weights_one_shot():
+    x, y = _make_points()
+    model = train_ridge_regression(
+        RegressionData(x, y), RidgeRegressionParams(reg=1e-6))
+    np.testing.assert_allclose(model.weights, TRUE_W, atol=0.02)
+
+
+def test_file_datasource_parses(data_file):
+    ds = reg_t.FileRegressionDataSource(reg_t.RegressionDSParams(filepath=data_file))
+    td = ds.read_training(ctx)
+    assert td.features.shape == (120, 3)
+    assert td.targets.shape == (120,)
+
+
+def test_train_and_average_serving(data_file):
+    engine = reg_t.regression_engine()
+    ep = reg_t.default_engine_params(data_file, step_sizes=[0.1, 0.2, 0.4])
+    result = engine.train(ctx, ep)
+    assert len(result.models) == 3
+    algos = engine.make_algorithms(ep)
+    serving = engine.make_serving(ep)
+    q = {"features": [1.0, 1.0, 1.0]}
+    preds = [a.predict(m, q) for a, m in zip(algos, result.models)]
+    combined = serving.serve(q, preds)
+    # true value 1.5; the average of the three variants should be close
+    assert combined == pytest.approx(sum(preds) / 3)
+    assert combined == pytest.approx(1.5, abs=0.1)
+
+
+def test_eval_mse(data_file):
+    engine = reg_t.regression_engine()
+    ep = reg_t.default_engine_params(data_file, eval_k=3, step_sizes=[0.2])
+    results = engine.eval(ctx, ep)
+    assert len(results) == 3
+    mse = MeanSquareError().calculate(ctx, results)
+    assert mse < 0.05
+    assert MeanSquareError.higher_is_better is False
+
+
+def test_ridge_collinear_features_no_nan():
+    x, y = _make_points()
+    x_dup = np.concatenate([x, x[:, :1]], axis=1)  # duplicated column
+    model = train_ridge_regression(
+        RegressionData(x_dup, y), RidgeRegressionParams(reg=1e-6))
+    assert np.isfinite(model.weights).all()
+    pred = model.predict_batch(x_dup)
+    np.testing.assert_allclose(pred, y, atol=0.05)
+
+
+def test_empty_data_file_reports_sanity_error(tmp_path):
+    path = tmp_path / "empty.txt"
+    path.write_text("\n")
+    engine = reg_t.regression_engine()
+    ep = reg_t.default_engine_params(str(path), step_sizes=[0.1])
+    with pytest.raises(ValueError, match="no labeled points"):
+        engine.train(ctx, ep)
+
+
+def test_entity_ix_map_rejects_float_keys():
+    from predictionio_tpu.data.bimap import EntityIdIxMap
+
+    m = EntityIdIxMap.from_keys(["a", "b", "c"])
+    with pytest.raises(TypeError):
+        m(1.7)
+    assert 1.7 not in m and None not in m
+    assert m.get(1.7, "d") == "d" and m.get(None, "d") == "d"
+
+
+def test_eval_with_empty_fold(data_file, tmp_path):
+    """A fold whose test split is empty must not crash batch_predict."""
+    path = tmp_path / "tiny.txt"
+    x, y = _make_points(n=2)
+    with open(path, "w") as f:
+        for yi, xi in zip(y, x):
+            f.write(f"{yi} " + " ".join(str(v) for v in xi) + "\n")
+    engine = reg_t.regression_engine()
+    ep = reg_t.default_engine_params(str(path), eval_k=3, step_sizes=[0.2])
+    results = engine.eval(ctx, ep)
+    assert len(results) == 3
+    assert sum(len(qpa) for _ei, qpa in results) == 2
