@@ -82,6 +82,9 @@ class ALSModel:
         self.user_ids = user_ids
         self.item_ids = item_ids
         self._scorer: Optional[TopKScorer] = None
+        # picklable record that sharded serving was enabled (the mesh
+        # itself never pickles); load_persistent_model re-enables it
+        self.sharded_axis: Optional[str] = None
 
     def __getstate__(self):
         d = dict(self.__dict__)
@@ -89,12 +92,23 @@ class ALSModel:
         return d
 
     def __setstate__(self, d):
+        d.setdefault("sharded_axis", None)  # models pickled pre-field
         self.__dict__.update(d)
 
     def scorer(self) -> TopKScorer:
         if self._scorer is None:
             self._scorer = TopKScorer(self.item_factors)
         return self._scorer
+
+    def enable_sharded_serving(self, mesh, axis: str = "data") -> None:
+        """Swap in a ShardedTopKScorer: item factors row-sharded over
+        ``mesh[axis]``, per-shard top-k merged over ICI — serving for
+        catalogs larger than one chip's HBM (ops.topk.make_sharded_topk).
+        Same results as the single-device scorer."""
+        from predictionio_tpu.ops.topk import ShardedTopKScorer
+
+        self._scorer = ShardedTopKScorer(self.item_factors, mesh, axis=axis)
+        self.sharded_axis = axis
 
     def recommend(
         self,
@@ -163,6 +177,18 @@ class ALSAlgorithm(Algorithm):
             max_ratings_per_item=p.max_ratings_per_item,
         )
         return ALSModel(factors, pd.user_ids, pd.item_ids)
+
+    def load_persistent_model(self, persisted: ALSModel, ctx: MeshContext) -> ALSModel:
+        """Re-enable sharded serving after unpickle when the model was
+        trained with it (the mesh never pickles; rebuild from ctx)."""
+        axis = getattr(persisted, "sharded_axis", None)
+        if axis is not None:
+            mesh = ctx.require_mesh()
+            if axis in mesh.axis_names and mesh.shape[axis] > 1:
+                persisted.enable_sharded_serving(mesh, axis=axis)
+            else:
+                persisted.sharded_axis = None  # single-device deploy
+        return persisted
 
     def predict(self, model: ALSModel, query: Dict[str, Any]) -> Dict[str, Any]:
         num = int(query.get("num", 10))

@@ -121,7 +121,10 @@ def train_ridge_regression(pd: RegressionData, p: RidgeRegressionParams) -> Line
     # solution instead of silent float32 NaNs
     gram, xty = _ridge_gram(jnp.asarray(x), jnp.asarray(y))
     d = x.shape[1]
-    a = np.asarray(gram, dtype=np.float64) + p.reg * np.eye(d)
+    penalty = np.eye(d)
+    if p.intercept:
+        penalty[-1, -1] = 0.0  # standard ridge never shrinks the intercept
+    a = np.asarray(gram, dtype=np.float64) + p.reg * penalty
     w = np.linalg.lstsq(a, np.asarray(xty, dtype=np.float64), rcond=None)[0]
     w = w.astype(np.float32)
     if p.intercept:

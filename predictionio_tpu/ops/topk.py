@@ -65,6 +65,29 @@ def _pow2_bucket(n: int, lo: int, hi: int) -> int:
     return b
 
 
+def _prepare_score_inputs(user_vecs, k: int, exclude_idx, n_items: int,
+                          max_exclude: int):
+    """Shared serve-path shape discipline for the scorers: batch the
+    user vectors, default/broadcast/bucket the exclusion lists (capped
+    at ``max_exclude``, oldest dropped first), bucket k to powers of
+    two. Returns (user_vecs [B, K], exclude [B, E_bucket], k, k_bucket)."""
+    user_vecs = jnp.atleast_2d(jnp.asarray(user_vecs, dtype=jnp.float32))
+    B = user_vecs.shape[0]
+    if exclude_idx is None:
+        exclude_idx = np.full((B, 1), -1, dtype=np.int32)
+    exclude_idx = np.asarray(exclude_idx, dtype=np.int32)
+    if exclude_idx.ndim == 1:
+        exclude_idx = np.broadcast_to(exclude_idx, (B, exclude_idx.shape[0]))
+    exclude_idx = exclude_idx[:, -max_exclude:]
+    e_bucket = _pow2_bucket(exclude_idx.shape[1], 1, max_exclude)
+    if exclude_idx.shape[1] < e_bucket:
+        pad = np.full((B, e_bucket - exclude_idx.shape[1]), -1, dtype=np.int32)
+        exclude_idx = np.concatenate([exclude_idx, pad], axis=1)
+    k = min(k, n_items)
+    k_bucket = min(_pow2_bucket(k, 8, 1 << 20), n_items)
+    return user_vecs, jnp.asarray(exclude_idx), k, k_bucket
+
+
 class TopKScorer:
     """Precompiled scorer over a fixed item-factor matrix.
 
@@ -90,23 +113,11 @@ class TopKScorer:
         first) — callers needing exact long blacklists should filter
         host-side on the returned ranking.
         """
-        user_vecs = jnp.atleast_2d(jnp.asarray(user_vecs, dtype=jnp.float32))
-        B = user_vecs.shape[0]
-        n_items = self.item_factors.shape[0]
-        if exclude_idx is None:
-            exclude_idx = np.full((B, 1), -1, dtype=np.int32)
-        exclude_idx = np.asarray(exclude_idx, dtype=np.int32)
-        if exclude_idx.ndim == 1:
-            exclude_idx = np.broadcast_to(exclude_idx, (B, exclude_idx.shape[0]))
-        exclude_idx = exclude_idx[:, -self.max_exclude:]
-        e_bucket = _pow2_bucket(exclude_idx.shape[1], 1, self.max_exclude)
-        if exclude_idx.shape[1] < e_bucket:
-            pad = np.full((B, e_bucket - exclude_idx.shape[1]), -1, dtype=np.int32)
-            exclude_idx = np.concatenate([exclude_idx, pad], axis=1)
-        k = min(k, n_items)
-        k_bucket = min(_pow2_bucket(k, 8, 1 << 20), n_items)
+        user_vecs, exclude_idx, k, k_bucket = _prepare_score_inputs(
+            user_vecs, k, exclude_idx, self.item_factors.shape[0],
+            self.max_exclude)
         scores, idx = _topk_scores(
-            user_vecs, self.item_factors, jnp.asarray(exclude_idx), k_bucket
+            user_vecs, self.item_factors, exclude_idx, k_bucket
         )
         return np.asarray(scores)[:, :k], np.asarray(idx)[:, :k]
 
@@ -128,6 +139,118 @@ class TopKScorer:
         scores, idx = _topk_scores_masked(
             user_vecs, self.item_factors, jnp.asarray(mask, dtype=bool), k_bucket
         )
+        return np.asarray(scores)[:, :k], np.asarray(idx)[:, :k]
+
+
+def make_sharded_topk(mesh, axis: str, n_items_global: int, k: int,
+                      n_valid: Optional[int] = None):
+    """Compile a top-k scorer whose item-factor matrix is row-sharded
+    over mesh axis ``axis`` (model parallelism for catalogs larger than
+    one chip's HBM — the capability the reference's driver-resident
+    MatrixFactorizationModel scan can never reach).
+
+    Per shard: score the local item slab [I/n, K] on the MXU, take a
+    local top-k over GLOBAL item ids, then all-gather the [B, k]
+    candidate lists over ICI and re-rank the n*k survivors — the merge
+    traffic is O(n * B * k), independent of catalog size.
+
+    Returns ``fn(user_vecs [B, K], item_shard [I/n, K], exclude [B, E])
+    -> (scores [B, k], global_idx [B, k])``, replicated outputs.
+
+    ``n_valid``: real item count when the matrix was zero-padded up to a
+    shard multiple — padded rows are masked to NEG_INF so a zero score
+    can never outrank genuine negatives.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n_shards = mesh.shape[axis]
+    if n_items_global % n_shards:
+        raise ValueError(
+            f"n_items_global={n_items_global} not divisible by "
+            f"{n_shards} '{axis}' shards (pad the factor matrix)"
+        )
+    i_loc = n_items_global // n_shards
+
+    def shard_fn(user_vecs, item_shard, exclude_idx):
+        shard = jax.lax.axis_index(axis)
+        offset = shard * i_loc
+        scores = user_vecs @ item_shard.T                    # [B, I/n]
+        B = scores.shape[0]
+        if n_valid is not None and n_valid < n_items_global:
+            gid = offset + jax.lax.iota(jnp.int32, i_loc)
+            scores = jnp.where(gid[None, :] < n_valid, scores, NEG_INF)
+        # exclusions arrive as global ids; route ones outside this
+        # shard (and -1 pads) to a scratch column
+        local_excl = jnp.where(
+            (exclude_idx >= offset) & (exclude_idx < offset + i_loc),
+            exclude_idx - offset, i_loc,
+        )
+        padded = jnp.concatenate(
+            [scores, jnp.zeros((B, 1), scores.dtype)], axis=1)
+        masked = jax.vmap(lambda row, e: row.at[e].set(NEG_INF))(
+            padded, local_excl)[:, :i_loc]
+        k_loc = min(k, i_loc)
+        loc_scores, loc_idx = jax.lax.top_k(masked, k_loc)    # [B, k_loc]
+        glob_idx = loc_idx + offset
+        # ICI merge: every shard sees all candidates, re-ranks locally
+        all_scores = jax.lax.all_gather(loc_scores, axis, axis=1)  # [B, n, k_loc]
+        all_idx = jax.lax.all_gather(glob_idx, axis, axis=1)
+        flat_s = all_scores.reshape(B, n_shards * k_loc)
+        flat_i = all_idx.reshape(B, n_shards * k_loc)
+        top_s, pos = jax.lax.top_k(flat_s, min(k, n_shards * k_loc))
+        top_i = jnp.take_along_axis(flat_i, pos, axis=1)
+        return top_s, top_i
+
+    fn = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), P(axis, None), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+class ShardedTopKScorer:
+    """TopKScorer drop-in whose item-factor matrix is row-sharded over a
+    mesh axis — serving for catalogs larger than one chip's HBM. Same
+    ``score`` signature/bucketing as TopKScorer; compiled merge kernels
+    cached per k bucket."""
+
+    def __init__(self, item_factors: np.ndarray, mesh, axis: str = "data",
+                 max_exclude: int = 64):
+        from predictionio_tpu.parallel.mesh import named_sharding
+
+        self.mesh, self.axis, self.max_exclude = mesh, axis, max_exclude
+        item_factors = np.asarray(item_factors, dtype=np.float32)
+        self.n_items = item_factors.shape[0]
+        n_shards = mesh.shape[axis]
+        pad = (-self.n_items) % n_shards
+        if pad:
+            item_factors = np.concatenate(
+                [item_factors,
+                 np.zeros((pad, item_factors.shape[1]), np.float32)])
+        self.n_padded = item_factors.shape[0]
+        self.item_factors = jax.device_put(
+            jnp.asarray(item_factors), named_sharding(mesh, axis, None))
+        self._fns = {}
+
+    def _fn(self, k: int):
+        if k not in self._fns:
+            self._fns[k] = make_sharded_topk(
+                self.mesh, self.axis, self.n_padded, k, n_valid=self.n_items)
+        return self._fns[k]
+
+    def score(
+        self,
+        user_vecs: np.ndarray,
+        k: int,
+        exclude_idx: Optional[np.ndarray] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        user_vecs, exclude_idx, k, k_bucket = _prepare_score_inputs(
+            user_vecs, k, exclude_idx, self.n_items, self.max_exclude)
+        scores, idx = self._fn(k_bucket)(
+            user_vecs, self.item_factors, exclude_idx)
         return np.asarray(scores)[:, :k], np.asarray(idx)[:, :k]
 
 

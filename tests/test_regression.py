@@ -127,3 +127,12 @@ def test_eval_with_empty_fold(data_file, tmp_path):
     results = engine.eval(ctx, ep)
     assert len(results) == 3
     assert sum(len(qpa) for _ei, qpa in results) == 2
+
+
+def test_ridge_does_not_shrink_intercept():
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(200, 3)).astype(np.float32)
+    y = (x @ TRUE_W + 100.0).astype(np.float32)  # large constant offset
+    model = train_ridge_regression(
+        RegressionData(x, y), RidgeRegressionParams(reg=10.0, intercept=True))
+    assert model.intercept == pytest.approx(100.0, abs=1.0)

@@ -1,0 +1,139 @@
+"""Sharded top-k serving: item factors row-sharded over the mesh,
+per-shard top-k + all-gather merge (ops.topk.make_sharded_topk).
+Runs on the 8-device CPU mesh; results must match the single-device
+TopKScorer exactly."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.ops.topk import TopKScorer, make_sharded_topk
+from predictionio_tpu.parallel.mesh import create_mesh, named_sharding
+
+import jax
+
+
+def _setup(n_items=256, rank=16, batch=4, seed=0):
+    rng = np.random.default_rng(seed)
+    items = rng.normal(size=(n_items, rank)).astype(np.float32)
+    users = rng.normal(size=(batch, rank)).astype(np.float32)
+    return users, items
+
+
+def test_sharded_matches_single_device():
+    users, items = _setup()
+    mesh = create_mesh({"data": 8})
+    k = 10
+    fn = make_sharded_topk(mesh, "data", items.shape[0], k)
+    sharded_items = jax.device_put(
+        jnp.asarray(items), named_sharding(mesh, "data", None))
+    excl = np.full((users.shape[0], 4), -1, dtype=np.int32)
+    s_scores, s_idx = fn(jnp.asarray(users), sharded_items, jnp.asarray(excl))
+
+    ref_scores, ref_idx = TopKScorer(items).score(users, k)
+    np.testing.assert_array_equal(np.asarray(s_idx), ref_idx)
+    np.testing.assert_allclose(np.asarray(s_scores), ref_scores, rtol=1e-5)
+
+
+def test_sharded_respects_global_exclusions():
+    users, items = _setup(batch=2)
+    mesh = create_mesh({"data": 8})
+    k = 5
+    fn = make_sharded_topk(mesh, "data", items.shape[0], k)
+    sharded_items = jax.device_put(
+        jnp.asarray(items), named_sharding(mesh, "data", None))
+
+    # exclude each row's unrestricted top-1 (global ids across shards)
+    _, base_idx = fn(jnp.asarray(users), sharded_items,
+                     jnp.full((2, 1), -1, np.int32))
+    excl = np.asarray(base_idx)[:, :1].astype(np.int32)
+    _, idx2 = fn(jnp.asarray(users), sharded_items, jnp.asarray(excl))
+    for b in range(2):
+        assert excl[b, 0] not in np.asarray(idx2)[b]
+
+    ref_scores, ref_idx = TopKScorer(items).score(users, k, exclude_idx=excl)
+    np.testing.assert_array_equal(np.asarray(idx2), ref_idx)
+
+
+def test_k_larger_than_shard_slab():
+    # k > I/n exercises the k_loc = I/n clamp
+    users, items = _setup(n_items=64, batch=2)
+    mesh = create_mesh({"data": 8})  # slab = 8 rows < k = 12
+    k = 12
+    fn = make_sharded_topk(mesh, "data", items.shape[0], k)
+    sharded_items = jax.device_put(
+        jnp.asarray(items), named_sharding(mesh, "data", None))
+    excl = np.full((2, 1), -1, np.int32)
+    s_scores, s_idx = fn(jnp.asarray(users), sharded_items, jnp.asarray(excl))
+    ref_scores, ref_idx = TopKScorer(items).score(users, k)
+    np.testing.assert_array_equal(np.asarray(s_idx), ref_idx)
+
+
+def test_sharded_scorer_class_with_padding():
+    # 250 items over 8 shards forces zero-row padding; padded rows must
+    # never appear even for users whose true scores are all negative
+    users, items = _setup(n_items=250, batch=3, seed=2)
+    users[0] = -np.abs(users[0])  # strongly negative scores likely
+    mesh = create_mesh({"data": 8})
+    from predictionio_tpu.ops.topk import ShardedTopKScorer
+
+    sharded = ShardedTopKScorer(items, mesh)
+    ref = TopKScorer(items)
+    for k in (5, 40):
+        s_s, s_i = sharded.score(users, k)
+        r_s, r_i = ref.score(users, k)
+        assert (s_i < 250).all()
+        np.testing.assert_array_equal(s_i, r_i)
+        np.testing.assert_allclose(s_s, r_s, rtol=1e-5)
+
+
+def test_als_model_sharded_serving_parity():
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.models.als import ALSModel
+    from predictionio_tpu.ops.als import ALSFactors
+
+    rng = np.random.default_rng(3)
+    n_users, n_items, rank = 6, 40, 8
+    factors = ALSFactors(
+        user_factors=rng.normal(size=(n_users, rank)).astype(np.float32),
+        item_factors=rng.normal(size=(n_items, rank)).astype(np.float32),
+    )
+    uids = BiMap.string_int([f"u{i}" for i in range(n_users)])
+    iids = BiMap.string_int([f"i{i}" for i in range(n_items)])
+    model = ALSModel(factors, uids, iids)
+    base = model.recommend("u2", 5, exclude_items=["i3", "i7"])
+
+    model.enable_sharded_serving(create_mesh({"data": 8}))
+    sharded = model.recommend("u2", 5, exclude_items=["i3", "i7"])
+    assert [i for i, _ in sharded] == [i for i, _ in base]
+
+
+def test_sharded_serving_survives_persistence_roundtrip():
+    """Pickled models re-enable sharded serving at load time
+    (ALSAlgorithm.load_persistent_model) instead of silently reverting
+    to a single-device scorer."""
+    import pickle
+
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.models.als import ALSAlgorithm, ALSModel, ALSParams
+    from predictionio_tpu.ops.als import ALSFactors
+    from predictionio_tpu.ops.topk import ShardedTopKScorer
+    from predictionio_tpu.parallel.mesh import MeshContext
+
+    rng = np.random.default_rng(4)
+    factors = ALSFactors(
+        user_factors=rng.normal(size=(5, 8)).astype(np.float32),
+        item_factors=rng.normal(size=(24, 8)).astype(np.float32),
+    )
+    model = ALSModel(
+        factors,
+        BiMap.string_int([f"u{i}" for i in range(5)]),
+        BiMap.string_int([f"i{i}" for i in range(24)]),
+    )
+    mesh = create_mesh({"data": 8})
+    model.enable_sharded_serving(mesh)
+
+    algo = ALSAlgorithm(ALSParams())
+    restored = pickle.loads(pickle.dumps(algo.make_persistent_model(model)))
+    loaded = algo.load_persistent_model(restored, MeshContext(mesh=mesh))
+    assert isinstance(loaded.scorer(), ShardedTopKScorer)
+    assert loaded.recommend("u1", 3) == model.recommend("u1", 3)
