@@ -1,0 +1,189 @@
+"""Sequential (next-item) recommendation as a DASE Algorithm.
+
+The long-context model family of the rebuild — no reference counterpart
+exists (SURVEY.md §5.7: PredictionIO has no sequence dimension), so the
+behavior contract is the recommendation template's query surface
+(top-``num`` itemScores, ref: examples/scala-parallel-recommendation
+Serving.scala) applied to *ordered* histories: the model answers "what
+comes next for this user", not "what does this user like overall".
+Compute core: ops.sessionrec (causal transformer; blockwise or ring
+attention for histories past one device's HBM).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.core import Algorithm, SanityCheck
+from predictionio_tpu.core.params import Params
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.ops.sessionrec import (
+    SessionRecConfig,
+    SessionRecModelState,
+    SessionRecTrainer,
+    SessionScorer,
+)
+from predictionio_tpu.parallel.mesh import MeshContext
+
+
+@dataclass
+class PreparedSequences(SanityCheck):
+    """PD for sequence models: indexed, timestamped interaction triples."""
+
+    user_ids: BiMap
+    item_ids: BiMap
+    user_idx: np.ndarray     # [n] int
+    item_idx: np.ndarray     # [n] int
+    times: np.ndarray        # [n] float64 (epoch seconds)
+
+    @property
+    def n_users(self) -> int:
+        return len(self.user_ids)
+
+    @property
+    def n_items(self) -> int:
+        return len(self.item_ids)
+
+    def sanity_check(self) -> None:
+        if len(self.user_idx) == 0:
+            raise ValueError("PreparedSequences is empty — no events found")
+        if not (len(self.user_idx) == len(self.item_idx) == len(self.times)):
+            raise ValueError("sequence arrays length mismatch")
+
+
+@dataclass
+class SessionRecParams(Params):
+    dim: int = 64
+    heads: int = 2
+    layers: int = 2
+    ffn_mult: int = 4
+    max_len: int = 64
+    dropout: float = 0.1
+    learning_rate: float = 1e-3
+    weight_decay: float = 1e-6
+    epochs: int = 5
+    batch_size: int = 256
+    seed: int = 13
+    attn_block: int = 0              # >0: flash-style blockwise attention
+    seq_axis: Optional[str] = None   # mesh axis for ring attention (SP)
+
+
+class SessionRecModel:
+    """Params + per-user histories + id maps; scorer compiled lazily."""
+
+    def __init__(self, state: SessionRecModelState, user_ids: BiMap, item_ids: BiMap):
+        self.state = state
+        self.user_ids = user_ids
+        self.item_ids = item_ids
+        self._scorer: Optional[SessionScorer] = None
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["_scorer"] = None          # device buffers never pickle
+        return d
+
+    def scorer(self) -> SessionScorer:
+        if self._scorer is None:
+            self._scorer = SessionScorer(self.state)
+        return self._scorer
+
+    def _sequence_for(self, query: Dict[str, Any]) -> Optional[np.ndarray]:
+        """Resolve the history to encode: an explicit ``items`` list in
+        the query (session-based, works for anonymous users) wins over
+        the stored training history."""
+        max_len = self.state.cfg.max_len
+        items = query.get("items")
+        if items is not None:
+            idx = [self.item_ids[i] + 1 for i in map(str, items) if i in self.item_ids]
+            if not idx:
+                return None
+            row = np.zeros(max_len, np.int32)
+            tail = idx[-max_len:]
+            row[: len(tail)] = tail
+            return row
+        row_id = self.user_ids.get(str(query.get("user", "")))
+        if row_id is None:
+            return None
+        row = self.state.sequences[row_id]
+        return row if (row > 0).any() else None
+
+    def recommend(self, query: Dict[str, Any]) -> List[Tuple[str, float]]:
+        seq = self._sequence_for(query)
+        if seq is None:
+            return []
+        num = int(query.get("num", 10))
+        scores, idx = self.scorer().top_k(
+            seq[None, :], num, exclude_seen=bool(query.get("excludeSeen", False))
+        )
+        inv = self.item_ids.inverse()
+        return [
+            (inv[int(i)], float(s))
+            for s, i in zip(scores[0], idx[0])
+            if i >= 0 and np.isfinite(s)
+        ]
+
+
+class SessionRecAlgorithm(Algorithm):
+    """DASE wrapper over ops.sessionrec."""
+
+    def __init__(self, params: SessionRecParams):
+        super().__init__(params)
+
+    def train(self, ctx: MeshContext, pd: PreparedSequences) -> SessionRecModel:
+        p: SessionRecParams = self.params
+        cfg = SessionRecConfig(
+            dim=p.dim, heads=p.heads, layers=p.layers, ffn_mult=p.ffn_mult,
+            max_len=p.max_len, dropout=p.dropout,
+            learning_rate=p.learning_rate, weight_decay=p.weight_decay,
+            epochs=p.epochs, batch_size=p.batch_size, seed=p.seed,
+            attn_block=p.attn_block, seq_axis=p.seq_axis,
+        )
+        # ring attention needs a mesh even when the caller didn't build
+        # one (same contract as ALSAlgorithm: require on demand)
+        mesh = ctx.require_mesh() if p.seq_axis else ctx.mesh
+        trainer = SessionRecTrainer(
+            (pd.user_idx, pd.item_idx, pd.times),
+            pd.n_users, pd.n_items, cfg, mesh=mesh,
+        )
+        losses = trainer.run()
+        state = trainer.state(losses)
+        return SessionRecModel(state, pd.user_ids, pd.item_ids)
+
+    def predict(self, model: SessionRecModel, query: Dict[str, Any]) -> Dict[str, Any]:
+        recs = model.recommend(query)
+        return {"itemScores": [{"item": i, "score": s} for i, s in recs]}
+
+    def batch_predict(self, model: SessionRecModel, queries):
+        """Batched evaluation: resolve every query's history, encode and
+        score them as one fixed-shape device batch per excludeSeen value
+        (the flag is jit-static, so mixed batches split in two)."""
+        groups: Dict[bool, list] = {False: [], True: []}
+        out = []
+        for qi, q in queries:
+            seq = model._sequence_for(q)
+            if seq is None:
+                out.append((qi, {"itemScores": []}))
+            else:
+                groups[bool(q.get("excludeSeen", False))].append((qi, q, seq))
+        inv = model.item_ids.inverse()
+        for exclude_seen, resolved in groups.items():
+            if not resolved:
+                continue
+            batch = np.stack([seq for _, _, seq in resolved])
+            num = max(int(q.get("num", 10)) for _, q, _ in resolved)
+            scores, idx = model.scorer().top_k(
+                batch, num, exclude_seen=exclude_seen
+            )
+            for (qi, q, _), s_row, i_row in zip(resolved, scores, idx):
+                n = int(q.get("num", 10))
+                out.append((qi, {
+                    "itemScores": [
+                        {"item": inv[int(i)], "score": float(s)}
+                        for s, i in zip(s_row[:n], i_row[:n])
+                        if i >= 0 and np.isfinite(s)
+                    ]
+                }))
+        return out

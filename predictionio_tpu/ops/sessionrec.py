@@ -1,0 +1,319 @@
+"""Sequential (next-item) recommendation: causal transformer over event
+histories.
+
+The reference's recommendation templates are order-blind matrix models
+(MLlib ALS); nothing in it models the event *sequence* (SURVEY.md §5.7).
+This module is the long-context model family the TPU rebuild adds: a
+SASRec-style causal self-attention encoder over each user's
+chronological item history, trained to predict the next item, with the
+sequence axis scalable past one device's HBM via the attention paths in
+ops.attention:
+
+  - ``attn_block > 0``: flash-style blockwise scan (single device, long
+    sequences without the O(L^2) score matrix),
+  - ``seq_axis``: ring attention — the sequence dimension sharded over a
+    mesh axis, kv blocks rotating over ICI (sequence/context
+    parallelism). FFN/LayerNorm are position-wise, so GSPMD shards them
+    along with the activations; only attention needs the ring.
+
+Fixed shapes throughout: histories truncated/padded to ``max_len``
+(item id 0 reserved for padding), so one compiled step serves every
+batch. Embeddings tied between input and output softmax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.ops.attention import (
+    blockwise_attention,
+    mha_reference,
+    ring_attention_sharded,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SessionRecConfig:
+    dim: int = 64
+    heads: int = 2
+    layers: int = 2
+    ffn_mult: int = 4
+    max_len: int = 64              # fixed sequence length (pad id = 0)
+    dropout: float = 0.1
+    learning_rate: float = 1e-3
+    weight_decay: float = 1e-6
+    epochs: int = 5
+    batch_size: int = 256
+    seed: int = 13
+    attn_block: int = 0            # >0: blockwise attention block size
+    seq_axis: Optional[str] = None  # mesh axis for ring attention (SP)
+
+
+class _Block(nn.Module):
+    """Pre-LN transformer block; attention path selected by config."""
+
+    cfg: SessionRecConfig
+    mesh: Optional[Mesh]
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, deterministic: bool) -> jax.Array:
+        cfg = self.cfg
+        h = nn.LayerNorm()(x)
+        B, L, _ = h.shape
+        head_dim = cfg.dim // cfg.heads
+        qkv = nn.DenseGeneral((3, cfg.heads, head_dim), axis=-1)(h)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]   # [B, L, H, Dh]
+        if cfg.seq_axis is not None and self.mesh is not None:
+            attn = ring_attention_sharded(
+                q, k, v, self.mesh, axis=cfg.seq_axis, causal=True
+            )
+        elif cfg.attn_block:
+            attn = blockwise_attention(q, k, v, block_size=cfg.attn_block)
+        else:
+            attn = mha_reference(q, k, v, causal=True)
+        attn = nn.DenseGeneral(cfg.dim, axis=(-2, -1))(attn)
+        attn = nn.Dropout(cfg.dropout)(attn, deterministic=deterministic)
+        x = x + attn
+        h = nn.LayerNorm()(x)
+        h = nn.Dense(cfg.dim * cfg.ffn_mult)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.dim)(h)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return x + h
+
+
+class SessionEncoder(nn.Module):
+    """Item+position embedding -> causal blocks -> hidden states.
+
+    Vocabulary is n_items + 1: index 0 is the padding token; real items
+    are 1-shifted by the caller.
+    """
+
+    n_items: int
+    cfg: SessionRecConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, seq: jax.Array, *, deterministic: bool = True) -> jax.Array:
+        cfg = self.cfg
+        emb = nn.Embed(self.n_items + 1, cfg.dim, name="item_embed")
+        x = emb(seq) * (cfg.dim ** 0.5)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (cfg.max_len, cfg.dim)
+        )
+        x = x + pos[None, : seq.shape[1]]
+        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+        for i in range(cfg.layers):
+            x = _Block(cfg, self.mesh, name=f"block_{i}")(
+                x, deterministic=deterministic
+            )
+        x = nn.LayerNorm(name="final_norm")(x)
+        # padding positions carry no signal downstream
+        return x * (seq > 0)[..., None]
+
+
+def build_sequences(
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    times: np.ndarray,
+    n_users: int,
+    max_len: int,
+) -> np.ndarray:
+    """Per-user chronological histories -> [n_users, max_len + 1] int32
+    of 1-shifted item ids, LEFT-aligned (trailing 0-pad); the +1 column
+    keeps the final target of each history. Left alignment means every
+    training prefix doubles as a short session starting at position 0 —
+    so serve-time sessions shorter than max_len are in-distribution.
+    Fully vectorized host pass: O(n log n) sort + O(n) scatter — no
+    per-user Python loop (the ops.ragged discipline applied to
+    sequence building)."""
+    order = np.lexsort((times, user_idx))
+    u, it = user_idx[order], item_idx[order] + 1
+    out = np.zeros((n_users, max_len + 1), np.int32)
+    if len(u) == 0:
+        return out
+    starts = np.searchsorted(u, np.arange(n_users))
+    ends = np.searchsorted(u, np.arange(n_users), side="right")
+    lengths = ends - starts
+    # each event's position within its user's history; keep only the
+    # last max_len+1 per user, left-aligned after the drop
+    pos = np.arange(len(u)) - starts[u]
+    drop = np.maximum(lengths - (max_len + 1), 0)[u]
+    kept = pos >= drop
+    out[u[kept], pos[kept] - drop[kept]] = it[kept]
+    return out
+
+
+@dataclasses.dataclass
+class SessionRecModelState:
+    """Serializable training product: params pytree (numpy leaves) +
+    per-user padded histories for serve-time encoding."""
+
+    params: Dict
+    sequences: np.ndarray          # [n_users, max_len] inputs (1-shifted)
+    n_items: int
+    cfg: SessionRecConfig
+    losses: List[float]
+
+
+class SessionRecTrainer:
+    """Mirrors ALSTrainer/TwoTowerTrainer: one-time costs (sequence
+    build, param init, compile) up front, `run()` drives jitted steps."""
+
+    def __init__(
+        self,
+        events: Tuple[np.ndarray, np.ndarray, np.ndarray],
+        n_users: int,
+        n_items: int,
+        cfg: SessionRecConfig,
+        mesh: Optional[Mesh] = None,
+    ):
+        u_idx, i_idx, times = events
+        self.cfg, self.mesh, self.n_items = cfg, mesh, n_items
+        seqs = build_sequences(
+            np.asarray(u_idx, np.int64), np.asarray(i_idx, np.int64),
+            np.asarray(times), n_users, cfg.max_len,
+        )
+        self.inputs = seqs[:, :-1]                     # [U, max_len]
+        self.targets = seqs[:, 1:]                     # next-item labels
+        keep = (self.targets > 0).any(axis=1)
+        self._train_rows = np.flatnonzero(keep)
+
+        self.encoder = SessionEncoder(n_items, cfg, mesh=mesh)
+        probe = jnp.zeros((1, cfg.max_len), jnp.int32)
+        self._params = self.encoder.init(
+            jax.random.PRNGKey(cfg.seed), probe, deterministic=True
+        )
+        self._tx = optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay)
+        self._opt_state = self._tx.init(self._params)
+
+        n_data = mesh.shape.get("data", 1) if mesh is not None else 1
+        self.batch = max(cfg.batch_size - cfg.batch_size % max(n_data, 1), n_data)
+        if mesh is not None:
+            rep = NamedSharding(mesh, P())
+            self._params = jax.device_put(self._params, rep)
+            self._opt_state = jax.device_put(self._opt_state, rep)
+            data_ax = "data" if "data" in mesh.shape else None
+            self._batch_sharding = NamedSharding(mesh, P(data_ax))
+        else:
+            self._batch_sharding = None
+        self._step = jax.jit(self._make_step(), donate_argnums=(0, 1))
+        self._shuffle = np.random.default_rng(cfg.seed)
+
+    def _make_step(self):
+        apply, tx, n_items = self.encoder.apply, self._tx, self.n_items
+
+        def loss_fn(params, seq, tgt, rng):
+            h = apply(
+                params, seq, deterministic=False, rngs={"dropout": rng}
+            )                                           # [B, L, D]
+            emb = params["params"]["item_embed"]["embedding"]   # tied softmax
+            logits = jnp.einsum("bld,vd->blv", h, emb)          # [B, L, V]
+            mask = (tgt > 0).astype(jnp.float32)
+            ll = optax.softmax_cross_entropy_with_integer_labels(logits, tgt)
+            return jnp.sum(ll * mask) / jnp.maximum(mask.sum(), 1e-8)
+
+        def step(params, opt_state, seq, tgt, rng):
+            loss, grads = jax.value_and_grad(loss_fn)(params, seq, tgt, rng)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return step
+
+    def run(self, epochs: Optional[int] = None) -> List[float]:
+        losses = []
+        rng = jax.random.PRNGKey(self.cfg.seed + 1)
+        for _ in range(epochs if epochs is not None else self.cfg.epochs):
+            order = self._shuffle.permutation(self._train_rows)
+            total, batches = 0.0, 0
+            for s in range(0, len(order), self.batch):
+                sel = order[s:s + self.batch]
+                if len(sel) < self.batch:   # fixed shape: wrap the tail
+                    sel = np.concatenate(
+                        [sel, order[: self.batch - len(sel)]]
+                    ) if len(order) >= self.batch else np.resize(sel, self.batch)
+                seq = jnp.asarray(self.inputs[sel])
+                tgt = jnp.asarray(self.targets[sel])
+                if self._batch_sharding is not None:
+                    seq = jax.device_put(seq, self._batch_sharding)
+                    tgt = jax.device_put(tgt, self._batch_sharding)
+                rng, sub = jax.random.split(rng)
+                self._params, self._opt_state, loss = self._step(
+                    self._params, self._opt_state, seq, tgt, sub
+                )
+                total += float(loss)
+                batches += 1
+            losses.append(total / max(batches, 1))
+        return losses
+
+    def state(self, losses: Optional[List[float]] = None) -> SessionRecModelState:
+        # serve-time input: the last max_len REAL items (drop the held
+        # -out target column, then re-truncate)
+        full = np.concatenate(
+            [self.inputs, self.targets[:, -1:]], axis=1
+        )                                          # [U, max_len+1] left-aligned
+        L = self.cfg.max_len
+        counts = (full > 0).sum(axis=1)
+        drop = np.maximum(counts - L, 0)           # at most 1 (full has L+1 cols)
+        # vectorized shift-left-by-drop + truncate to L columns
+        gather = np.minimum(drop[:, None] + np.arange(L)[None, :], full.shape[1] - 1)
+        serve = np.take_along_axis(full, gather, axis=1)
+        serve[np.arange(L)[None, :] >= counts[:, None] - drop[:, None]] = 0
+        params_np = jax.tree_util.tree_map(np.asarray, self._params)
+        return SessionRecModelState(
+            params=params_np, sequences=serve, n_items=self.n_items,
+            cfg=self.cfg, losses=losses or [],
+        )
+
+
+class SessionScorer:
+    """Serve path: encode a batch of histories, score the catalog from
+    the last hidden state, fixed-shape top-k with seen-item exclusion.
+    One compiled fn reused across requests (fixed [1, max_len] shape) —
+    the framework's <10 ms serving discipline applied to the deep model."""
+
+    def __init__(self, state: SessionRecModelState, mesh: Optional[Mesh] = None):
+        self.state = state
+        cfg = dataclasses.replace(state.cfg, dropout=0.0, seq_axis=None)
+        self._cfg = cfg
+        encoder = SessionEncoder(state.n_items, cfg, mesh=None)
+        params = jax.tree_util.tree_map(jnp.asarray, state.params)
+
+        def score(seq, exclude_seen):                    # [B, max_len]
+            h = encoder.apply(params, seq, deterministic=True)
+            # last non-pad position per row
+            idx = jnp.maximum(
+                (seq > 0).astype(jnp.int32).sum(axis=1) - 1, 0
+            )
+            last = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0]
+            emb = params["params"]["item_embed"]["embedding"]
+            logits = last @ emb.T                        # [B, V]
+            logits = logits.at[:, 0].set(-jnp.inf)       # never the pad token
+            if exclude_seen:                             # repeat items are a
+                B = seq.shape[0]                         # legitimate next-item
+                logits = logits.at[                      # answer, so opt-in
+                    jnp.arange(B)[:, None], seq
+                ].set(-jnp.inf)
+            return logits
+
+        self._score = jax.jit(score, static_argnums=1)
+
+    def top_k(
+        self, seq_rows: np.ndarray, k: int, *, exclude_seen: bool = False
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """(scores, 0-based item indices) of the k best next items; k is
+        clamped to the catalog size (num > catalog returns the full
+        ranking, not an error — TopKScorer's contract)."""
+        logits = self._score(jnp.asarray(seq_rows, jnp.int32), exclude_seen)
+        scores, idx = jax.lax.top_k(logits, min(k, logits.shape[1]))
+        return np.asarray(scores), np.asarray(idx) - 1   # unshift pad offset

@@ -17,4 +17,8 @@ engine.json variants.
   vanilla        — skeleton for new engines (ref: template gallery vanilla)
   regression     — linear regression over text-file features
                    (ref: examples/experimental/scala-parallel-regression)
+  sessionrec     — causal-transformer next-item prediction over ordered
+                   event histories; long sequences via blockwise or
+                   ring attention (no reference counterpart —
+                   SURVEY.md §5.7)
 """

@@ -1,0 +1,151 @@
+"""Sequential-recommendation engine template (next-item prediction).
+
+The data contract extends the recommendation template's (rate/buy/view
+events between user and item entities, ref: examples/
+scala-parallel-recommendation DataSource.scala:31) with the one thing
+the reference never uses: the event TIME. Histories are ordered by
+``event_time``, the model predicts what each user does next.
+
+Evaluation is leave-last-out — train on every event but each user's
+final one, query with the history, compare against the held-out item —
+the standard sequential-rec protocol (the reference's k-fold split,
+CrossValidation.scala:33, shuffles away order and would leak future
+events into training here).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.core import DataSource, Engine, FirstServing, Preparator, SanityCheck
+from predictionio_tpu.core.params import Params
+from predictionio_tpu.data import store
+from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.models.sessionrec import (
+    PreparedSequences,
+    SessionRecAlgorithm,
+)
+from predictionio_tpu.parallel.mesh import MeshContext
+
+
+@dataclass
+class SeqEvent:
+    user: str
+    item: str
+    time: float          # epoch seconds
+
+
+@dataclass
+class SequencesTD(SanityCheck):
+    events: List[SeqEvent] = field(default_factory=list)
+
+    def sanity_check(self) -> None:
+        if not self.events:
+            raise ValueError("SequencesTD is empty — no interaction events found")
+
+
+@dataclass
+class SeqDataSourceParams(Params):
+    app_name: str = ""
+    channel_name: Optional[str] = None
+    event_names: Tuple[str, ...] = ("view", "buy", "rate")
+    eval_query_num: int = 10
+    eval_enabled: bool = False
+
+
+class SeqDataSource(DataSource):
+    """Timestamped (user -> item) interactions from the event store."""
+
+    def __init__(self, params: SeqDataSourceParams):
+        super().__init__(params)
+
+    def _read(self) -> List[SeqEvent]:
+        p: SeqDataSourceParams = self.params
+        events = store.find(
+            p.app_name,
+            channel_name=p.channel_name,
+            entity_type="user",
+            event_names=list(p.event_names),
+            target_entity_type="item",
+        )
+        return [
+            SeqEvent(
+                user=e.entity_id,
+                item=e.target_entity_id,
+                time=e.event_time.timestamp(),
+            )
+            for e in events
+        ]
+
+    def read_training(self, ctx: MeshContext) -> SequencesTD:
+        return SequencesTD(events=self._read())
+
+    def read_eval(self, ctx: MeshContext):
+        """Leave-last-out: hold out each user's chronologically final
+        event; one fold."""
+        p: SeqDataSourceParams = self.params
+        if not p.eval_enabled:
+            return []
+        events = sorted(self._read(), key=lambda e: (e.user, e.time))
+        train: List[SeqEvent] = []
+        last: Dict[str, SeqEvent] = {}
+        for ev in events:
+            if ev.user in last:
+                train.append(last[ev.user])
+            last[ev.user] = ev
+        train_users = {t.user for t in train}
+        qa = [
+            ({"user": u, "num": p.eval_query_num}, {"item": ev.item})
+            for u, ev in sorted(last.items())
+            # users with a single event have no history left to query from
+            if u in train_users
+        ]
+        return [(SequencesTD(events=train), {"protocol": "leave-last-out"}, qa)]
+
+
+class SeqPreparator(Preparator):
+    """String ids -> dense indices, times kept (BiMap row, SURVEY.md §2.4)."""
+
+    def prepare(self, ctx: MeshContext, td: SequencesTD) -> PreparedSequences:
+        users = BiMap.string_int(e.user for e in td.events)
+        items = BiMap.string_int(e.item for e in td.events)
+        n = len(td.events)
+        return PreparedSequences(
+            user_ids=users,
+            item_ids=items,
+            user_idx=np.fromiter((users[e.user] for e in td.events), np.int64, count=n),
+            item_idx=np.fromiter((items[e.item] for e in td.events), np.int64, count=n),
+            times=np.fromiter((e.time for e in td.events), np.float64, count=n),
+        )
+
+
+def default_engine_params(
+    app_name: str,
+    channel_name: Optional[str] = None,
+    algo_params: Optional["SessionRecParams"] = None,
+    ds_params: Optional[SeqDataSourceParams] = None,
+) -> "EngineParams":
+    from predictionio_tpu.core.params import EngineParams
+    from predictionio_tpu.models.sessionrec import SessionRecParams
+
+    return EngineParams(
+        data_source_params=(
+            "",
+            ds_params
+            or SeqDataSourceParams(app_name=app_name, channel_name=channel_name),
+        ),
+        algorithm_params_list=[("sessionrec", algo_params or SessionRecParams())],
+    )
+
+
+def sessionrec_engine() -> Engine:
+    """Engine factory: causal-transformer next-item recommender."""
+    return Engine(
+        data_source_classes=SeqDataSource,
+        preparator_classes=SeqPreparator,
+        algorithm_classes={"sessionrec": SessionRecAlgorithm},
+        serving_classes=FirstServing,
+    )

@@ -45,6 +45,7 @@ BUILTIN_TEMPLATES = {
     "regression": "predictionio_tpu.templates.regression",
     "twotower": "predictionio_tpu.templates.twotower",
     "twotower-hybrid": "predictionio_tpu.templates.twotower",
+    "sessionrec": "predictionio_tpu.templates.sessionrec",
 }
 
 TEMPLATE_FACTORIES = {
@@ -56,6 +57,7 @@ TEMPLATE_FACTORIES = {
     "regression": "regression_engine",
     "twotower": "twotower_engine",
     "twotower-hybrid": "twotower_hybrid_engine",
+    "sessionrec": "sessionrec_engine",
 }
 
 

@@ -1,0 +1,113 @@
+"""Sequential recommender: training learns an obvious transition
+pattern; sequence-parallel (ring attention) training step runs on the
+mesh and matches the single-device forward."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from predictionio_tpu.ops.sessionrec import (
+    SessionEncoder,
+    SessionRecConfig,
+    SessionRecTrainer,
+    SessionScorer,
+    build_sequences,
+)
+from predictionio_tpu.parallel.mesh import create_mesh
+
+
+def _cyclic_events(n_users=64, n_items=12, hist=24, seed=0):
+    """Every user walks the item cycle 0,1,2,...,n-1,0,... from a random
+    offset — the next item is fully determined by the last one."""
+    rng = np.random.default_rng(seed)
+    users, items, times = [], [], []
+    for u in range(n_users):
+        start = rng.integers(0, n_items)
+        for t in range(hist):
+            users.append(u)
+            items.append((start + t) % n_items)
+            times.append(t)
+    return np.array(users), np.array(items), np.array(times, np.float64)
+
+
+def test_build_sequences_right_aligned_chronological():
+    u = np.array([1, 0, 1, 0])
+    i = np.array([5, 3, 7, 2])
+    t = np.array([2.0, 9.0, 4.0, 1.0])
+    out = build_sequences(u, i, t, n_users=3, max_len=3)
+    # user 0: time order (2@1, 3@9) -> 1-shifted [3, 4], left-aligned
+    np.testing.assert_array_equal(out[0], [3, 4, 0, 0])
+    np.testing.assert_array_equal(out[1], [6, 8, 0, 0])
+    np.testing.assert_array_equal(out[2], [0, 0, 0, 0])
+
+
+def test_trainer_learns_cycle_and_scorer_predicts_next():
+    users, items, times = _cyclic_events()
+    cfg = SessionRecConfig(
+        dim=32, heads=2, layers=1, max_len=16, dropout=0.0,
+        epochs=30, batch_size=64, learning_rate=3e-3,
+    )
+    tr = SessionRecTrainer((users, items, times), 64, 12, cfg)
+    losses = tr.run()
+    assert losses[-1] < losses[0] * 0.5, losses
+    state = tr.state(losses)
+    scorer = SessionScorer(state)
+    scores, idx = scorer.top_k(state.sequences[:8], k=1, exclude_seen=False)
+    # each user's last item is known; top-1 should be (last + 1) % n
+    rows = state.sequences[:8]
+    last_pos = (rows > 0).sum(axis=1) - 1
+    last = rows[np.arange(8), last_pos] - 1
+    expect = (last + 1) % 12
+    acc = float(np.mean(idx[:, 0] == expect))
+    assert acc >= 0.75, (idx[:, 0], expect)
+
+
+def test_scorer_excludes_seen_and_pad():
+    users, items, times = _cyclic_events(n_users=8, n_items=6, hist=4)
+    cfg = SessionRecConfig(dim=16, heads=2, layers=1, max_len=4,
+                           dropout=0.0, epochs=1, batch_size=8)
+    tr = SessionRecTrainer((users, items, times), 8, 6, cfg)
+    tr.run()
+    state = tr.state()
+    scorer = SessionScorer(state)
+    scores, idx = scorer.top_k(state.sequences[:4], k=2, exclude_seen=True)
+    for r in range(4):
+        seen = set(state.sequences[r][state.sequences[r] > 0] - 1)
+        assert not (set(idx[r]) & seen)
+        assert (idx[r] >= 0).all()
+
+
+def test_blockwise_and_ring_forward_match_materialized():
+    users, items, times = _cyclic_events(n_users=16, n_items=8, hist=32)
+    base = SessionRecConfig(dim=32, heads=2, layers=2, max_len=32, dropout=0.0)
+    enc = SessionEncoder(8, base)
+    seqs = build_sequences(users, items, times, 16, base.max_len)[:, :-1]
+    params = enc.init(jax.random.PRNGKey(0), jnp.asarray(seqs))
+    ref = enc.apply(params, jnp.asarray(seqs))
+
+    blk = SessionEncoder(8, dataclasses.replace(base, attn_block=8))
+    np.testing.assert_allclose(
+        np.asarray(blk.apply(params, jnp.asarray(seqs))),
+        np.asarray(ref), atol=1e-5,
+    )
+
+    mesh = create_mesh({"seq": 8})
+    ring = SessionEncoder(8, dataclasses.replace(base, seq_axis="seq"), mesh=mesh)
+    np.testing.assert_allclose(
+        np.asarray(ring.apply(params, jnp.asarray(seqs))),
+        np.asarray(ref), atol=1e-5,
+    )
+
+
+def test_seq_parallel_training_step_runs():
+    users, items, times = _cyclic_events(n_users=16, n_items=8, hist=32)
+    mesh = create_mesh({"data": 2, "seq": 4})
+    cfg = SessionRecConfig(
+        dim=16, heads=2, layers=1, max_len=32, dropout=0.0,
+        epochs=1, batch_size=8, seq_axis="seq",
+    )
+    tr = SessionRecTrainer((users, items, times), 16, 8, cfg, mesh=mesh)
+    losses = tr.run()
+    assert np.isfinite(losses[0])

@@ -1,0 +1,141 @@
+"""Sessionrec engine template: end-to-end against the in-memory event
+store — ordered histories in, next-item predictions out, leave-last-out
+evaluation fold."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.models.sessionrec import SessionRecParams
+from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.templates import sessionrec as seq_t
+
+UTC = dt.timezone.utc
+ctx = MeshContext()
+
+N_ITEMS = 8
+N_USERS = 24
+HIST = 12
+
+
+@pytest.fixture()
+def seq_app(memory_storage):
+    app = memory_storage.apps().insert("seqapp")
+    memory_storage.events().init(app.id)
+    # every user walks the item cycle from an offset — next item fully
+    # determined by the previous one
+    for u in range(N_USERS):
+        for t in range(HIST):
+            memory_storage.events().insert(
+                Event(
+                    event="view",
+                    entity_type="user",
+                    entity_id=f"u{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"i{(u + t) % N_ITEMS}",
+                    event_time=dt.datetime(2026, 1, 1, 0, 0, t, tzinfo=UTC),
+                ),
+                app.id,
+            )
+    return app
+
+
+FAST = SessionRecParams(
+    dim=32, heads=2, layers=1, max_len=HIST, dropout=0.0,
+    epochs=25, batch_size=32, learning_rate=3e-3,
+)
+
+
+def test_datasource_orders_and_eval_holds_out_last(memory_storage, seq_app):
+    ds = seq_t.SeqDataSource(
+        seq_t.SeqDataSourceParams(app_name="seqapp", eval_enabled=True))
+    td = ds.read_training(ctx)
+    assert len(td.events) == N_USERS * HIST
+    folds = ds.read_eval(ctx)
+    assert len(folds) == 1
+    train_td, info, qa = folds[0]
+    assert info["protocol"] == "leave-last-out"
+    assert len(train_td.events) == N_USERS * (HIST - 1)
+    assert len(qa) == N_USERS
+    # the held-out actual is each user's final item in the cycle
+    for q, a in qa:
+        u = int(q["user"][1:])
+        assert a["item"] == f"i{(u + HIST - 1) % N_ITEMS}"
+
+
+def test_train_and_predict_next(memory_storage, seq_app):
+    engine = seq_t.sessionrec_engine()
+    ep = seq_t.default_engine_params("seqapp", algo_params=FAST)
+    result = engine.train(ctx, ep)
+    model = result.models[0]
+
+    hits = 0
+    for u in range(8):
+        preds = engine.make_algorithms(ep)[0].predict(
+            model, {"user": f"u{u}", "num": 1})
+        expect = f"i{(u + HIST) % N_ITEMS}"
+        hits += bool(preds["itemScores"]) and preds["itemScores"][0]["item"] == expect
+    assert hits >= 6, f"only {hits}/8 next-item hits"
+
+    # anonymous session query: explicit items history, no known user
+    preds = engine.make_algorithms(ep)[0].predict(
+        model, {"items": ["i2", "i3", "i4"], "num": 1})
+    assert preds["itemScores"][0]["item"] == "i5"
+
+    # unknown user with no items -> empty, not an error
+    assert engine.make_algorithms(ep)[0].predict(model, {"user": "nobody", "num": 3}) == {
+        "itemScores": []
+    }
+
+
+def test_model_pickles_and_serves(memory_storage, seq_app):
+    import pickle
+
+    engine = seq_t.sessionrec_engine()
+    ep = seq_t.default_engine_params("seqapp", algo_params=FAST)
+    model = engine.train(ctx, ep).models[0]
+    blob = pickle.dumps(model)
+    loaded = pickle.loads(blob)
+    a = engine.make_algorithms(ep)[0].predict(model, {"user": "u0", "num": 3})
+    b = engine.make_algorithms(ep)[0].predict(loaded, {"user": "u0", "num": 3})
+    assert [x["item"] for x in a["itemScores"]] == [x["item"] for x in b["itemScores"]]
+
+
+def test_num_larger_than_catalog_returns_full_ranking(memory_storage, seq_app):
+    engine = seq_t.sessionrec_engine()
+    ep = seq_t.default_engine_params("seqapp", algo_params=FAST)
+    model = engine.train(ctx, ep).models[0]
+    preds = engine.make_algorithms(ep)[0].predict(model, {"user": "u0", "num": 500})
+    assert 0 < len(preds["itemScores"]) <= N_ITEMS
+
+
+def test_batch_predict_honors_exclude_seen(memory_storage, seq_app):
+    engine = seq_t.sessionrec_engine()
+    ep = seq_t.default_engine_params("seqapp", algo_params=FAST)
+    model = engine.train(ctx, ep).models[0]
+    algo = engine.make_algorithms(ep)[0]
+    # u0 saw every item except none (8-item catalog, 12 views) — use an
+    # explicit short session so some items remain unseen
+    q = {"items": ["i0", "i1"], "num": 8, "excludeSeen": True}
+    batched = dict(algo.batch_predict(model, [(0, q)]))[0]
+    single = algo.predict(model, q)
+    items = {x["item"] for x in batched["itemScores"]}
+    assert items == {x["item"] for x in single["itemScores"]}
+    assert not items & {"i0", "i1"}
+
+
+def test_batch_predict_matches_predict(memory_storage, seq_app):
+    engine = seq_t.sessionrec_engine()
+    ep = seq_t.default_engine_params("seqapp", algo_params=FAST)
+    model = engine.train(ctx, ep).models[0]
+    algo = engine.make_algorithms(ep)[0]
+    queries = [(i, {"user": f"u{i}", "num": 3}) for i in range(6)]
+    queries.append((6, {"user": "ghost", "num": 3}))
+    batched = dict(algo.batch_predict(model, queries))
+    for i, q in queries:
+        single = algo.predict(model, q)
+        assert [x["item"] for x in batched[i]["itemScores"]] == [
+            x["item"] for x in single["itemScores"]
+        ]
