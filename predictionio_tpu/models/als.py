@@ -62,9 +62,12 @@ class ALSParams(Params):
     alpha: float = 1.0
     block_size: int = 4096
     seed: int = 3
-    seg_len: int = 256                # virtual-row length (ops.ragged)
+    seg_len: object = "auto"          # virtual-row length (int), or
+                                      # "auto": sized from the group-
+                                      # size histogram (ops.ragged)
     solver: str = "cg"               # "cg" | "direct"
     cg_iters: int = 16
+    cg_dtype: str = "bfloat16"       # CG matvec dtype ("float32" to opt out)
     compute_dtype: str = "bfloat16"  # Gramian input dtype (f32 accumulate)
     use_pallas: str = "never"        # fused gather+Gramian kernel (ops.gramian)
     # optional hard caps (None = keep every rating; the segmented layout
@@ -164,6 +167,7 @@ class ALSAlgorithm(Algorithm):
             seg_len=p.seg_len,
             solver=p.solver,
             cg_iters=p.cg_iters,
+            cg_dtype=p.cg_dtype,
             compute_dtype=p.compute_dtype,
             use_pallas=p.use_pallas,
         )

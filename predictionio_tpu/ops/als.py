@@ -51,9 +51,15 @@ class ALSConfig:
     seed: int = 7
     solver: str = "cg"        # "cg" (MXU-friendly, default) | "direct" (LU)
     cg_iters: int = 16        # CG steps; 16 reaches ~1e-3 rel err at K=64
+    cg_dtype: str = "bfloat16"  # CG matvec storage dtype: the solve is
+                                # HBM-bound on re-reading A each step, so
+                                # bf16 halves it (f32 accumulate/recurrences)
     compute_dtype: str = "bfloat16"  # gather/Gramian input dtype; accumulation
                                      # is always f32 (MXU native bf16xbf16->f32)
-    seg_len: int = 256        # virtual-row length for the segmented layout
+    seg_len: object = "auto"  # virtual-row length (int), or "auto": sized
+                              # from the group-size histogram to minimize
+                              # padded slots — the gather is issue-bound,
+                              # so padding costs like real entries
     use_pallas: str = "never"  # "never" | "auto" | "always" — fused
                                # gather+Gramian kernel (ops.gramian) for
                                # the partial stage when the opposing
@@ -76,31 +82,46 @@ def _build_side(
     return build_segmented_groups(
         group_idx, item_idx, vals, n_groups, seg_len=cfg.seg_len,
         max_len=max_len, n_shards=n_shards, block_size=cfg.block_size,
+        # per-row overhead in equivalent slots: the [rows, K, K] partial
+        # HBM round trip relative to the per-slot gather cost
+        row_cost_slots=max(8.0, cfg.rank * cfg.rank / 300.0),
     )
 
 
-def _batched_cg(A, b, iters: int, x0=None):
+def _batched_cg(A, b, iters: int, x0=None, matvec_dtype=jnp.float32):
     """Batched conjugate gradient for SPD K x K systems.
 
     TPU-shaped replacement for ``jnp.linalg.solve``: batched LU/Cholesky
-    lowers poorly on TPU (~10x slower than the einsum work feeding it),
+    lowers poorly on TPU (~20x slower than the einsum work feeding it),
     while CG is pure batched matvecs the MXU eats. 16 iterations reach
     ~1e-3 relative error at K=64 — far below ALS's own convergence
     tolerance. ``x0`` warm-starts from the previous outer iteration's
     factors (they drift slowly), buying the same residual in fewer steps.
+
+    ``matvec_dtype=bfloat16`` stores A once in bf16 and runs the matvecs
+    from it with f32 accumulation: CG is HBM-bound on re-reading A every
+    iteration, so this halves solve time; the bf16 residual floor
+    (~2e-3 relative at K=64) sits below ALS's tolerance. All scalar
+    recurrences (alpha, beta, x, r) stay f32.
     """
+    Am = A.astype(matvec_dtype)
+
+    def matvec(v):
+        return jnp.einsum("bij,bj->bi", Am, v.astype(matvec_dtype),
+                          preferred_element_type=jnp.float32)
+
     if x0 is None:
         x = jnp.zeros_like(b)
         r = b
     else:
         x = x0
-        r = b - jnp.einsum("bij,bj->bi", A, x0)
+        r = b - matvec(x0)
     p = r
     rs = jnp.einsum("bi,bi->b", r, r)
 
     def body(carry, _):
         x, r, p, rs = carry
-        Ap = jnp.einsum("bij,bj->bi", A, p)
+        Ap = matvec(p)
         alpha = rs / (jnp.einsum("bi,bi->b", p, Ap) + 1e-20)
         x = x + alpha[:, None] * p
         r = r - alpha[:, None] * Ap
@@ -114,7 +135,7 @@ def _batched_cg(A, b, iters: int, x0=None):
 
 def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
                  alpha, row_block, group_block, groups_loc, solver, cg_iters,
-                 compute_dtype, pallas_mode=0):
+                 cg_dtype, compute_dtype, pallas_mode=0):
     """Solve all groups of one shard from segmented virtual rows.
 
     Three stages, all static-shape:
@@ -144,7 +165,7 @@ def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
         return _solve_groups(Ar, br, X_prev, seg, counts, Yc, rank=rank,
                              reg=reg, implicit=implicit, group_block=group_block,
                              groups_loc=groups_loc, solver=solver,
-                             cg_iters=cg_iters)
+                             cg_iters=cg_iters, cg_dtype=cg_dtype)
 
     def partial_block(args):
         idx_b, val_b, mask_b = args
@@ -174,11 +195,12 @@ def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
     br = br.reshape(R_loc, rank)
     return _solve_groups(Ar, br, X_prev, seg, counts, Yc, rank=rank, reg=reg,
                          implicit=implicit, group_block=group_block,
-                         groups_loc=groups_loc, solver=solver, cg_iters=cg_iters)
+                         groups_loc=groups_loc, solver=solver,
+                         cg_iters=cg_iters, cg_dtype=cg_dtype)
 
 
 def _solve_groups(Ar, br, X_prev, seg, counts, Yc, *, rank, reg, implicit,
-                  group_block, groups_loc, solver, cg_iters):
+                  group_block, groups_loc, solver, cg_iters, cg_dtype):
     """Stages 2+3: segment-sum row partials to groups, regularize, solve."""
     f32 = jnp.float32
     A = jax.ops.segment_sum(Ar, seg, num_segments=groups_loc,
@@ -206,7 +228,8 @@ def _solve_groups(Ar, br, X_prev, seg, counts, Yc, *, rank, reg, implicit,
             n_u = jnp.maximum(cnt_b.astype(f32), 1.0)
             A_b = A_b + (reg * n_u)[:, None, None] * eye
         if solver == "cg":
-            return _batched_cg(A_b, b_b, cg_iters, x0=x0_b)   # [B, K]
+            return _batched_cg(A_b, b_b, cg_iters, x0=x0_b,
+                               matvec_dtype=jnp.dtype(cg_dtype))   # [B, K]
         return jnp.linalg.solve(A_b, b_b[..., None])[..., 0]
 
     out = jax.lax.map(solve_block, (A, b, cnt, x0))  # [ngb, B, K]
@@ -227,9 +250,13 @@ def _pallas_mode(cfg: ALSConfig, n_table_rows: Optional[int]) -> int:
     dtype_bytes = jnp.dtype(cfg.compute_dtype).itemsize
     if not supported(n_table_rows, cfg.rank, cfg.implicit, dtype_bytes):
         return 0
-    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
-    if on_tpu:
-        return 1
+    # Compiled Mosaic mode is OFF on every backend: Mosaic (jax 0.9)
+    # cannot lower the kernel's per-row dynamic VMEM loads (vector.load
+    # demands 8-aligned sublane starts), and the measured XLA path is
+    # gather-ISSUE-bound, not HBM-latency-bound, so a VMEM-resident
+    # table would not beat it anyway. "always" keeps its contract by
+    # running the interpreter (exact same kernel logic, any backend);
+    # "auto" means "compiled kernel when profitable" -> XLA path today.
     return 2 if cfg.use_pallas == "always" else 0
 
 
@@ -240,7 +267,8 @@ def make_half_step(mesh: Optional[Mesh], cfg: ALSConfig, row_block: int,
     kwargs = dict(
         rank=cfg.rank, reg=cfg.reg, implicit=cfg.implicit, alpha=cfg.alpha,
         row_block=row_block, group_block=group_block, groups_loc=groups_loc,
-        solver=cfg.solver, cg_iters=cfg.cg_iters, compute_dtype=cfg.compute_dtype,
+        solver=cfg.solver, cg_iters=cfg.cg_iters, cg_dtype=cfg.cg_dtype,
+        compute_dtype=cfg.compute_dtype,
         pallas_mode=_pallas_mode(cfg, n_table_rows),
     )
     fn = functools.partial(_solve_shard, **kwargs)
@@ -325,6 +353,7 @@ class ALSTrainer:
         )
         self._ud = self._to_device(by_user)
         self._it = self._to_device(by_item)
+        self._run_cache = {}
 
     def _to_device(self, sg: SegmentedGroups):
         arrs = (jnp.asarray(sg.idx), jnp.asarray(sg.val), jnp.asarray(sg.mask),
@@ -338,24 +367,58 @@ class ALSTrainer:
             arrs = tuple(jax.device_put(a, s) for a, s in zip(arrs, shardings))
         return arrs
 
-    def compile(self) -> "ALSTrainer":
-        """Force both half-step compilations (bench warm-up).
+    def _run_compiled(self, n: int):
+        """One jitted program for n full alternations: `lax.scan` over
+        (user solve; item solve) — a single dispatch instead of 2n, so
+        per-call host/tunnel latency never gaps the device."""
+        fn = self._run_cache.get(n)
+        if fn is None:
+            user_step, item_step = self._user_step, self._item_step
+            n_ud = len(self._ud)
 
-        Synced via scalar readback: on tunneled backends
-        ``block_until_ready`` can return before compilation/execution
-        actually happens, so a host pull is the only reliable barrier.
+            def run_n(X, Y, *data):
+                ud, it = data[:n_ud], data[n_ud:]
+
+                def body(carry, _):
+                    X, Y = carry
+                    X = user_step(Y, X, *ud)
+                    Y = item_step(X, Y, *it)
+                    return (X, Y), None
+
+                (X, Y), _ = jax.lax.scan(body, (X, Y), None, length=n)
+                return X, Y
+
+            fn = jax.jit(run_n, donate_argnums=(0, 1))
+            self._run_cache[n] = fn
+        return fn
+
+    def compile(self) -> "ALSTrainer":
+        """Warm the default-iteration-count program (bench warm-up).
+
+        Executes one real run on throwaway copies of the factors
+        (donation-safe; the virgin factors stay untouched) — AOT
+        `.lower().compile()` is NOT used because tunneled backends hand
+        back a far slower executable than the jit dispatch path, and
+        `block_until_ready` can return early there, so the only reliable
+        barrier is a host scalar pull.
         """
-        _force(self._user_step(self._Y, self._X, *self._ud))
-        _force(self._item_step(self._X, self._Y, *self._it))
+        fn = self._run_compiled(self.cfg.iterations)
+        X0, Y0 = jnp.array(self._X), jnp.array(self._Y)   # donated copies
+        out = fn(X0, Y0, *self._ud, *self._it)
+        _force(out[0])
         return self
 
+    def step_n(self, iterations: Optional[int] = None) -> None:
+        """Run n alternations on device, synced by a scalar pull; factors
+        stay device-resident (materialize with `factors()`)."""
+        n = iterations if iterations is not None else self.cfg.iterations
+        fn = self._run_compiled(n)
+        self._X, self._Y = fn(self._X, self._Y, *self._ud, *self._it)
+        _force(self._X)
+
     def run(self, iterations: Optional[int] = None) -> ALSFactors:
-        X, Y = self._X, self._Y
-        for _ in range(iterations if iterations is not None else self.cfg.iterations):
-            X = self._user_step(Y, X, *self._ud)
-            Y = self._item_step(X, Y, *self._it)
-        self._X, self._Y = X, Y
-        return self.factors()  # np.asarray is the real sync barrier
+        self.step_n(iterations)
+        return self.factors()
 
     def factors(self) -> ALSFactors:
         return ALSFactors(

@@ -165,10 +165,16 @@ def ring_attention(
         vc = jax.lax.ppermute(vc, axis, perm)
         return m, l, o, kc, vc
 
-    m, l, o, _, _ = jax.lax.fori_loop(
-        0, size, body,
+    # S-1 rotate-and-accumulate steps, then the final block accumulates
+    # WITHOUT rotating — the last ppermute's output is dead, and a ring
+    # exchange per layer per step is too expensive to waste
+    m, l, o, kc, vc = jax.lax.fori_loop(
+        0, size - 1, body,
         (m0, l0, o0, k.astype(jnp.float32), v.astype(jnp.float32)),
     )
+    src = (my - (size - 1)) % size
+    k_pos = src * kc.shape[1] + jnp.arange(kc.shape[1])
+    m, l, o = _accum_block(qf, kc, vc, m, l, o, q_pos, k_pos, causal)
     return _finish(m, l, o, dtype)
 
 

@@ -72,7 +72,11 @@ def _kernel(idx_ref, val_ref, mask_ref, y_ref, A_ref, b_ref, yg_scratch):
             yg, yg, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        b_ref[r] = jnp.dot(val_ref[r, :], yg, preferred_element_type=jnp.float32)
+        # 2D x 2D dot: Mosaic's dot lowering rejects 1D operands
+        b_ref[r] = jax.lax.dot_general(
+            val_ref[pl.ds(r, 1), :], yg, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )[0]
 
 
 @functools.partial(

@@ -134,23 +134,51 @@ class SegmentedGroups:
         return self.idx.shape[0]
 
 
+def auto_seg_len(
+    counts: np.ndarray, row_cost_slots: float = 16.0,
+    lo: int = 16, hi: int = 512,
+) -> int:
+    """Pick the virtual-row length minimizing estimated device cost.
+
+    The consumer's stage-1 work is proportional to total SLOTS (padding
+    gathers and multiplies like real entries — the TPU gather is
+    issue-bound, so every slot costs the same), plus a per-ROW overhead
+    (the [rows, K, K] partial-Gramian HBM round trip), expressed in
+    equivalent slots: cost(L) = rows(L) * (L + row_cost_slots).
+    Evaluated exactly from the group-size histogram.
+    """
+    c = counts[counts > 0]
+    if len(c) == 0:
+        return lo
+    best_L, best_cost = lo, None
+    for L in range(lo, hi + 1, 16):
+        rows = int(np.sum(-(-c // L)))
+        cost = rows * (L + row_cost_slots)
+        if best_cost is None or cost < best_cost:
+            best_L, best_cost = L, cost
+    return best_L
+
+
 def build_segmented_groups(
     group_idx: np.ndarray,
     item_idx: np.ndarray,
     values: np.ndarray,
     n_groups: int,
-    seg_len: int = 256,
+    seg_len="auto",
     max_len: Optional[int] = None,
     n_shards: int = 1,
     block_size: int = 4096,
+    row_cost_slots: float = 16.0,
 ) -> SegmentedGroups:
     """Bin COO triples into fixed-length virtual rows with segment ids.
 
-    ``block_size`` bounds the lax.map blocks; the row and group axes of
-    each shard are padded to exact multiples of the chosen blocks (both
-    returned on the result). ``max_len`` optionally caps a group's
-    entries (keeping the latest) before row splitting; None keeps
-    everything.
+    ``seg_len`` is the virtual-row length, or ``"auto"`` to size it
+    from the group-size distribution (``auto_seg_len`` — minimizes
+    padded slots, the dominant device cost). ``block_size`` bounds the
+    lax.map blocks; the row and group axes of each shard are padded to
+    exact multiples of the chosen blocks (both returned on the result).
+    ``max_len`` optionally caps a group's entries (keeping the latest)
+    before row splitting; None keeps everything.
     """
     group_idx = np.asarray(group_idx, dtype=np.int64)
     item_idx = np.asarray(item_idx, dtype=np.int64)
@@ -158,9 +186,15 @@ def build_segmented_groups(
     if not (len(group_idx) == len(item_idx) == len(values)):
         raise ValueError("COO arrays must have equal length")
     nnz = len(group_idx)
-    L = max(pad_to_multiple(seg_len, 8), 8)
 
     counts_true = np.bincount(group_idx, minlength=n_groups).astype(np.int64)
+    if isinstance(seg_len, str):
+        if seg_len != "auto":
+            raise ValueError(f"seg_len must be an int or 'auto', got {seg_len!r}")
+        capped = (counts_true if max_len is None
+                  else np.minimum(counts_true, max_len))
+        seg_len = auto_seg_len(capped, row_cost_slots)
+    L = max(pad_to_multiple(seg_len, 8), 8)
     g_raw = pad_to_multiple(max(1, -(-n_groups // n_shards)), 8)
     group_block = min(block_size, g_raw)
     g_per_shard = pad_to_multiple(g_raw, group_block)
