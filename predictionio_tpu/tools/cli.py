@@ -318,6 +318,54 @@ def cmd_shell(args) -> int:
     return 0
 
 
+def cmd_run(args) -> int:
+    """Generic entry-point runner (ref: Runner.scala:27 — `pio run
+    <mainClass>` spark-submits an arbitrary class on the PIO classpath).
+    Here: resolve a dotted `module.callable` (or a bare module, executed
+    as __main__) in-process with storage already configured, passing the
+    remaining argv through."""
+    target = args.target
+    passthrough = list(args.args or [])
+    module_name, _, attr = target.rpartition(".")
+    obj = None
+    if module_name:
+        try:
+            obj = getattr(importlib.import_module(module_name), attr, None)
+        except ImportError:
+            obj = None
+    def exit_code(value, from_exit: bool) -> int:
+        if isinstance(value, bool):      # True = success, not exit code 1
+            return 0 if value else 1
+        if isinstance(value, int):
+            return value
+        if value is None:
+            return 0
+        # non-int: a result object from a callable is success; a
+        # SystemExit message (sys.exit("msg")) is failure
+        return 1 if from_exit else 0
+
+    if obj is not None and callable(obj):
+        try:
+            return exit_code(obj(passthrough), from_exit=False)
+        except SystemExit as e:
+            return exit_code(e.code, from_exit=True)
+    import runpy
+
+    old_argv = sys.argv
+    sys.argv = [target] + passthrough
+    try:
+        runpy.run_module(target, run_name="__main__")
+    except SystemExit as e:   # module mains exit; keep their code
+        return exit_code(e.code, from_exit=True)
+    except ImportError as e:
+        raise CommandError(
+            f"cannot resolve {target!r} as a callable or module: {e}"
+        ) from e
+    finally:
+        sys.argv = old_argv
+    return 0
+
+
 def cmd_status(args) -> int:
     results = commands.status()
     ok = all(results.values())
@@ -457,6 +505,13 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("shell", help="interactive Python shell with the "
                                      "framework preloaded (ref: bin/pio-shell)")
     p.set_defaults(func=cmd_shell)
+
+    p = sub.add_parser("run", help="run a dotted module.callable (or module "
+                                   "as __main__) with storage configured "
+                                   "(ref: pio run / Runner.scala)")
+    p.add_argument("target")
+    p.add_argument("args", nargs=argparse.REMAINDER)
+    p.set_defaults(func=cmd_run)
 
     p_t = sub.add_parser("template", help="list or scaffold templates")
     t_sub = p_t.add_subparsers(dest="template_command", required=True)

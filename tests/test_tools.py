@@ -152,6 +152,15 @@ class TestImportExport:
             eventdata.import_events("bad", str(f), storage=memory_storage)
 
 
+_RUN_ARGS = None
+
+
+def _run_target(argv):
+    global _RUN_ARGS
+    _RUN_ARGS = list(argv)
+    return 0
+
+
 class TestCLI:
     def test_app_and_template_commands(self, memory_storage, tmp_path, capsys):
         assert cli_main(["app", "new", "cliapp"]) == 0
@@ -168,6 +177,15 @@ class TestCLI:
         assert cli_main(["template", "get", "vanilla", tdir]) == 0
         variant = json.load(open(f"{tdir}/engine.json"))
         assert variant["engineFactory"].endswith("vanilla_engine")
+
+    def test_run_command(self, memory_storage, tmp_path, capsys):
+        # dotted callable: gets passthrough argv, return value is exit code
+        import tests.test_tools as me
+        assert cli_main(["run", "tests.test_tools._run_target", "a", "b"]) == 0
+        assert me._RUN_ARGS == ["a", "b"]
+        # bare module executed as __main__ (prints the platform string)
+        assert cli_main(["run", "platform"]) == 0
+        assert capsys.readouterr().out.strip()
 
     def test_build_train_via_cli(self, memory_storage, tmp_path, capsys):
         tdir = str(tmp_path / "eng")
