@@ -313,7 +313,18 @@ class SessionScorer:
     ) -> Tuple[np.ndarray, np.ndarray]:
         """(scores, 0-based item indices) of the k best next items; k is
         clamped to the catalog size (num > catalog returns the full
-        ranking, not an error — TopKScorer's contract)."""
-        logits = self._score(jnp.asarray(seq_rows, jnp.int32), exclude_seen)
+        ranking, not an error — TopKScorer's contract). The batch is
+        bucketed to powers of two so micro-batched serving's arbitrary
+        batch sizes reuse a handful of compiled programs."""
+        seq_rows = np.atleast_2d(np.asarray(seq_rows, np.int32))
+        B = seq_rows.shape[0]
+        b_bucket = 1
+        while b_bucket < B:
+            b_bucket *= 2
+        if B < b_bucket:   # pad rows are all-padding sequences
+            seq_rows = np.concatenate(
+                [seq_rows, np.zeros((b_bucket - B, seq_rows.shape[1]), np.int32)]
+            )
+        logits = self._score(jnp.asarray(seq_rows), exclude_seen)
         scores, idx = jax.lax.top_k(logits, min(k, logits.shape[1]))
-        return np.asarray(scores), np.asarray(idx) - 1   # unshift pad offset
+        return np.asarray(scores)[:B], np.asarray(idx)[:B] - 1  # unshift pad

@@ -67,10 +67,13 @@ def _pow2_bucket(n: int, lo: int, hi: int) -> int:
 
 def _prepare_score_inputs(user_vecs, k: int, exclude_idx, n_items: int,
                           max_exclude: int):
-    """Shared serve-path shape discipline for the scorers: batch the
-    user vectors, default/broadcast/bucket the exclusion lists (capped
-    at ``max_exclude``, oldest dropped first), bucket k to powers of
-    two. Returns (user_vecs [B, K], exclude [B, E_bucket], k, k_bucket)."""
+    """Shared serve-path shape discipline for the scorers: bucket the
+    BATCH to a power of two (zero-row padding — micro-batched serving
+    produces arbitrary batch sizes, and every novel B would otherwise
+    compile a fresh program), default/broadcast/bucket the exclusion
+    lists (capped at ``max_exclude``, oldest dropped first), bucket k to
+    powers of two. Returns (user_vecs [B_bucket, K],
+    exclude [B_bucket, E_bucket], k, k_bucket, true_batch)."""
     user_vecs = jnp.atleast_2d(jnp.asarray(user_vecs, dtype=jnp.float32))
     B = user_vecs.shape[0]
     if exclude_idx is None:
@@ -83,9 +86,19 @@ def _prepare_score_inputs(user_vecs, k: int, exclude_idx, n_items: int,
     if exclude_idx.shape[1] < e_bucket:
         pad = np.full((B, e_bucket - exclude_idx.shape[1]), -1, dtype=np.int32)
         exclude_idx = np.concatenate([exclude_idx, pad], axis=1)
+    b_bucket = _pow2_bucket(B, 1, 1 << 30)
+    if B < b_bucket:
+        user_vecs = jnp.concatenate(
+            [user_vecs,
+             jnp.zeros((b_bucket - B, user_vecs.shape[1]), user_vecs.dtype)]
+        )
+        exclude_idx = np.concatenate(
+            [exclude_idx,
+             np.full((b_bucket - B, exclude_idx.shape[1]), -1, np.int32)]
+        )
     k = min(k, n_items)
     k_bucket = min(_pow2_bucket(k, 8, 1 << 20), n_items)
-    return user_vecs, jnp.asarray(exclude_idx), k, k_bucket
+    return user_vecs, jnp.asarray(exclude_idx), k, k_bucket, B
 
 
 class TopKScorer:
@@ -113,13 +126,13 @@ class TopKScorer:
         first) — callers needing exact long blacklists should filter
         host-side on the returned ranking.
         """
-        user_vecs, exclude_idx, k, k_bucket = _prepare_score_inputs(
+        user_vecs, exclude_idx, k, k_bucket, B = _prepare_score_inputs(
             user_vecs, k, exclude_idx, self.item_factors.shape[0],
             self.max_exclude)
         scores, idx = _topk_scores(
             user_vecs, self.item_factors, exclude_idx, k_bucket
         )
-        return np.asarray(scores)[:, :k], np.asarray(idx)[:, :k]
+        return np.asarray(scores)[:B, :k], np.asarray(idx)[:B, :k]
 
     def score_masked(
         self,
@@ -134,12 +147,25 @@ class TopKScorer:
         <= NEG_INF — callers drop them by score threshold.
         """
         user_vecs = jnp.atleast_2d(jnp.asarray(user_vecs, dtype=jnp.float32))
+        B = user_vecs.shape[0]
+        b_bucket = _pow2_bucket(B, 1, 1 << 30)
+        mask = np.asarray(mask, dtype=bool)
+        if B < b_bucket:   # batch bucketing (see _prepare_score_inputs)
+            user_vecs = jnp.concatenate(
+                [user_vecs,
+                 jnp.zeros((b_bucket - B, user_vecs.shape[1]), user_vecs.dtype)]
+            )
+            if mask.ndim == 2:
+                mask = np.concatenate(
+                    [mask, np.zeros((b_bucket - B, mask.shape[1]), bool)]
+                )
         n_items = self.item_factors.shape[0]
-        k_bucket = min(_pow2_bucket(min(k, n_items), 8, 1 << 20), n_items)
+        k = min(k, n_items)
+        k_bucket = min(_pow2_bucket(k, 8, 1 << 20), n_items)
         scores, idx = _topk_scores_masked(
-            user_vecs, self.item_factors, jnp.asarray(mask, dtype=bool), k_bucket
+            user_vecs, self.item_factors, jnp.asarray(mask), k_bucket
         )
-        return np.asarray(scores)[:, :k], np.asarray(idx)[:, :k]
+        return np.asarray(scores)[:B, :k], np.asarray(idx)[:B, :k]
 
 
 def make_sharded_topk(mesh, axis: str, n_items_global: int, k: int,
@@ -247,11 +273,11 @@ class ShardedTopKScorer:
         k: int,
         exclude_idx: Optional[np.ndarray] = None,
     ) -> Tuple[np.ndarray, np.ndarray]:
-        user_vecs, exclude_idx, k, k_bucket = _prepare_score_inputs(
+        user_vecs, exclude_idx, k, k_bucket, B = _prepare_score_inputs(
             user_vecs, k, exclude_idx, self.n_items, self.max_exclude)
         scores, idx = self._fn(k_bucket)(
             user_vecs, self.item_factors, exclude_idx)
-        return np.asarray(scores)[:, :k], np.asarray(idx)[:, :k]
+        return np.asarray(scores)[:B, :k], np.asarray(idx)[:B, :k]
 
 
 def cosine_normalize(m: np.ndarray, eps: float = 1e-8) -> np.ndarray:

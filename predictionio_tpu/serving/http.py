@@ -53,6 +53,12 @@ class JSONRequestHandler(BaseHTTPRequestHandler):
         return json.loads(self._read_body() or b"{}")
 
 
+class _ThreadingHTTPServer(ThreadingHTTPServer):
+    # the stdlib default backlog of 5 drops connections under serving
+    # bursts (micro-batched engines legitimately queue dozens)
+    request_queue_size = 128
+
+
 class HTTPServerBase:
     """Bind (with retry), run on a daemon thread, stop cleanly.
 
@@ -66,7 +72,7 @@ class HTTPServerBase:
         attempts = max(1, bind_retries)
         for attempt in range(attempts):
             try:
-                self.httpd = ThreadingHTTPServer((host, port), handler)
+                self.httpd = _ThreadingHTTPServer((host, port), handler)
                 break
             except OSError as e:
                 log.warning("bind attempt %d failed: %s", attempt + 1, e)

@@ -75,6 +75,21 @@ class Deployment:
         ]
         return self.serving.serve(q, predictions)
 
+    def query_batch(self, payloads: List[Any]) -> List[Any]:
+        """Many queries through each algorithm's vectorized
+        ``batch_predict`` (one device dispatch per algorithm instead of
+        one per query), then per-query Serving. The serve-time analogue
+        of the evaluation batch path (SURVEY.md §7.5 micro-batching)."""
+        indexed = list(enumerate(payloads))
+        per_algo = [
+            dict(algo.batch_predict(model, indexed))
+            for algo, model in zip(self.algorithms, self.models)
+        ]
+        return [
+            self.serving.serve(q, [preds[i] for preds in per_algo])
+            for i, q in indexed
+        ]
+
 
 def prepare_deploy(
     engine: Engine,

@@ -290,6 +290,42 @@ def test_engine_server_reload_hot_swaps(engine_server):
     assert http("POST", f"{base}/queries.json", {"mult": 1})[1] == {"result": 20.0}
 
 
+def test_micro_batched_concurrent_queries(engine_server):
+    """Concurrent requests coalesce through Deployment.query_batch and
+    every waiter gets ITS result; a malformed query in a batch 400s
+    alone instead of failing its batchmates."""
+    import threading
+
+    server, engine, storage = engine_server
+    base = f"http://127.0.0.1:{server.port}"
+    payloads = [{"mult": m} for m in range(1, 9)] + [{"wrong": 1}]
+    results = [None] * len(payloads)
+
+    def fire(i):
+        results[i] = http("POST", f"{base}/queries.json", payloads[i])
+
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(len(payloads))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, m in enumerate(range(1, 9)):
+        assert results[i] == (200, {"result": 3.0 * m}), results[i]
+    assert results[-1][0] == 400
+    # server still healthy afterwards
+    assert http("POST", f"{base}/queries.json", {"mult": 2})[1] == {"result": 6.0}
+
+
+def test_deployment_query_batch_matches_query(memory_storage):
+    engine, instance = train_const(memory_storage)
+    from predictionio_tpu.workflow.deploy import prepare_deploy
+
+    dep = prepare_deploy(engine, instance, storage=memory_storage)
+    payloads = [{"mult": m} for m in (2, 5, 7)]
+    assert dep.query_batch(payloads) == [dep.query(p) for p in payloads]
+
+
 def test_engine_server_requires_completed_instance(memory_storage):
     with pytest.raises(RuntimeError, match="No valid engine instance"):
         EngineServer(const_engine(), "never-trained", host="127.0.0.1", port=0,
