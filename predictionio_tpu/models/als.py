@@ -69,7 +69,6 @@ class ALSParams(Params):
     cg_iters: int = 16
     cg_dtype: str = "bfloat16"       # CG matvec dtype ("float32" to opt out)
     compute_dtype: str = "bfloat16"  # Gramian input dtype (f32 accumulate)
-    use_pallas: str = "never"        # fused gather+Gramian kernel (ops.gramian)
     # optional hard caps (None = keep every rating; the segmented layout
     # makes caps unnecessary except as an outlier guard)
     max_ratings_per_user: Optional[int] = None
@@ -169,7 +168,6 @@ class ALSAlgorithm(Algorithm):
             cg_iters=p.cg_iters,
             cg_dtype=p.cg_dtype,
             compute_dtype=p.compute_dtype,
-            use_pallas=p.use_pallas,
         )
         factors = als_train(
             (pd.user_idx, pd.item_idx, pd.ratings),

@@ -60,12 +60,12 @@ class ALSConfig:
                               # from the group-size histogram to minimize
                               # padded slots — the gather is issue-bound,
                               # so padding costs like real entries
-    use_pallas: str = "never"  # "never" | "auto" | "always" — fused
-                               # gather+Gramian kernel (ops.gramian) for
-                               # the partial stage when the opposing
-                               # table fits VMEM; "auto" gates on a TPU
-                               # backend, "always" uses the interpreter
-                               # elsewhere (tests)
+    # NOTE: a fused gather+Gramian Pallas kernel (VMEM-resident table,
+    # aligned-tile one-hot gathers) was built, lowered through Mosaic and
+    # measured on a real chip: 0.46-0.66x the XLA path at ML-20M shapes
+    # (G=27k K=64 R=8192 L in {128,512}, f32 and bf16) — the stage is
+    # gather-ISSUE-bound and the one-hot select costs ALIGNx more VMEM
+    # loads per slot than the hardware gather XLA emits. Removed.
 
 
 def _build_side(
@@ -135,7 +135,7 @@ def _batched_cg(A, b, iters: int, x0=None, matvec_dtype=jnp.float32):
 
 def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
                  alpha, row_block, group_block, groups_loc, solver, cg_iters,
-                 cg_dtype, compute_dtype, pallas_mode=0):
+                 cg_dtype, compute_dtype):
     """Solve all groups of one shard from segmented virtual rows.
 
     Three stages, all static-shape:
@@ -156,16 +156,6 @@ def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
     cdt = jnp.dtype(compute_dtype)
     f32 = jnp.float32
     Yc = Y.astype(cdt)
-
-    if pallas_mode:  # 1 = compiled kernel, 2 = interpreter (tests)
-        from predictionio_tpu.ops.gramian import rowwise_gramians
-
-        Ar, br = rowwise_gramians(Yc, idx, val, mask,
-                                  interpret=pallas_mode == 2)
-        return _solve_groups(Ar, br, X_prev, seg, counts, Yc, rank=rank,
-                             reg=reg, implicit=implicit, group_block=group_block,
-                             groups_loc=groups_loc, solver=solver,
-                             cg_iters=cg_iters, cg_dtype=cg_dtype)
 
     def partial_block(args):
         idx_b, val_b, mask_b = args
@@ -236,40 +226,14 @@ def _solve_groups(Ar, br, X_prev, seg, counts, Yc, *, rank, reg, implicit,
     return out.reshape(groups_loc, rank)
 
 
-def _pallas_mode(cfg: ALSConfig, n_table_rows: Optional[int]) -> int:
-    """0 = XLA path, 1 = compiled Pallas kernel, 2 = interpreter."""
-    if cfg.use_pallas not in ("never", "auto", "always"):
-        raise ValueError(
-            f"use_pallas must be 'never', 'auto' or 'always', got "
-            f"{cfg.use_pallas!r}"
-        )
-    if cfg.use_pallas == "never" or n_table_rows is None:
-        return 0
-    from predictionio_tpu.ops.gramian import supported
-
-    dtype_bytes = jnp.dtype(cfg.compute_dtype).itemsize
-    if not supported(n_table_rows, cfg.rank, cfg.implicit, dtype_bytes):
-        return 0
-    # Compiled Mosaic mode is OFF on every backend: Mosaic (jax 0.9)
-    # cannot lower the kernel's per-row dynamic VMEM loads (vector.load
-    # demands 8-aligned sublane starts), and the measured XLA path is
-    # gather-ISSUE-bound, not HBM-latency-bound, so a VMEM-resident
-    # table would not beat it anyway. "always" keeps its contract by
-    # running the interpreter (exact same kernel logic, any backend);
-    # "auto" means "compiled kernel when profitable" -> XLA path today.
-    return 2 if cfg.use_pallas == "always" else 0
-
-
 def make_half_step(mesh: Optional[Mesh], cfg: ALSConfig, row_block: int,
-                   group_block: int, groups_loc: int,
-                   n_table_rows: Optional[int] = None):
+                   group_block: int, groups_loc: int):
     """Compile one ALS half-step, sharded over the mesh ``data`` axis."""
     kwargs = dict(
         rank=cfg.rank, reg=cfg.reg, implicit=cfg.implicit, alpha=cfg.alpha,
         row_block=row_block, group_block=group_block, groups_loc=groups_loc,
         solver=cfg.solver, cg_iters=cfg.cg_iters, cg_dtype=cfg.cg_dtype,
         compute_dtype=cfg.compute_dtype,
-        pallas_mode=_pallas_mode(cfg, n_table_rows),
     )
     fn = functools.partial(_solve_shard, **kwargs)
     if mesh is not None and np.prod([mesh.shape[a] for a in mesh.axis_names]) > 1:
@@ -345,11 +309,11 @@ class ALSTrainer:
 
         self._user_step = make_half_step(
             mesh, cfg, by_user.row_block, by_user.group_block,
-            by_user.groups_per_shard, n_table_rows=self._g_items,
+            by_user.groups_per_shard,
         )
         self._item_step = make_half_step(
             mesh, cfg, by_item.row_block, by_item.group_block,
-            by_item.groups_per_shard, n_table_rows=self._g_users,
+            by_item.groups_per_shard,
         )
         self._ud = self._to_device(by_user)
         self._it = self._to_device(by_item)

@@ -284,7 +284,19 @@ class SessionScorer:
 
     def __init__(self, state: SessionRecModelState, mesh: Optional[Mesh] = None):
         self.state = state
-        cfg = dataclasses.replace(state.cfg, dropout=0.0, seq_axis=None)
+        attn_block = state.cfg.attn_block
+        if state.cfg.seq_axis is not None and not attn_block:
+            # the model was trained with ring attention precisely because
+            # max_len's O(L^2) score matrix is too big for one device;
+            # serving single-device must not materialize it — fall back
+            # to blockwise attention with the largest power-of-two block
+            # <= 512 that divides max_len
+            attn_block = 512
+            while state.cfg.max_len % attn_block:
+                attn_block //= 2
+        cfg = dataclasses.replace(
+            state.cfg, dropout=0.0, seq_axis=None, attn_block=attn_block
+        )
         self._cfg = cfg
         encoder = SessionEncoder(state.n_items, cfg, mesh=None)
         params = jax.tree_util.tree_map(jnp.asarray, state.params)
@@ -326,5 +338,7 @@ class SessionScorer:
                 [seq_rows, np.zeros((b_bucket - B, seq_rows.shape[1]), np.int32)]
             )
         logits = self._score(jnp.asarray(seq_rows), exclude_seen)
-        scores, idx = jax.lax.top_k(logits, min(k, logits.shape[1]))
+        # clamp to the true catalog size: column 0 is the pad token and
+        # is always -inf, so it must never count toward (or appear in) k
+        scores, idx = jax.lax.top_k(logits, min(k, logits.shape[1] - 1))
         return np.asarray(scores)[:B], np.asarray(idx)[:B] - 1  # unshift pad

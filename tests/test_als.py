@@ -181,41 +181,6 @@ def test_topk_scorer_and_exclusion():
     assert idx[0].tolist() == [1, 2]
 
 
-def test_pallas_gramian_matches_xla():
-    from predictionio_tpu.ops.gramian import rowwise_gramians, rowwise_gramians_xla
-
-    rng = np.random.default_rng(3)
-    G, K, R, L = 48, 16, 12, 16
-    Y = jnp.asarray(rng.normal(size=(G, K)).astype(np.float32))
-    idx = jnp.asarray(rng.integers(0, G, size=(R, L)).astype(np.int32))
-    mask = jnp.asarray((rng.random((R, L)) > 0.3).astype(np.float32))
-    val = jnp.asarray(rng.random((R, L)).astype(np.float32)) * mask
-    A1, b1 = rowwise_gramians(Y, idx, val, mask, interpret=True)
-    A2, b2 = rowwise_gramians_xla(Y, idx, val, mask)
-    np.testing.assert_allclose(A1, A2, atol=1e-4)
-    np.testing.assert_allclose(b1, b2, atol=1e-4)
-    # bf16 table (the default compute dtype) must trace and stay close
-    A3, b3 = rowwise_gramians(Y.astype(jnp.bfloat16), idx, val, mask,
-                              interpret=True)
-    np.testing.assert_allclose(A3, A2, rtol=5e-2, atol=5e-2)
-
-
-def test_als_pallas_path_matches_xla_path():
-    """Full training with the fused kernel (interpreter) must reproduce
-    the XLA path's factors."""
-    rng = np.random.default_rng(5)
-    nnz, n_users, n_items = 300, 24, 12
-    coo = (rng.integers(0, n_users, nnz), rng.integers(0, n_items, nnz),
-           rng.random(nnz).astype(np.float32) * 4 + 1)
-    kw = dict(rank=8, iterations=3, reg=0.1, block_size=16, seg_len=8,
-              compute_dtype="float32")
-    f_xla = als_train(coo, n_users, n_items, ALSConfig(**kw, use_pallas="never"))
-    f_pal = als_train(coo, n_users, n_items, ALSConfig(**kw, use_pallas="always"))
-    np.testing.assert_allclose(
-        f_xla.user_factors, f_pal.user_factors, rtol=1e-3, atol=1e-4
-    )
-
-
 def test_cosine_normalize():
     m = np.array([[3.0, 4.0], [0.0, 0.0]])
     n = cosine_normalize(m)
