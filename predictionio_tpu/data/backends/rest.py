@@ -1,0 +1,418 @@
+"""``rest`` storage backend: proxy DAOs talking to a Storage Server.
+
+The reference reaches its scale-out tiers through network clients —
+HBase RPC for events, the Elasticsearch transport client for metadata
+(elasticsearch/StorageClient.scala:42), HDFS for model blobs
+(hdfs/HDFSModels.scala:28). This backend is that client side for the
+TPU build's own storage service (serving/storage_server.py): every DAO
+call becomes an HTTP request, so any number of trainer/serving hosts
+share one logical METADATA / EVENTDATA / MODELDATA over DCN.
+
+Source config (reference env grammar, conf/pio-env.sh.template):
+
+    PIO_STORAGE_SOURCES_CENTRAL_TYPE=rest
+    PIO_STORAGE_SOURCES_CENTRAL_HOSTS=10.0.0.5
+    PIO_STORAGE_SOURCES_CENTRAL_PORTS=7077
+    PIO_STORAGE_SOURCES_CENTRAL_AUTH_KEY=...   # optional shared secret
+    PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE=CENTRAL   # etc.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data import metadata as MD
+from predictionio_tpu.data.metadata import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    Model,
+)
+from predictionio_tpu.data import storage as S
+
+
+class _Transport:
+    """One storage-server endpoint + auth; shared by all proxy DAOs."""
+
+    def __init__(self, base_url: str, auth_key: Optional[str], timeout: float):
+        self.base_url = base_url.rstrip("/")
+        self.auth_key = auth_key
+        self.timeout = timeout
+
+    def _request_obj(self, path, body, method, content_type) -> urllib.request.Request:
+        req = urllib.request.Request(
+            self.base_url + path, data=body, method=method,
+            headers={"Content-Type": content_type},
+        )
+        if self.auth_key:
+            req.add_header("X-PIO-Storage-Key", self.auth_key)
+        return req
+
+    def _error(self, path: str, e: urllib.error.HTTPError) -> S.StorageError:
+        payload = e.read()
+        try:
+            message = json.loads(payload).get("message", payload.decode())
+        except Exception:  # noqa: BLE001 — raw body is the best we have
+            message = payload.decode(errors="replace")
+        return S.StorageError(
+            f"storage server {self.base_url}{path}: HTTP {e.code}: {message}"
+        )
+
+    def request(
+        self,
+        path: str,
+        body: Optional[bytes] = None,
+        method: str = "POST",
+        content_type: str = "application/json",
+    ):
+        """(status, body bytes). A 404 is returned (not raised) ONLY when
+        the server marks it as a data miss (``{"missing": true}``); a
+        bare 404 means route/version skew and raises StorageError, so it
+        can never masquerade as empty data."""
+        req = self._request_obj(path, body, method, content_type)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                payload = e.read()
+                try:
+                    missing = json.loads(payload).get("missing", False)
+                except Exception:  # noqa: BLE001
+                    missing = False
+                if missing:
+                    return 404, payload
+                raise S.StorageError(
+                    f"storage server {self.base_url}{path}: unknown route "
+                    "(server/client version skew?)"
+                ) from None
+            raise self._error(path, e) from None
+        except urllib.error.URLError as e:
+            raise S.StorageError(
+                f"storage server {self.base_url} unreachable: {e.reason}"
+            ) from None
+
+    def json_call(self, path: str, payload: Dict[str, Any]) -> Any:
+        status, body = self.request(path, json.dumps(payload).encode())
+        if status == 404:
+            return None
+        return json.loads(body)
+
+    def stream_lines(self, path: str, payload: Dict[str, Any]):
+        """Yield non-empty response lines without buffering the body
+        (the server chunk-streams finds; urllib decodes transparently)."""
+        req = self._request_obj(
+            path, json.dumps(payload).encode(), "POST", "application/json"
+        )
+        try:
+            resp = urllib.request.urlopen(req, timeout=self.timeout)
+        except urllib.error.HTTPError as e:
+            raise self._error(path, e) from None
+        except urllib.error.URLError as e:
+            raise S.StorageError(
+                f"storage server {self.base_url} unreachable: {e.reason}"
+            ) from None
+        with resp:
+            for line in resp:
+                line = line.strip()
+                if line:
+                    yield line
+
+
+class RestEventStore(S.EventStore):
+    def __init__(self, transport: _Transport):
+        self._t = transport
+
+    def _call(self, method: str, app_id, channel_id, **extra) -> Any:
+        payload = {"app_id": int(app_id), "channel_id": channel_id}
+        payload.update(extra)
+        return self._t.json_call(f"/storage/events/{method}", payload)
+
+    def init(self, app_id, channel_id=None):
+        self._call("init", app_id, channel_id)
+
+    def remove(self, app_id, channel_id=None):
+        self._call("remove", app_id, channel_id)
+
+    def insert(self, event: Event, app_id, channel_id=None) -> str:
+        out = self._call("insert", app_id, channel_id,
+                         event=event.to_dict(api_format=False))
+        return out["eventId"]
+
+    def insert_batch(self, events, app_id, channel_id=None) -> List[str]:
+        out = self._call("insert_batch", app_id, channel_id,
+                         events=[e.to_dict(api_format=False) for e in events])
+        return out["eventIds"]
+
+    def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
+        out = self._call("get", app_id, channel_id, event_id=event_id)
+        return Event.from_dict(out["event"]) if out else None
+
+    def delete(self, event_id, app_id, channel_id=None) -> bool:
+        return bool(self._call("delete", app_id, channel_id,
+                               event_id=event_id)["found"])
+
+    def find(
+        self,
+        app_id,
+        channel_id=None,
+        start_time=None,
+        until_time=None,
+        entity_type=None,
+        entity_id=None,
+        event_names=None,
+        target_entity_type=S.UNSET,
+        target_entity_id=S.UNSET,
+        limit=None,
+        reversed=False,
+    ) -> List[Event]:
+        payload: Dict[str, Any] = {
+            "app_id": int(app_id),
+            "channel_id": channel_id,
+            "start_time": start_time.isoformat() if start_time else None,
+            "until_time": until_time.isoformat() if until_time else None,
+            "entity_type": entity_type,
+            "entity_id": entity_id,
+            "event_names": list(event_names) if event_names is not None else None,
+            "limit": limit,
+            "reversed": bool(reversed),
+        }
+        # tri-state target filters (absent | null | value) via *_set flags
+        if target_entity_type is not S.UNSET:
+            payload["target_entity_type_set"] = True
+            payload["target_entity_type"] = target_entity_type
+        if target_entity_id is not S.UNSET:
+            payload["target_entity_id_set"] = True
+            payload["target_entity_id"] = target_entity_id
+        return [
+            Event.from_dict(json.loads(line))
+            for line in self._t.stream_lines("/storage/events/find", payload)
+        ]
+
+
+class _RestRepo:
+    """Generic metadata repo proxy: method calls become /storage/meta RPCs."""
+
+    repo: str = ""
+    record_cls: type = object
+
+    def __init__(self, transport: _Transport):
+        self._t = transport
+
+    def _rpc(self, method: str, args: List[Any], kind: str) -> Any:
+        out = self._t.json_call(
+            f"/storage/meta/{self.repo}/{method}", {"args": args}
+        )
+        result = out["result"] if out else None
+        if result is None:
+            return [] if kind == "records" else None
+        if kind == "record":
+            return MD.dict_to_record(self.record_cls, result)
+        if kind == "records":
+            return [MD.dict_to_record(self.record_cls, r) for r in result]
+        return result
+
+
+class RestAppsRepo(_RestRepo, S.AppsRepo):
+    repo, record_cls = "apps", App
+
+    def insert(self, name, description=None):
+        return self._rpc("insert", [name, description], "record")
+
+    def get(self, app_id):
+        return self._rpc("get", [int(app_id)], "record")
+
+    def get_by_name(self, name):
+        return self._rpc("get_by_name", [name], "record")
+
+    def get_all(self):
+        return self._rpc("get_all", [], "records")
+
+    def update(self, app):
+        self._rpc("update", [MD.record_to_dict(app)], "scalar")
+
+    def delete(self, app_id):
+        self._rpc("delete", [int(app_id)], "scalar")
+
+
+class RestAccessKeysRepo(_RestRepo, S.AccessKeysRepo):
+    repo, record_cls = "access_keys", AccessKey
+
+    def insert(self, access_key):
+        return self._rpc("insert", [MD.record_to_dict(access_key)], "scalar")
+
+    def get(self, key):
+        return self._rpc("get", [key], "record")
+
+    def get_all(self):
+        return self._rpc("get_all", [], "records")
+
+    def get_by_app_id(self, app_id):
+        return self._rpc("get_by_app_id", [int(app_id)], "records")
+
+    def update(self, access_key):
+        self._rpc("update", [MD.record_to_dict(access_key)], "scalar")
+
+    def delete(self, key):
+        self._rpc("delete", [key], "scalar")
+
+
+class RestChannelsRepo(_RestRepo, S.ChannelsRepo):
+    repo, record_cls = "channels", Channel
+
+    def insert(self, name, app_id):
+        return self._rpc("insert", [name, int(app_id)], "record")
+
+    def get(self, channel_id):
+        return self._rpc("get", [int(channel_id)], "record")
+
+    def get_by_app_id(self, app_id):
+        return self._rpc("get_by_app_id", [int(app_id)], "records")
+
+    def delete(self, channel_id):
+        self._rpc("delete", [int(channel_id)], "scalar")
+
+
+class RestEngineManifestsRepo(_RestRepo, S.EngineManifestsRepo):
+    repo, record_cls = "engine_manifests", EngineManifest
+
+    def insert(self, manifest):
+        self._rpc("insert", [MD.record_to_dict(manifest)], "scalar")
+
+    def get(self, id, version):
+        return self._rpc("get", [id, version], "record")
+
+    def get_all(self):
+        return self._rpc("get_all", [], "records")
+
+    def update(self, manifest):
+        self._rpc("update", [MD.record_to_dict(manifest)], "scalar")
+
+    def delete(self, id, version):
+        self._rpc("delete", [id, version], "scalar")
+
+
+class RestEngineInstancesRepo(_RestRepo, S.EngineInstancesRepo):
+    repo, record_cls = "engine_instances", EngineInstance
+
+    def insert(self, instance):
+        return self._rpc("insert", [MD.record_to_dict(instance)], "scalar")
+
+    def get(self, id):
+        return self._rpc("get", [id], "record")
+
+    def get_all(self):
+        return self._rpc("get_all", [], "records")
+
+    def get_latest_completed(self, engine_id, engine_version, engine_variant):
+        return self._rpc(
+            "get_latest_completed",
+            [engine_id, engine_version, engine_variant], "record",
+        )
+
+    def get_completed(self, engine_id, engine_version, engine_variant):
+        return self._rpc(
+            "get_completed", [engine_id, engine_version, engine_variant],
+            "records",
+        )
+
+    def update(self, instance):
+        self._rpc("update", [MD.record_to_dict(instance)], "scalar")
+
+    def delete(self, id):
+        self._rpc("delete", [id], "scalar")
+
+
+class RestEvaluationInstancesRepo(_RestRepo, S.EvaluationInstancesRepo):
+    repo, record_cls = "evaluation_instances", EvaluationInstance
+
+    def insert(self, instance):
+        return self._rpc("insert", [MD.record_to_dict(instance)], "scalar")
+
+    def get(self, id):
+        return self._rpc("get", [id], "record")
+
+    def get_all(self):
+        return self._rpc("get_all", [], "records")
+
+    def get_completed(self):
+        return self._rpc("get_completed", [], "records")
+
+    def update(self, instance):
+        self._rpc("update", [MD.record_to_dict(instance)], "scalar")
+
+    def delete(self, id):
+        self._rpc("delete", [id], "scalar")
+
+
+class RestModelsRepo(S.ModelsRepo):
+    """Model blobs as raw bodies — the HDFSModels role over HTTP."""
+
+    def __init__(self, transport: _Transport):
+        self._t = transport
+
+    def insert(self, model: Model) -> None:
+        self._t.request(
+            f"/storage/models/{model.id}", bytes(model.models), method="PUT",
+            content_type="application/octet-stream",
+        )
+
+    def get(self, id: str) -> Optional[Model]:
+        status, body = self._t.request(
+            f"/storage/models/{id}", method="GET"
+        )
+        if status == 404:
+            return None
+        return Model(id=id, models=body)
+
+    def delete(self, id: str) -> None:
+        self._t.request(f"/storage/models/{id}", method="DELETE")
+
+
+class RestStorageClient(S.StorageClient):
+    """Storage source of TYPE ``rest`` (HOSTS/PORTS per the env grammar)."""
+
+    def __init__(self, config: Dict[str, str]):
+        super().__init__(config)
+        host = (config.get("HOSTS") or "127.0.0.1").split(",")[0].strip()
+        port = (config.get("PORTS") or "7077").split(",")[0].strip()
+        scheme = config.get("SCHEME", "http")
+        timeout = float(config.get("TIMEOUT", "30"))
+        self._transport = _Transport(
+            f"{scheme}://{host}:{port}", config.get("AUTH_KEY"), timeout
+        )
+        self._events = RestEventStore(self._transport)
+        self._apps = RestAppsRepo(self._transport)
+        self._access_keys = RestAccessKeysRepo(self._transport)
+        self._channels = RestChannelsRepo(self._transport)
+        self._engine_manifests = RestEngineManifestsRepo(self._transport)
+        self._engine_instances = RestEngineInstancesRepo(self._transport)
+        self._evaluation_instances = RestEvaluationInstancesRepo(self._transport)
+        self._models = RestModelsRepo(self._transport)
+
+    def events(self): return self._events
+    def apps(self): return self._apps
+    def access_keys(self): return self._access_keys
+    def channels(self): return self._channels
+    def engine_manifests(self): return self._engine_manifests
+    def engine_instances(self): return self._engine_instances
+    def evaluation_instances(self): return self._evaluation_instances
+    def models(self): return self._models
+
+    def health_check(self) -> bool:
+        """`pio status` probe: the server must answer GET / as alive."""
+        try:
+            status, body = self._transport.request("/", method="GET")
+        except S.StorageError:
+            return False
+        return status == 200 and json.loads(body).get("status") == "alive"
+
+
+S.register_backend("rest", RestStorageClient)

@@ -266,6 +266,12 @@ class StorageClient(abc.ABC):
     @abc.abstractmethod
     def models(self) -> ModelsRepo: ...
 
+    def health_check(self) -> bool:
+        """Backend reachability probe (ref: Storage.verifyAllDataObjects
+        instantiates each DAO against its live backend). Local backends
+        are healthy by construction; network backends override."""
+        return True
+
 
 # ---------------------------------------------------------------------------
 # Registry + env config
@@ -281,7 +287,9 @@ def register_backend(type_name: str, client_cls: type) -> None:
 def _load_backends() -> None:
     # import side-effect registers the built-in backends (the native
     # eventlog backend compiles lazily — importing it is cheap)
-    from predictionio_tpu.data.backends import memory, localfs, sqlite, eventlog  # noqa: F401
+    from predictionio_tpu.data.backends import (  # noqa: F401
+        memory, localfs, sqlite, eventlog, rest,
+    )
 
 
 _SOURCE_RE = re.compile(r"^PIO_STORAGE_SOURCES_([^_]+)_(.+)$")
@@ -336,8 +344,7 @@ class Storage:
         results: Dict[str, bool] = {}
         for repo in REPOSITORIES:
             try:
-                self.client_for(repo)
-                results[repo] = True
+                results[repo] = self.client_for(repo).health_check()
             except Exception:
                 results[repo] = False
         return results

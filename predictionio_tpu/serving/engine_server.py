@@ -34,7 +34,7 @@ import threading
 import time
 import urllib.request
 import uuid
-from typing import Any, Optional
+from typing import Any, List, Optional
 from urllib.parse import urlparse
 
 from predictionio_tpu.core.engine import Engine
@@ -77,13 +77,14 @@ class ServingStats:
 
 
 class _Pending:
-    __slots__ = ("payload", "event", "result", "error")
+    __slots__ = ("payload", "event", "result", "error", "abandoned")
 
     def __init__(self, payload):
         self.payload = payload
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
+        self.abandoned = False  # submitter timed out; skip device work
 
 
 class MicroBatcher:
@@ -110,28 +111,45 @@ class MicroBatcher:
         self._max_batch = max_batch
         self._queue: "_queue.Queue[_Pending]" = _queue.Queue()
         self._stop = False
+        # orders submit()'s stop-check+enqueue against stop()'s flag+wake,
+        # so nothing can be enqueued after the worker's shutdown drain
+        self._stop_lock = threading.Lock()
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
 
     def submit(self, payload, timeout: float = 30.0):
         pending = _Pending(payload)
-        self._queue.put(pending)
+        with self._stop_lock:
+            if self._stop:
+                raise RuntimeError("serving batcher is stopped")
+            self._queue.put(pending)
         if not pending.event.wait(timeout):
+            # leave a tombstone so the worker spends no device time
+            # answering a waiter that already gave up
+            pending.abandoned = True
             raise TimeoutError("query timed out in the serving batcher")
         if pending.error is not None:
             raise pending.error
         return pending.result
 
     def stop(self) -> None:
-        self._stop = True
-        self._queue.put(_Pending(None))  # wake the worker
+        with self._stop_lock:
+            if self._stop:
+                return
+            self._stop = True
+            self._queue.put(_Pending(None))  # wake the worker
+        # the worker's shutdown drain answers everything still queued, so
+        # no submitter blocks out its full timeout on a dying server
+        self._worker.join(timeout=60)
 
     def _loop(self) -> None:
         import queue as _queue
 
-        while not self._stop:
+        leftover: List[_Pending] = []
+        while True:
             first = self._queue.get()
             if self._stop:
+                leftover.append(first)
                 break
             batch = [first]
             while len(batch) < self._max_batch:
@@ -140,8 +158,22 @@ class MicroBatcher:
                 except _queue.Empty:
                     break
             self._answer(batch)
+        # shutdown drain: only the worker consumes the queue, so nothing
+        # races it; the stop-lock guarantees no later enqueues
+        while True:
+            try:
+                leftover.append(self._queue.get_nowait())
+            except _queue.Empty:
+                break
+        for p in leftover:
+            if p.payload is not None and not p.event.is_set():
+                p.error = RuntimeError("serving batcher stopped")
+                p.event.set()
 
     def _answer(self, batch) -> None:
+        batch = [p for p in batch if not p.abandoned]
+        if not batch:
+            return
         if len(batch) == 1:
             p = batch[0]
             try:

@@ -1,0 +1,359 @@
+"""The Storage Server: DAO-level REST storage service, default port 7077.
+
+The reference delegates scale-out storage to external network services —
+HBase for events (client RPC, data/.../storage/hbase/StorageClient.scala),
+Elasticsearch for metadata (transport port 9300,
+elasticsearch/StorageClient.scala:42), HDFS for model blobs
+(hdfs/HDFSModels.scala:28). This server is the TPU build's equivalent
+network tier: it exposes the *storage DAO contracts* (EventStore, the
+metadata repos, ModelsRepo) over HTTP, backed by whatever local backend
+the server process is configured with (eventlog/sqlite/localfs/memory).
+N serving hosts + M trainer hosts point a ``rest``-type storage source
+(data/backends/rest.py) at one storage server and share one logical
+METADATA / EVENTDATA / MODELDATA — train on host A, deploy on host B.
+
+Routes:
+  - ``GET  /``                            {"status": "alive"}
+  - ``POST /storage/events/<method>``     init/remove/insert/insert_batch/
+                                          get/delete — JSON body, DB-format
+                                          event dicts
+  - ``POST /storage/events/find``         filter body -> NDJSON stream
+                                          (one DB-format event per line)
+  - ``POST /storage/meta/<repo>/<method>``whitelisted repo RPC (args array,
+                                          records as dicts)
+  - ``PUT/GET/DELETE /storage/models/<id>`` raw model blobs
+
+Optional shared-secret auth: configure ``AUTH_KEY`` on the server and the
+client; every request must carry it in ``X-PIO-Storage-Key`` (the
+reference's storage tiers sit on a trusted network; the key guards
+against accidental cross-environment writes, not adversaries).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+from typing import Any, Dict, List, Optional
+
+from predictionio_tpu.data.event import Event
+from predictionio_tpu.data import metadata as MD
+from predictionio_tpu.data.metadata import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    Model,
+)
+from predictionio_tpu.data.storage import (
+    UNSET,
+    Storage,
+    StorageError,
+    get_storage,
+)
+from predictionio_tpu.serving.http import HTTPServerBase, JSONRequestHandler
+
+log = logging.getLogger(__name__)
+
+DEFAULT_PORT = 7077
+
+#: per-repo RPC whitelist: method -> (record-arg positions, result kind)
+#: result kinds: "record" | "records" | "scalar"
+_REPO_SPECS: Dict[str, Dict[str, Any]] = {
+    "apps": {
+        "record_cls": App,
+        "methods": {
+            "insert": ((), "record"),
+            "get": ((), "record"),
+            "get_by_name": ((), "record"),
+            "get_all": ((), "records"),
+            "update": ((0,), "scalar"),
+            "delete": ((), "scalar"),
+        },
+    },
+    "access_keys": {
+        "record_cls": AccessKey,
+        "methods": {
+            "insert": ((0,), "scalar"),
+            "get": ((), "record"),
+            "get_all": ((), "records"),
+            "get_by_app_id": ((), "records"),
+            "update": ((0,), "scalar"),
+            "delete": ((), "scalar"),
+        },
+    },
+    "channels": {
+        "record_cls": Channel,
+        "methods": {
+            "insert": ((), "record"),
+            "get": ((), "record"),
+            "get_by_app_id": ((), "records"),
+            "delete": ((), "scalar"),
+        },
+    },
+    "engine_manifests": {
+        "record_cls": EngineManifest,
+        "methods": {
+            "insert": ((0,), "scalar"),
+            "get": ((), "record"),
+            "get_all": ((), "records"),
+            "update": ((0,), "scalar"),
+            "delete": ((), "scalar"),
+        },
+    },
+    "engine_instances": {
+        "record_cls": EngineInstance,
+        "methods": {
+            "insert": ((0,), "scalar"),
+            "get": ((), "record"),
+            "get_all": ((), "records"),
+            "get_latest_completed": ((), "record"),
+            "get_completed": ((), "records"),
+            "update": ((0,), "scalar"),
+            "delete": ((), "scalar"),
+        },
+    },
+    "evaluation_instances": {
+        "record_cls": EvaluationInstance,
+        "methods": {
+            "insert": ((0,), "scalar"),
+            "get": ((), "record"),
+            "get_all": ((), "records"),
+            "get_completed": ((), "records"),
+            "update": ((0,), "scalar"),
+            "delete": ((), "scalar"),
+        },
+    },
+}
+
+_EVENT_METHODS = frozenset(
+    {"init", "remove", "insert", "insert_batch", "get", "delete", "find"}
+)
+
+
+def _encode_result(value: Any, kind: str) -> Any:
+    if kind == "record":
+        return None if value is None else MD.record_to_dict(value)
+    if kind == "records":
+        return [MD.record_to_dict(r) for r in value]
+    return value
+
+
+class StorageRequestHandler(JSONRequestHandler):
+    """Dispatch /storage/* to the wrapped Storage's DAOs."""
+
+    # -- auth ---------------------------------------------------------------
+    def _authorized(self) -> bool:
+        required = self.server_ref.auth_key
+        if not required:
+            return True
+        return self.headers.get("X-PIO-Storage-Key") == required
+
+    def _deny(self) -> None:
+        self._send(401, {"message": "Invalid storage key."})
+
+    # -- HTTP verbs ---------------------------------------------------------
+    def _guarded(self, fn, *args):
+        """Run a route handler, mapping storage/user errors to HTTP
+        bodies (a backend failure must answer, not abort the socket —
+        an aborted connection reads as a network outage client-side)."""
+        try:
+            return fn(*args)
+        except StorageError as e:
+            return self._send(400, {"message": str(e), "type": "StorageError"})
+        except (KeyError, TypeError, ValueError) as e:
+            return self._send(400, {"message": str(e), "type": type(e).__name__})
+        except Exception as e:  # noqa: BLE001 — surfaced to the client
+            log.exception("storage server error on %s", self.path)
+            return self._send(500, {"message": str(e), "type": type(e).__name__})
+
+    def do_GET(self):
+        if not self._authorized():
+            return self._deny()
+        if self.path == "/":
+            return self._send(200, {"status": "alive"})
+        if self.path.startswith("/storage/models/"):
+            return self._guarded(self._get_model,
+                                 self.path[len("/storage/models/"):])
+        return self._send(404, {"message": "not found"})
+
+    def _get_model(self, model_id: str):
+        model = self.server_ref.storage.models().get(model_id)
+        if model is None:
+            # "missing": a data miss on a live route, NOT an unknown
+            # route — the rest client maps only this 404 form to None
+            return self._send(404, {"message": "model not found",
+                                    "missing": True})
+        return self._send(200, model.models,
+                          content_type="application/octet-stream")
+
+    def do_PUT(self):
+        if not self._authorized():
+            return self._deny()
+        if self.path.startswith("/storage/models/"):
+            return self._guarded(self._put_model,
+                                 self.path[len("/storage/models/"):])
+        return self._send(404, {"message": "not found"})
+
+    def _put_model(self, model_id: str):
+        if not model_id:
+            return self._send(400, {"message": "missing model id"})
+        blob = self._read_body()
+        self.server_ref.storage.models().insert(Model(id=model_id, models=blob))
+        return self._send(200, {"id": model_id, "bytes": len(blob)})
+
+    def do_DELETE(self):
+        if not self._authorized():
+            return self._deny()
+        if self.path.startswith("/storage/models/"):
+            return self._guarded(self._delete_model,
+                                 self.path[len("/storage/models/"):])
+        return self._send(404, {"message": "not found"})
+
+    def _delete_model(self, model_id: str):
+        self.server_ref.storage.models().delete(model_id)
+        return self._send(200, {"id": model_id})
+
+    def do_POST(self):
+        if not self._authorized():
+            return self._deny()
+        parts = self.path.strip("/").split("/")
+        if len(parts) == 3 and parts[0] == "storage" and parts[1] == "events":
+            return self._guarded(self._handle_events, parts[2])
+        if len(parts) == 4 and parts[0] == "storage" and parts[1] == "meta":
+            return self._guarded(self._handle_meta, parts[2], parts[3])
+        return self._send(404, {"message": "not found"})
+
+    # -- events -------------------------------------------------------------
+    def _handle_events(self, method: str):
+        if method not in _EVENT_METHODS:
+            return self._send(404, {"message": f"unknown events method {method!r}"})
+        body = self._read_json()
+        store = self.server_ref.storage.events()
+        app_id = int(body["app_id"])
+        channel_id = body.get("channel_id")
+        if channel_id is not None:
+            channel_id = int(channel_id)
+
+        if method == "init":
+            store.init(app_id, channel_id)
+            return self._send(200, {"ok": True})
+        if method == "remove":
+            store.remove(app_id, channel_id)
+            return self._send(200, {"ok": True})
+        if method == "insert":
+            event = Event.from_dict(body["event"])
+            event_id = store.insert(event, app_id, channel_id)
+            return self._send(201, {"eventId": event_id})
+        if method == "insert_batch":
+            events = [Event.from_dict(d) for d in body["events"]]
+            ids = store.insert_batch(events, app_id, channel_id)
+            return self._send(201, {"eventIds": ids})
+        if method == "get":
+            event = store.get(body["event_id"], app_id, channel_id)
+            if event is None:
+                return self._send(404, {"message": "event not found",
+                                        "missing": True})
+            return self._send(200, {"event": event.to_dict(api_format=False)})
+        if method == "delete":
+            found = store.delete(body["event_id"], app_id, channel_id)
+            return self._send(200, {"found": bool(found)})
+
+        # find: NDJSON stream so 20M-event training reads never build one
+        # giant JSON document on either side
+        kwargs: Dict[str, Any] = {}
+        for key in ("start_time", "until_time"):
+            if body.get(key) is not None:
+                kwargs[key] = _dt.datetime.fromisoformat(body[key])
+        for key in ("entity_type", "entity_id"):
+            if body.get(key) is not None:
+                kwargs[key] = body[key]
+        if body.get("event_names") is not None:
+            kwargs["event_names"] = list(body["event_names"])
+        # target filters: tri-state (absent | null | value) via *_set flags
+        if body.get("target_entity_type_set"):
+            kwargs["target_entity_type"] = body.get("target_entity_type")
+        if body.get("target_entity_id_set"):
+            kwargs["target_entity_id"] = body.get("target_entity_id")
+        if body.get("limit") is not None:
+            kwargs["limit"] = int(body["limit"])
+        kwargs["reversed"] = bool(body.get("reversed", False))
+        events = store.find(app_id, channel_id=channel_id, **kwargs)
+        # genuinely chunked NDJSON: a 20M-event training read never
+        # joins into one multi-GB buffer on the server side
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        buf: List[bytes] = []
+        size = 0
+        for e in events:
+            line = json.dumps(
+                e.to_dict(api_format=False), sort_keys=True
+            ).encode() + b"\n"
+            buf.append(line)
+            size += len(line)
+            if size >= 256 * 1024:
+                self._write_chunk(b"".join(buf))
+                buf, size = [], 0
+        if buf:
+            self._write_chunk(b"".join(buf))
+        self.wfile.write(b"0\r\n\r\n")
+
+    def _write_chunk(self, data: bytes) -> None:
+        self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+
+    # -- metadata RPC -------------------------------------------------------
+    def _handle_meta(self, repo: str, method: str):
+        spec = _REPO_SPECS.get(repo)
+        if spec is None or method not in spec["methods"]:
+            return self._send(404, {"message": f"unknown meta RPC {repo}/{method}"})
+        record_args, result_kind = spec["methods"][method]
+        body = self._read_json()
+        args = list(body.get("args", []))
+        for pos in record_args:
+            if pos < len(args) and isinstance(args[pos], dict):
+                args[pos] = MD.dict_to_record(spec["record_cls"], args[pos])
+        target = getattr(self.server_ref.storage, repo)()
+        result = getattr(target, method)(*args)
+        return self._send(200, {"result": _encode_result(result, result_kind)})
+
+
+class StorageServer(HTTPServerBase):
+    """DAO-level storage service over a locally-configured Storage."""
+
+    def __init__(
+        self,
+        storage: Optional[Storage] = None,
+        host: str = "0.0.0.0",
+        port: int = DEFAULT_PORT,
+        auth_key: Optional[str] = None,
+        bind_retries: int = 3,
+    ):
+        self.storage = storage if storage is not None else get_storage()
+        self.auth_key = auth_key
+        super().__init__(host, port, StorageRequestHandler, bind_retries=bind_retries)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="PIO-TPU storage server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=DEFAULT_PORT)
+    parser.add_argument("--auth-key", default=None,
+                        help="require X-PIO-Storage-Key on every request")
+    args = parser.parse_args(argv)
+    server = StorageServer(host=args.host, port=args.port, auth_key=args.auth_key)
+    print(f"Storage server listening on {args.host}:{server.port}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

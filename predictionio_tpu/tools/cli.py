@@ -11,7 +11,7 @@ Console.scala:128-735 command surface; bin/pio:17-42 wrapper):
                          in-process — no spark-submit JVM hop)
   eval                  (ref: evaluation branch, CreateWorkflow.scala:263)
   deploy / undeploy     (ref: Console.scala:830 -> CreateServer)
-  eventserver / adminserver / dashboard
+  eventserver / adminserver / dashboard / storageserver
   import / export       (ref: imprt/FileToEvents, export/EventsToFile)
   template list|get     (egress-free: scaffolds the built-in templates
                          instead of downloading from the gallery,
@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import importlib
+import importlib.util
 import json
 import logging
 import sys
@@ -280,6 +281,17 @@ def cmd_dashboard(args) -> int:
     return 0
 
 
+def cmd_storageserver(args) -> int:
+    """Serve this host's configured storage to `rest`-backend peers
+    (the scale-out tier: HBase/ES/HDFS roles behind one HTTP service)."""
+    from predictionio_tpu.serving.storage_server import StorageServer
+
+    server = StorageServer(host=args.ip, port=args.port, auth_key=args.auth_key)
+    _p(f"Storage server running on {args.ip}:{server.port}")
+    server.serve_forever()
+    return 0
+
+
 # -- data / misc ---------------------------------------------------------------
 
 def cmd_import(args) -> int:
@@ -331,7 +343,14 @@ def cmd_run(args) -> int:
     if module_name:
         try:
             obj = getattr(importlib.import_module(module_name), attr, None)
-        except ImportError:
+        except ModuleNotFoundError as e:
+            # only swallow "the dotted prefix itself isn't a module"
+            # (we then retry the full name via runpy); an import failing
+            # *inside* a real module is the user's error — surface it
+            if e.name is None or not (
+                module_name == e.name or module_name.startswith(e.name + ".")
+            ):
+                raise
             obj = None
     def exit_code(value, from_exit: bool) -> int:
         if isinstance(value, bool):      # True = success, not exit code 1
@@ -351,16 +370,29 @@ def cmd_run(args) -> int:
             return exit_code(e.code, from_exit=True)
     import runpy
 
+    # resolve existence up front so "target isn't a module" yields the
+    # friendly error while ImportErrors raised *inside* a real module
+    # (missing dependency, bad code) surface with their own traceback
+    try:
+        spec = importlib.util.find_spec(target)
+    except ModuleNotFoundError as e:
+        # the target (or its dotted prefix) is not a module at all
+        if e.name and (target == e.name or target.startswith(e.name + ".")):
+            spec = None
+        else:  # a real module failed on a missing dependency — surface it
+            raise
+    except ValueError:  # e.g. an already-imported module with no __spec__
+        spec = None
+    if spec is None:
+        raise CommandError(
+            f"cannot resolve {target!r} as a callable or module"
+        )
     old_argv = sys.argv
     sys.argv = [target] + passthrough
     try:
         runpy.run_module(target, run_name="__main__")
     except SystemExit as e:   # module mains exit; keep their code
         return exit_code(e.code, from_exit=True)
-    except ImportError as e:
-        raise CommandError(
-            f"cannot resolve {target!r} as a callable or module: {e}"
-        ) from e
     finally:
         sys.argv = old_argv
     return 0
@@ -484,6 +516,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ip", default="0.0.0.0")
     p.add_argument("--port", type=int, default=9000)
     p.set_defaults(func=cmd_dashboard)
+
+    p = sub.add_parser(
+        "storageserver",
+        help="serve this host's storage to rest-backend peers",
+    )
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7077)
+    p.add_argument("--auth-key", default=None,
+                   help="require X-PIO-Storage-Key on every request")
+    p.set_defaults(func=cmd_storageserver)
 
     p = sub.add_parser("import", help="import events from a JSONL/parquet file")
     p.add_argument("--appname", required=True)
