@@ -1,80 +1,210 @@
-"""Headline benchmark: ALS training throughput on MovieLens-20M-scale data.
+"""Headline benchmark: the full events->model pipeline at MovieLens-20M
+scale, ending in ALS training throughput on-chip.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": {...}}
 
-Metric: rating-updates/sec/chip during ALS training — n_ratings *
-iterations / wall-time of the timed iterations. Warm-up (excluded from
-the timed region) covers host binning, device placement, XLA compile,
-and one full throwaway training run that forces the compilation; the
-timed region is pure device training synced by a scalar readback, with
-model materialization (host transfer) after the clock stops. This is the
-rebuild's side of BASELINE.md's north star ("ALS on MovieLens-20M at
->=5x Spark-CPU events/sec/chip"): the reference publishes no numbers
-(BASELINE.json "published": {}), so vs_baseline is computed against a
-1e6 ratings/sec Spark-MLlib-CPU-node proxy — the >=5x target is
-therefore vs_baseline >= 5.
+Unlike a kernel microbench, this drives the framework's own data path —
+the `pio train` call stack (SURVEY.md §3.1):
+
+  synth   - structured ratings (latent-factor signal + noise, so the
+            RMSE gate below measures real generalization, not luck)
+  ingest  - 20M events into the native eventlog via the storage write
+            API (columnar bulk path = PEvents.write role; the row path
+            insert_batch is sampled separately)
+  read    - RecoDataSource.read_training: native columnar scan with
+            dict-encoded string ids (HBPEvents.scala:48 role)
+  prepare - RecoPreparator: BiMap id indexing over the vocabularies
+  bin     - ragged->segmented static blocks + device placement + XLA
+            compile + one throwaway run (ALSTrainer.compile)
+  train   - the timed region: pure device ALS alternations, synced by a
+            scalar readback
+  rmse    - model-quality gate on a 5% held-out split: the model must
+            beat the global-mean predictor's RMSE by >=15%, so a
+            numerically-degraded fast path cannot "win" the benchmark
+
+Headline metric: rating-updates/sec/chip = n_train_ratings * iterations
+/ train_sec. ``vs_baseline`` divides by an ASSUMED PROXY of 1e6
+ratings*iters/sec for a Spark-MLlib-ALS CPU node — the reference
+publishes no benchmark numbers at all (BASELINE.json "published": {});
+the proxy is our own stated assumption, recorded in the detail block,
+and the >=5x north-star (BASELINE.md) reads as vs_baseline >= 5.
+If the RMSE gate fails, value is reported as 0.0.
 
 Scale knobs via env: PIO_BENCH_USERS/ITEMS/RATINGS/RANK/ITERS.
 """
 
 import json
 import os
+import shutil
+import tempfile
 import time
 
 import numpy as np
 
 
+def synthesize(n_users, n_items, n_ratings, rng):
+    """Ratings with planted rank-8 structure: clip(3 + 1.2z + noise)."""
+    uu = rng.integers(0, n_users, size=n_ratings, dtype=np.int64)
+    item_pop = rng.zipf(1.2, size=n_ratings) % n_items  # Zipf popularity
+    ii = item_pop.astype(np.int64)
+    U = rng.normal(size=(n_users, 8)).astype(np.float32)
+    V = rng.normal(size=(n_items, 8)).astype(np.float32)
+    z = np.einsum("nk,nk->n", U[uu], V[ii]) / np.sqrt(8.0)
+    raw = 3.0 + 1.2 * z + rng.normal(0, 0.35, size=n_ratings).astype(np.float32)
+    vals = np.clip(np.round(raw * 2.0) / 2.0, 0.5, 5.0).astype(np.float64)
+    return uu, ii, vals
+
+
 def main() -> None:
-    n_users = int(os.environ.get("PIO_BENCH_USERS", 138_000))
-    n_items = int(os.environ.get("PIO_BENCH_ITEMS", 27_000))
+    n_users = int(os.environ.get("PIO_BENCH_USERS", 138_493))   # ML-20M
+    n_items = int(os.environ.get("PIO_BENCH_ITEMS", 26_744))    # cardinalities
     n_ratings = int(os.environ.get("PIO_BENCH_RATINGS", 20_000_000))
     rank = int(os.environ.get("PIO_BENCH_RANK", 64))
     iterations = int(os.environ.get("PIO_BENCH_ITERS", 5))
 
-    from predictionio_tpu.ops.als import ALSConfig, ALSTrainer
+    from predictionio_tpu.data.storage import EventColumns, Storage, set_storage
+    from predictionio_tpu.ops.als import ALSConfig, ALSTrainer, predict_rmse
+    from predictionio_tpu.parallel.mesh import MeshContext
+    from predictionio_tpu.templates.recommendation import (
+        RecoDataSource,
+        RecoDataSourceParams,
+        RecoPreparator,
+    )
 
+    detail = {"n_users": n_users, "n_items": n_items, "n_ratings": n_ratings,
+              "rank": rank, "iterations": iterations}
     rng = np.random.default_rng(0)
-    # Zipf-ish popularity for items, uniform users — MovieLens-shaped
-    uu = rng.integers(0, n_users, size=n_ratings, dtype=np.int64)
-    item_pop = rng.zipf(1.2, size=n_ratings) % n_items
-    ii = item_pop.astype(np.int64)
-    vals = rng.integers(1, 11, size=n_ratings).astype(np.float32) / 2.0
+    base_dir = tempfile.mkdtemp(prefix="pio_bench_")
+    try:
+        # -- synth ----------------------------------------------------------
+        t0 = time.perf_counter()
+        uu, ii, vals = synthesize(n_users, n_items, n_ratings, rng)
+        cols = EventColumns(
+            entity_codes=uu.astype(np.int32),
+            target_codes=ii.astype(np.int32),
+            name_codes=np.zeros(n_ratings, np.int32),
+            values=vals,
+            times_us=np.arange(n_ratings, dtype=np.int64) * 1_000_000,
+            entity_vocab=[f"u{i}" for i in range(n_users)],
+            target_vocab=[f"i{i}" for i in range(n_items)],
+            names=["rate"],
+        )
+        detail["synth_sec"] = round(time.perf_counter() - t0, 2)
 
-    cfg = ALSConfig(rank=rank, iterations=iterations, reg=0.1, block_size=4096)
+        # -- ingest (storage write path, native eventlog) -------------------
+        storage = Storage.from_env({
+            "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+            "PIO_STORAGE_SOURCES_EL_PATH": base_dir,
+            **{f"PIO_STORAGE_REPOSITORIES_{r}_{k}": v
+               for r in ("METADATA", "EVENTDATA", "MODELDATA")
+               for k, v in (("NAME", r.lower()), ("SOURCE", "EL"))},
+        })
+        set_storage(storage)
+        app = storage.apps().insert("bench")
+        storage.events().init(app.id)
 
-    # one-time costs: host binning + device placement + XLA compile
-    t0 = time.perf_counter()
-    trainer = ALSTrainer((uu, ii, vals), n_users, n_items, cfg)
-    trainer.compile()
-    warm = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        storage.events().insert_columnar(
+            cols, app.id, entity_type="user", target_entity_type="item",
+            value_property="rating",
+        )
+        ingest_sec = time.perf_counter() - t0
+        detail["ingest_sec"] = round(ingest_sec, 2)
+        detail["ingest_events_per_sec"] = round(n_ratings / ingest_sec, 1)
 
-    t0 = time.perf_counter()
-    trainer.step_n(iterations)     # scalar-pull sync: all device work done
-    elapsed = time.perf_counter() - t0
-    trainer.factors()              # model materialization, outside the
-                                   # timed region (host transfer, one-time)
+        # row-path write rate, sampled (the per-request API the event
+        # server uses; full 20M through Python Event objects would add
+        # ~10 min of pure object churn to every bench run)
+        sample = min(100_000, n_ratings)
+        from predictionio_tpu.data.event import Event
+        import datetime as dt
 
-    # the segmented layout processes every rating on both half-steps
-    # (no per-group caps); kept_* stay in the detail block as the
-    # honest-accounting invariant (must equal n_ratings)
-    effective = (trainer.kept_user_entries + trainer.kept_item_entries) / 2
-    value = effective * iterations / elapsed
-    baseline_proxy = 1e6  # Spark MLlib ALS CPU-node ratings/sec (see module doc)
-    print(json.dumps({
-        "metric": "als_ml20m_rating_updates_per_sec_per_chip",
-        "value": round(value, 1),
-        "unit": "ratings*iters/sec",
-        "vs_baseline": round(value / baseline_proxy, 2),
-        "detail": {
-            "n_users": n_users, "n_items": n_items, "n_ratings": n_ratings,
-            "effective_ratings": int(effective),
-            "kept_user_frac": round(trainer.kept_user_entries / n_ratings, 3),
-            "kept_item_frac": round(trainer.kept_item_entries / n_ratings, 3),
-            "rank": rank, "iterations": iterations,
-            "elapsed_sec": round(elapsed, 2), "warmup_sec": round(warm, 2),
-        },
-    }))
+        t0 = time.perf_counter()
+        epoch = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+        events = [
+            Event(event="rate", entity_type="user", entity_id=f"u{uu[k]}",
+                  target_entity_type="item", target_entity_id=f"i{ii[k]}",
+                  properties={"rating": float(vals[k])},
+                  event_time=epoch + dt.timedelta(seconds=int(k)))
+            for k in range(sample)
+        ]
+        storage.events().insert_batch(events, app.id)
+        detail["insert_batch_events_per_sec"] = round(
+            sample / (time.perf_counter() - t0), 1
+        )
+        extra_rows = sample  # the sampled rows are real events in the log
+
+        # -- read (the DataSource the recommendation template ships) --------
+        ctx = MeshContext()
+        ds = RecoDataSource(RecoDataSourceParams(app_name="bench"))
+        t0 = time.perf_counter()
+        td = ds.read_training(ctx)
+        read_sec = time.perf_counter() - t0
+        detail["read_sec"] = round(read_sec, 2)
+        n_read = len(td.columns.ratings)
+        assert n_read == n_ratings + extra_rows, (n_read, n_ratings, extra_rows)
+
+        # -- prepare (BiMap string-id indexing) ------------------------------
+        t0 = time.perf_counter()
+        pd = RecoPreparator(None).prepare(ctx, td)
+        detail["prepare_sec"] = round(time.perf_counter() - t0, 2)
+
+        # -- held-out split for the quality gate -----------------------------
+        hold = np.arange(n_read) % 20 == 0   # 5%
+        tr_u, tr_i, tr_r = pd.user_idx[~hold], pd.item_idx[~hold], pd.ratings[~hold]
+        ho = (pd.user_idx[hold], pd.item_idx[hold], pd.ratings[hold])
+        n_train = len(tr_r)
+
+        # -- bin + place + compile (one-time costs) --------------------------
+        cfg = ALSConfig(rank=rank, iterations=iterations, reg=0.05,
+                        block_size=4096)
+        t0 = time.perf_counter()
+        trainer = ALSTrainer((tr_u, tr_i, tr_r), len(pd.user_ids),
+                             len(pd.item_ids), cfg)
+        trainer.compile()
+        detail["bin_compile_sec"] = round(time.perf_counter() - t0, 2)
+
+        # -- train (timed region: pure device work) --------------------------
+        t0 = time.perf_counter()
+        trainer.step_n(iterations)
+        train_sec = time.perf_counter() - t0
+        factors = trainer.factors()
+        detail["train_sec"] = round(train_sec, 2)
+
+        # -- quality gate -----------------------------------------------------
+        rmse = predict_rmse(factors, ho)
+        base_rmse = float(np.sqrt(np.mean((ho[2] - tr_r.mean()) ** 2)))
+        gate = rmse <= 0.85 * base_rmse
+        detail["rmse_heldout"] = round(rmse, 4)
+        detail["rmse_global_mean_baseline"] = round(base_rmse, 4)
+        detail["rmse_gate_passed"] = bool(gate)
+
+        # -- headline + honest accounting ------------------------------------
+        effective = (trainer.kept_user_entries + trainer.kept_item_entries) / 2
+        assert int(effective) == n_train, (effective, n_train)
+        value = effective * iterations / train_sec if gate else 0.0
+        e2e_sec = read_sec + detail["prepare_sec"] + detail["bin_compile_sec"] + train_sec
+        detail["events_to_model_sec"] = round(e2e_sec, 2)
+        detail["events_to_model_events_per_sec"] = round(n_read / e2e_sec, 1)
+        detail["baseline_proxy"] = {
+            "value": 1e6,
+            "unit": "ratings*iters/sec",
+            "basis": ("ASSUMED Spark-MLlib-ALS CPU-node throughput; the "
+                      "reference publishes no numbers (BASELINE.json "
+                      "published={}) — this proxy is our own stated "
+                      "assumption, not a citation"),
+        }
+        print(json.dumps({
+            "metric": "als_ml20m_rating_updates_per_sec_per_chip",
+            "value": round(value, 1),
+            "unit": "ratings*iters/sec",
+            "vs_baseline": round(value / 1e6, 2),
+            "detail": detail,
+        }))
+    finally:
+        set_storage(None)
+        shutil.rmtree(base_dir, ignore_errors=True)
 
 
 if __name__ == "__main__":

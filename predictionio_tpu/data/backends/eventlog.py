@@ -78,7 +78,32 @@ def _load():
         ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
         ctypes.POINTER(ctypes.c_uint64),
     ]
-    lib.el_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.el_find_columnar.restype = ctypes.c_int64
+    lib.el_find_columnar.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(_FindReq), ctypes.c_char_p,
+        ctypes.c_int32,                                   # time_ordered
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),   # ent codes
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),   # tgt codes
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),   # name codes
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),  # values
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),   # times_us
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.el_append_columnar.restype = ctypes.c_int64
+    lib.el_append_columnar.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64,
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64,
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_double), ctypes.c_char_p,
+    ]
+    lib.el_free.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -279,6 +304,35 @@ class EventLogEventStore(S.EventStore):
         h = self._handle(app_id, channel_id)
         return self._lib.el_delete(h, _id16(event_id)) == 1
 
+    @staticmethod
+    def _build_req(start_time, until_time, entity_type, entity_id,
+                   event_names, target_entity_type, target_entity_id,
+                   limit, reversed) -> _FindReq:
+        def target_mode(v) -> Tuple[int, Optional[bytes]]:
+            if v is S.UNSET:
+                return 0, None
+            if v is None:
+                return 1, None
+            return 2, str(v).encode("utf-8")
+
+        tt_mode, tt_val = target_mode(target_entity_type)
+        ti_mode, ti_val = target_mode(target_entity_id)
+        names = list(event_names) if event_names is not None else []
+        return _FindReq(
+            start_us=_us(start_time) if start_time is not None else _I64_MIN,
+            until_us=_us(until_time) if until_time is not None else _I64_MAX,
+            entity_type=entity_type.encode() if entity_type is not None else None,
+            entity_id=entity_id.encode() if entity_id is not None else None,
+            target_type_mode=tt_mode,
+            target_id_mode=ti_mode,
+            target_entity_type=tt_val,
+            target_entity_id=ti_val,
+            event_names=b"\0".join(n.encode() for n in names) + b"\0" if names else None,
+            n_event_names=len(names),
+            reversed=1 if reversed else 0,
+            limit=limit if limit is not None and limit >= 0 else -1,
+        )
+
     def find(
         self,
         app_id,
@@ -294,32 +348,9 @@ class EventLogEventStore(S.EventStore):
         reversed=False,
     ) -> List[Event]:
         h = self._handle(app_id, channel_id)
-
-        def target_mode(v) -> Tuple[int, Optional[bytes]]:
-            if v is S.UNSET:
-                return 0, None
-            if v is None:
-                return 1, None
-            return 2, str(v).encode("utf-8")
-
-        tt_mode, tt_val = target_mode(target_entity_type)
-        ti_mode, ti_val = target_mode(target_entity_id)
-        names = list(event_names) if event_names is not None else []
-
-        req = _FindReq(
-            start_us=_us(start_time) if start_time is not None else _I64_MIN,
-            until_us=_us(until_time) if until_time is not None else _I64_MAX,
-            entity_type=entity_type.encode() if entity_type is not None else None,
-            entity_id=entity_id.encode() if entity_id is not None else None,
-            target_type_mode=tt_mode,
-            target_id_mode=ti_mode,
-            target_entity_type=tt_val,
-            target_entity_id=ti_val,
-            event_names=b"\0".join(n.encode() for n in names) + b"\0" if names else None,
-            n_event_names=len(names),
-            reversed=1 if reversed else 0,
-            limit=limit if limit is not None and limit >= 0 else -1,
-        )
+        req = self._build_req(start_time, until_time, entity_type, entity_id,
+                              event_names, target_entity_type,
+                              target_entity_id, limit, reversed)
         out = ctypes.POINTER(ctypes.c_uint8)()
         out_bytes = ctypes.c_uint64()
         n = self._lib.el_find(h, ctypes.byref(req), ctypes.byref(out), ctypes.byref(out_bytes))
@@ -332,6 +363,159 @@ class EventLogEventStore(S.EventStore):
         finally:
             self._lib.el_free(out)
         return _unpack_records(buf)
+
+    def find_columnar(
+        self,
+        app_id,
+        channel_id=None,
+        value_property=None,
+        time_ordered=True,
+        **find_kwargs,
+    ) -> S.EventColumns:
+        """One native pass: filter + dict-encode + property extraction
+        (overrides the Event-object fallback in storage.EventStore).
+        ``time_ordered=False`` (bulk training reads) fuses filter and
+        encode into a single parse per record and skips the sort."""
+        import numpy as np
+
+        unknown = set(find_kwargs) - {
+            "start_time", "until_time", "entity_type", "entity_id",
+            "event_names", "target_entity_type", "target_entity_id",
+            "limit", "reversed",
+        }
+        if unknown:
+            # a typo'd filter must fail loudly, never scan unfiltered
+            raise TypeError(
+                f"find_columnar() got unexpected filters {sorted(unknown)}"
+            )
+        h = self._handle(app_id, channel_id)
+        req = self._build_req(
+            find_kwargs.get("start_time"), find_kwargs.get("until_time"),
+            find_kwargs.get("entity_type"), find_kwargs.get("entity_id"),
+            find_kwargs.get("event_names"),
+            find_kwargs.get("target_entity_type", S.UNSET),
+            find_kwargs.get("target_entity_id", S.UNSET),
+            find_kwargs.get("limit"), find_kwargs.get("reversed", False),
+        )
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        ent = ctypes.POINTER(ctypes.c_int32)()
+        tgt = ctypes.POINTER(ctypes.c_int32)()
+        nam = ctypes.POINTER(ctypes.c_int32)()
+        val = ctypes.POINTER(ctypes.c_double)()
+        tim = ctypes.POINTER(ctypes.c_int64)()
+        ent_d, tgt_d, nam_d = u8p(), u8p(), u8p()
+        ent_db, tgt_db, nam_db = ctypes.c_uint64(), ctypes.c_uint64(), ctypes.c_uint64()
+        n_ent, n_tgt, n_nam = ctypes.c_int64(), ctypes.c_int64(), ctypes.c_int64()
+        n = self._lib.el_find_columnar(
+            h, ctypes.byref(req),
+            value_property.encode() if value_property is not None else None,
+            1 if time_ordered else 0,
+            ctypes.byref(ent), ctypes.byref(tgt), ctypes.byref(nam),
+            ctypes.byref(val), ctypes.byref(tim),
+            ctypes.byref(ent_d), ctypes.byref(ent_db), ctypes.byref(n_ent),
+            ctypes.byref(tgt_d), ctypes.byref(tgt_db), ctypes.byref(n_tgt),
+            ctypes.byref(nam_d), ctypes.byref(nam_db), ctypes.byref(n_nam),
+        )
+        if n < 0:
+            raise S.StorageError("columnar find failed in native event log")
+
+        def take(ptr, ctype, count, np_dtype):
+            arr = np.ctypeslib.as_array(
+                ctypes.cast(ptr, ctypes.POINTER(ctype)), shape=(count,)
+            ).copy() if count else np.empty(0, np_dtype)
+            return arr.astype(np_dtype, copy=False)
+
+        def vocab(ptr, nbytes, count):
+            if not count:
+                return []
+            raw = ctypes.string_at(ptr, nbytes)
+            return raw.decode("utf-8").split("\0")[:count]
+
+        try:
+            cols = S.EventColumns(
+                entity_codes=take(ent, ctypes.c_int32, n, np.int32),
+                target_codes=take(tgt, ctypes.c_int32, n, np.int32),
+                name_codes=take(nam, ctypes.c_int32, n, np.int32),
+                values=take(val, ctypes.c_double, n, np.float64),
+                times_us=take(tim, ctypes.c_int64, n, np.int64),
+                entity_vocab=vocab(ent_d, ent_db.value, n_ent.value),
+                target_vocab=vocab(tgt_d, tgt_db.value, n_tgt.value),
+                names=vocab(nam_d, nam_db.value, n_nam.value),
+            )
+        finally:
+            for p in (ent, tgt, nam, val, tim, ent_d, tgt_d, nam_d):
+                self._lib.el_free(p)
+        return cols
+
+    def insert_columnar(
+        self,
+        cols: S.EventColumns,
+        app_id,
+        channel_id=None,
+        *,
+        entity_type: str,
+        target_entity_type: Optional[str] = None,
+        value_property: Optional[str] = None,
+    ) -> int:
+        """Native bulk ingest: rows are packed into wire records in C++
+        straight from the dict-encoded columns (overrides the
+        Event-object fallback; ref: PEvents.write:124)."""
+        import numpy as np
+
+        h = self._handle(app_id, channel_id)
+
+        # dictionaries packed WITHOUT separators; prefix offsets are exact
+        def dict_concat(vocab):
+            bs = [s.encode("utf-8") for s in vocab]
+            offsets = np.zeros(len(bs) + 1, np.uint64)
+            if bs:
+                np.cumsum(
+                    np.fromiter((len(b) for b in bs), np.uint64, count=len(bs)),
+                    out=offsets[1:],
+                )
+            return b"".join(bs), offsets
+
+        ent_b, ent_off = dict_concat(cols.entity_vocab)
+        tgt_b, tgt_off = dict_concat(cols.target_vocab)
+        nam_b, nam_off = dict_concat(cols.names)
+
+        ent_codes = np.ascontiguousarray(cols.entity_codes, np.int32)
+        tgt_codes = np.ascontiguousarray(cols.target_codes, np.int32)
+        nam_codes = np.ascontiguousarray(cols.name_codes, np.int32)
+        times = np.ascontiguousarray(cols.times_us, np.int64)
+        values = np.ascontiguousarray(cols.values, np.float64)
+
+        def ptr(arr, ctype):
+            return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+        n = len(cols)
+        chunk = 4_000_000
+        total = 0
+        for s in range(0, n, chunk):
+            m = min(chunk, n - s)
+            wrote = self._lib.el_append_columnar(
+                h, m,
+                entity_type.encode("utf-8"),
+                target_entity_type.encode("utf-8")
+                if target_entity_type is not None else None,
+                value_property.encode("utf-8")
+                if value_property is not None else None,
+                ent_b, ptr(ent_off, ctypes.c_uint64), len(cols.entity_vocab),
+                tgt_b, ptr(tgt_off, ctypes.c_uint64), len(cols.target_vocab),
+                nam_b, ptr(nam_off, ctypes.c_uint64), len(cols.names),
+                ptr(ent_codes[s:s + m], ctypes.c_int32),
+                ptr(tgt_codes[s:s + m], ctypes.c_int32),
+                ptr(nam_codes[s:s + m], ctypes.c_int32),
+                ptr(times[s:s + m], ctypes.c_int64),
+                ptr(values[s:s + m], ctypes.c_double),
+                None,
+            )
+            if wrote != m:
+                raise S.StorageError(
+                    f"columnar append failed ({wrote} of {m} written)"
+                )
+            total += m
+        return total
 
     def close(self) -> None:
         with self._lock:

@@ -95,6 +95,15 @@ class BiMap(Generic[K, V]):
 
     string_long = string_int
 
+    @staticmethod
+    def from_vocab(vocab: Sequence[str]) -> "BiMap[str, int]":
+        """Already-distinct keys -> their positions (the dict-encoded
+        bulk path: storage.EventColumns vocabularies index directly)."""
+        forward = {k: i for i, k in enumerate(vocab)}
+        if len(forward) != len(vocab):
+            raise ValueError("from_vocab requires distinct keys")
+        return BiMap(forward, {i: k for k, i in forward.items()})
+
 
 class EntityIdIxMap:
     """Entity-id <-> dense-index map (ref: storage/EntityMap.scala:27

@@ -77,6 +77,28 @@ def find(
     )
 
 
+def find_columnar(
+    app_name: str,
+    channel_name: Optional[str] = None,
+    value_property: Optional[str] = None,
+    time_ordered: bool = True,
+    storage: Optional[Storage] = None,
+    **find_kwargs,
+):
+    """Bulk training read as dict-encoded columns (storage.EventColumns)
+    — the fast path behind DataSources at ML-20M scale (the role of the
+    reference's region-parallel HBase scans, hbase/HBPEvents.scala:48)."""
+    storage = storage or get_storage()
+    app_id, channel_id = resolve_app(app_name, channel_name, storage)
+    return storage.events().find_columnar(
+        app_id,
+        channel_id=channel_id,
+        value_property=value_property,
+        time_ordered=time_ordered,
+        **find_kwargs,
+    )
+
+
 def aggregate_properties(
     app_name: str,
     entity_type: str,

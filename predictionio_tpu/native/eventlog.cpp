@@ -45,10 +45,13 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <limits>
 #include <memory>
 #include <mutex>
+#include <random>
 #include <shared_mutex>
 #include <string>
+#include <string_view>
 #include <unordered_map>
 #include <vector>
 
@@ -137,8 +140,21 @@ struct Log {
   std::vector<RecMeta> recs;
   std::unordered_map<std::string, uint64_t> by_id;  // raw 16-byte id -> rec index
   std::unordered_map<std::string, uint64_t> tombs;  // id -> max cutoff offset
+  bool has_dupes = false;  // an id was ever re-inserted; scans must
+                           // consult by_id for liveness when set
+  // records appended via el_append_columnar carry fresh random ids, so
+  // they are indexed lazily: by_id covers recs[0, indexed_upto) and is
+  // completed on demand by el_get/el_delete (ensure_id_index). A bulk
+  // 20M-row ingest therefore skips ~20M hash-map node inserts.
+  uint64_t indexed_upto = 0;
   bool fsync_on_append = false;
   mutable std::shared_mutex mu;
+
+  // every record is live: no tombstones and no superseded ids, so
+  // scans skip the per-record by_id lookup (the dominant cost of a
+  // 20M-row scan — one random DRAM access per record otherwise).
+  // Unindexed records are fresh-id columnar appends — never dupes.
+  bool all_live() const { return tombs.empty() && !has_dupes; }
 
   ~Log() {
     if (map) munmap(map, map_size);
@@ -170,7 +186,8 @@ struct Log {
     return it != tombs.end() && it->second > offset;
   }
 
-  void index_record(uint64_t offset, uint32_t len, const Header& h) {
+  void index_record(uint64_t offset, uint32_t len, const Header& h,
+                    bool fresh_ids = false) {
     RecMeta m;
     m.offset = offset;
     m.len = len;
@@ -183,9 +200,45 @@ struct Log {
     m.has_target_id = h.tid != nullptr;
     m.ttype_hash = h.ttype ? fnv1a(h.ttype, h.len_ttype) : 0;
     m.tid_hash = h.tid ? fnv1a(h.tid, h.len_tid) : 0;
+    if (fresh_ids) {
+      // fresh random ids can't collide: defer by_id (ensure_id_index).
+      // Invariant: by_id covers exactly [0, indexed_upto) — non-fresh
+      // appends pay any debt first (append_packed), so the debt region
+      // is always a fresh-ids suffix and eager inserts below always
+      // run with indexed_upto == recs.size().
+      recs.push_back(m);
+      return;
+    }
+    ++indexed_upto;
     std::string id(reinterpret_cast<const char*>(h.id), 16);
-    if (!dead(id, offset)) by_id[id] = recs.size();
+    if (!dead(id, offset)) {
+      auto [it, inserted] = by_id.try_emplace(std::move(id), recs.size());
+      if (!inserted) {
+        it->second = recs.size();
+        has_dupes = true;
+      }
+    }
     recs.push_back(m);
+  }
+
+  // complete by_id over [indexed_upto, recs.size()) — called (with the
+  // exclusive lock) before any id-keyed operation
+  void ensure_id_index() {
+    if (indexed_upto == recs.size()) return;
+    by_id.reserve(by_id.size() + (recs.size() - indexed_upto));
+    for (uint64_t i = indexed_upto; i < recs.size(); ++i) {
+      Header h;
+      parse(map + recs[i].offset + 4, recs[i].len, &h);
+      std::string id(reinterpret_cast<const char*>(h.id), 16);
+      if (!dead(id, recs[i].offset)) {
+        auto [it, inserted] = by_id.try_emplace(std::move(id), i);
+        if (!inserted) {
+          it->second = i;
+          has_dupes = true;
+        }
+      }
+    }
+    indexed_upto = recs.size();
   }
 };
 
@@ -207,6 +260,268 @@ struct FindReq {
 bool bytes_eq(const uint8_t* a, uint32_t alen, const char* b) {
   return alen == strlen(b) && memcmp(a, b, alen) == 0;
 }
+
+// precomputed filter hashes for one FindReq
+struct FilterCtx {
+  uint64_t etype_h = 0, eid_h = 0, ttype_h = 0, tid_h = 0;
+  std::vector<std::pair<uint64_t, const char*>> name_hashes;
+};
+
+FilterCtx make_filter_ctx(const FindReq* req) {
+  FilterCtx c;
+  if (req->entity_type)
+    c.etype_h = fnv1a(reinterpret_cast<const uint8_t*>(req->entity_type),
+                      strlen(req->entity_type));
+  if (req->entity_id)
+    c.eid_h = fnv1a(reinterpret_cast<const uint8_t*>(req->entity_id),
+                    strlen(req->entity_id));
+  if (req->target_type_mode == 2)
+    c.ttype_h = fnv1a(reinterpret_cast<const uint8_t*>(req->target_entity_type),
+                      strlen(req->target_entity_type));
+  if (req->target_id_mode == 2)
+    c.tid_h = fnv1a(reinterpret_cast<const uint8_t*>(req->target_entity_id),
+                    strlen(req->target_entity_id));
+  const char* p = req->event_names;
+  for (int32_t i = 0; i < req->n_event_names; ++i) {
+    size_t l = strlen(p);
+    c.name_hashes.emplace_back(fnv1a(reinterpret_cast<const uint8_t*>(p), l), p);
+    p += l + 1;
+  }
+  return c;
+}
+
+// One record's filter check: index-hash prefilter, then header parse,
+// liveness (current by_id entry) and byte-wise string confirmation
+// (hash-collision guard). Fills *hd on a true return so callers parse
+// only once. Caller must hold a shared lock.
+bool match_rec(const Log* log, const FindReq* req, const FilterCtx& c,
+               uint64_t i, Header* hd) {
+  const RecMeta& m = log->recs[i];
+  if (m.time_us < req->start_us || m.time_us >= req->until_us) return false;
+  if (req->entity_type && m.etype_hash != c.etype_h) return false;
+  if (req->entity_id && m.eid_hash != c.eid_h) return false;
+  if (req->target_type_mode == 1 && m.has_target_type) return false;
+  if (req->target_type_mode == 2 && (!m.has_target_type || m.ttype_hash != c.ttype_h)) return false;
+  if (req->target_id_mode == 1 && m.has_target_id) return false;
+  if (req->target_id_mode == 2 && (!m.has_target_id || m.tid_hash != c.tid_h)) return false;
+  if (req->n_event_names > 0) {
+    bool any = false;
+    for (const auto& nh : c.name_hashes) {
+      if (nh.first == m.name_hash) { any = true; break; }
+    }
+    if (!any) return false;
+  }
+  parse(log->map + m.offset + 4, m.len, hd);
+  if (!log->all_live()) {
+    auto live = log->by_id.find(std::string(reinterpret_cast<const char*>(hd->id), 16));
+    if (live == log->by_id.end() || live->second != i) return false;
+  }
+  if (req->entity_type && !bytes_eq(hd->etype, hd->len_etype, req->entity_type)) return false;
+  if (req->entity_id && !bytes_eq(hd->eid, hd->len_eid, req->entity_id)) return false;
+  if (req->target_type_mode == 2 &&
+      !bytes_eq(hd->ttype, hd->len_ttype, req->target_entity_type)) return false;
+  if (req->target_id_mode == 2 &&
+      !bytes_eq(hd->tid, hd->len_tid, req->target_entity_id)) return false;
+  if (req->n_event_names > 0) {
+    bool any = false;
+    for (const auto& nh : c.name_hashes) {
+      if (bytes_eq(hd->event, hd->len_event, nh.second)) { any = true; break; }
+    }
+    if (!any) return false;
+  }
+  return true;
+}
+
+// Filtered index scan shared by el_find / sorted columnar finds: fills
+// `hits` with live matching record indices, sorted by (time, ctime,
+// arrival). Caller must hold a shared lock.
+void collect_hits(const Log* log, const FindReq* req, std::vector<uint64_t>* hits) {
+  FilterCtx ctx = make_filter_ctx(req);
+  Header hd;
+  for (uint64_t i = 0; i < log->recs.size(); ++i) {
+    if (match_rec(log, req, ctx, i, &hd)) hits->push_back(i);
+  }
+
+  auto key_less = [log](uint64_t a, uint64_t b) {
+    const RecMeta& ma = log->recs[a];
+    const RecMeta& mb = log->recs[b];
+    if (ma.time_us != mb.time_us) return ma.time_us < mb.time_us;
+    if (ma.ctime_us != mb.ctime_us) return ma.ctime_us < mb.ctime_us;
+    return a < b;
+  };
+  if (req->reversed)
+    std::sort(hits->begin(), hits->end(), [&](uint64_t a, uint64_t b) { return key_less(b, a); });
+  else
+    std::sort(hits->begin(), hits->end(), key_less);
+  if (req->limit >= 0 && hits->size() > static_cast<uint64_t>(req->limit))
+    hits->resize(req->limit);
+}
+
+// ---------------------------------------------------------------------------
+// minimal JSON walking over the record's `extra` blob (written by our own
+// packer: compact json.dumps output) to pull one numeric property out of
+// the "p" object without materializing Python events
+// ---------------------------------------------------------------------------
+
+// advance past one JSON value starting at s (s < e); returns nullptr on
+// malformed input
+const char* skip_json_value(const char* s, const char* e);
+
+const char* skip_ws(const char* s, const char* e) {
+  while (s < e && (*s == ' ' || *s == '\t' || *s == '\n' || *s == '\r')) ++s;
+  return s;
+}
+
+const char* skip_json_string(const char* s, const char* e) {  // s at opening quote
+  ++s;
+  while (s < e) {
+    if (*s == '\\') { s += 2; continue; }
+    if (*s == '"') return s + 1;
+    ++s;
+  }
+  return nullptr;
+}
+
+const char* skip_json_container(const char* s, const char* e, char open, char close) {
+  int depth = 0;
+  while (s < e) {
+    if (*s == '"') {
+      s = skip_json_string(s, e);
+      if (!s) return nullptr;
+      continue;
+    }
+    if (*s == open) ++depth;
+    else if (*s == close) {
+      if (--depth == 0) return s + 1;
+    }
+    ++s;
+  }
+  return nullptr;
+}
+
+const char* skip_json_value(const char* s, const char* e) {
+  s = skip_ws(s, e);
+  if (s >= e) return nullptr;
+  if (*s == '"') return skip_json_string(s, e);
+  if (*s == '{') return skip_json_container(s, e, '{', '}');
+  if (*s == '[') return skip_json_container(s, e, '[', ']');
+  while (s < e && *s != ',' && *s != '}' && *s != ']') ++s;  // number/true/false/null
+  return s;
+}
+
+// extract extra["p"][key] as a double; NaN when absent or non-numeric
+double extract_prop(const uint8_t* extra, uint32_t len, const char* key) {
+  const double nan = std::numeric_limits<double>::quiet_NaN();
+  const char* s = reinterpret_cast<const char*>(extra);
+  const char* e = s + len;
+  // fast path: records written by el_append_columnar (and any compact
+  // extra whose first property is the key) start {"p":{"<key>":
+  {
+    size_t klen = strlen(key);
+    if (len > 8 + klen && memcmp(s, "{\"p\":{\"", 7) == 0 &&
+        memcmp(s + 7, key, klen) == 0 && s[7 + klen] == '"' &&
+        s[8 + klen] == ':') {
+      const char* v = s + 9 + klen;
+      if (v < e && (*v == '-' || (*v >= '0' && *v <= '9'))) {
+        char numbuf[64];
+        size_t n = std::min<size_t>(e - v, 63);
+        memcpy(numbuf, v, n);
+        numbuf[n] = 0;
+        return strtod(numbuf, nullptr);
+      }
+    }
+  }
+  s = skip_ws(s, e);
+  if (s >= e || *s != '{') return nan;
+  ++s;
+  size_t klen = strlen(key);
+  // walk the top-level object to find "p"
+  while (true) {
+    s = skip_ws(s, e);
+    if (s >= e || *s == '}') return nan;
+    if (*s == ',') { ++s; continue; }
+    if (*s != '"') return nan;
+    const char* kstart = s + 1;
+    const char* kend_q = skip_json_string(s, e);
+    if (!kend_q) return nan;
+    const char* kend = kend_q - 1;
+    s = skip_ws(kend_q, e);
+    if (s >= e || *s != ':') return nan;
+    ++s;
+    s = skip_ws(s, e);
+    bool is_p = (kend - kstart) == 1 && *kstart == 'p';
+    if (!is_p) {
+      s = skip_json_value(s, e);
+      if (!s) return nan;
+      continue;
+    }
+    // inside "p": walk its pairs for `key`
+    if (s >= e || *s != '{') return nan;
+    ++s;
+    while (true) {
+      s = skip_ws(s, e);
+      if (s >= e || *s == '}') return nan;
+      if (*s == ',') { ++s; continue; }
+      if (*s != '"') return nan;
+      const char* pstart = s + 1;
+      const char* pend_q = skip_json_string(s, e);
+      if (!pend_q) return nan;
+      const char* pend = pend_q - 1;
+      s = skip_ws(pend_q, e);
+      if (s >= e || *s != ':') return nan;
+      ++s;
+      s = skip_ws(s, e);
+      if (static_cast<size_t>(pend - pstart) == klen &&
+          memcmp(pstart, key, klen) == 0) {
+        if (s < e && (*s == '-' || (*s >= '0' && *s <= '9'))) {
+          char numbuf[64];
+          size_t n = std::min<size_t>(e - s, 63);
+          memcpy(numbuf, s, n);
+          numbuf[n] = 0;
+          return strtod(numbuf, nullptr);
+        }
+        return nan;  // present but not numeric
+      }
+      s = skip_json_value(s, e);
+      if (!s) return nan;
+    }
+  }
+}
+
+// dict encoder for string columns: string -> code in first-seen order,
+// dictionary emitted as '\0'-joined bytes. Keys are string_views into
+// the mmap'ed log (stable under the shared lock held for the whole
+// scan), so encoding 20M rows allocates nothing per row.
+struct DictEncoder {
+  std::unordered_map<std::string_view, int32_t> codes;
+  std::vector<std::string_view> order;
+
+  int32_t encode(const uint8_t* s, uint32_t len) {
+    std::string_view key(reinterpret_cast<const char*>(s), len);
+    auto it = codes.find(key);
+    if (it != codes.end()) return it->second;
+    int32_t code = static_cast<int32_t>(order.size());
+    codes.emplace(key, code);
+    order.push_back(key);
+    return code;
+  }
+
+  // '\0'-joined dictionary; caller owns (el_free)
+  uint8_t* dump(uint64_t* nbytes) const {
+    uint64_t total = 0;
+    for (const auto& s : order) total += s.size() + 1;
+    uint8_t* buf = static_cast<uint8_t*>(malloc(total ? total : 1));
+    if (!buf) return nullptr;
+    uint64_t w = 0;
+    for (const auto& s : order) {
+      memcpy(buf + w, s.data(), s.size());
+      w += s.size();
+      buf[w++] = 0;
+    }
+    *nbytes = total;
+    return buf;
+  }
+};
 
 }  // namespace
 
@@ -274,8 +589,78 @@ void el_close(void* h) { delete static_cast<Log*>(h); }
 int64_t el_count(void* h) {
   Log* log = static_cast<Log*>(h);
   std::shared_lock lk(log->mu);
-  return static_cast<int64_t>(log->by_id.size());
+  // unindexed (fresh-id columnar) records are all live
+  return static_cast<int64_t>(log->by_id.size() +
+                              (log->recs.size() - log->indexed_upto));
 }
+
+namespace {
+
+// scans that must consult by_id for liveness (tombstones/dupes exist)
+// need the id index completed first; take the exclusive lock only when
+// there is lazy-indexing debt to pay
+void ensure_index_for_scan(Log* log) {
+  bool need;
+  {
+    std::shared_lock lk(log->mu);
+    need = !log->all_live() && log->indexed_upto != log->recs.size();
+  }
+  if (need) {
+    std::unique_lock lk(log->mu);
+    if (!log->broken) log->ensure_id_index();
+  }
+}
+
+}  // namespace
+
+namespace {
+
+// write + index a batch of records already known to be well-formed
+// (validated by el_append_batch, or built by el_append_columnar —
+// fresh_ids = the batch's ids were freshly generated, enabling lazy
+// id indexing)
+int64_t append_packed(Log* log, const uint8_t* buf, uint64_t nbytes, int64_t n,
+                      bool fresh_ids = false) {
+  std::unique_lock lk(log->mu);
+  if (log->broken) return -1;
+  uint64_t written = 0;
+  while (written < nbytes) {
+    ssize_t w = write(log->fd, buf + written, nbytes - written);
+    if (w < 0) {
+      // partial batch on disk: re-truncate to the pre-batch size
+      if (ftruncate(log->fd, log->file_size) != 0) {}
+      return -1;
+    }
+    written += static_cast<uint64_t>(w);
+  }
+  if (log->fsync_on_append) fdatasync(log->fd);
+
+  uint64_t base = log->file_size;
+  log->file_size += nbytes;
+  // index from the caller's buffer so indexing does not depend on the
+  // remap succeeding; reserve up front so a 20M-row ingest doesn't
+  // rehash the id map dozens of times. Caller-supplied ids could
+  // duplicate an unindexed record, so pay any lazy-indexing debt first
+  // (dup detection must see every id).
+  log->recs.reserve(log->recs.size() + n);
+  if (!fresh_ids) {
+    log->ensure_id_index();
+    log->by_id.reserve(log->by_id.size() + n);
+  }
+  uint64_t off = 0;
+  while (off < nbytes) {
+    uint32_t len;
+    memcpy(&len, buf + off, 4);
+    Header h2;
+    parse(buf + off + 4, len, &h2);
+    log->index_record(base + off, len, h2, fresh_ids);
+    off += 4 + len;
+  }
+  if (!log->ensure_mapped()) log->broken = true;
+  return n;
+}
+
+}  // namespace
 
 // Appends a batch of pre-packed records. Validates the whole batch before
 // writing anything (all-or-nothing). Returns records appended, or -1.
@@ -296,41 +681,14 @@ int64_t el_append_batch(void* h, const uint8_t* buf, uint64_t nbytes) {
     off += 4 + len;
     ++n;
   }
-
-  std::unique_lock lk(log->mu);
-  if (log->broken) return -1;
-  uint64_t written = 0;
-  while (written < nbytes) {
-    ssize_t w = write(log->fd, buf + written, nbytes - written);
-    if (w < 0) {
-      // partial batch on disk: re-truncate to the pre-batch size
-      if (ftruncate(log->fd, log->file_size) != 0) {}
-      return -1;
-    }
-    written += static_cast<uint64_t>(w);
-  }
-  if (log->fsync_on_append) fdatasync(log->fd);
-
-  uint64_t base = log->file_size;
-  log->file_size += nbytes;
-  // index from the caller's buffer (already validated) so indexing does
-  // not depend on the remap succeeding
-  off = 0;
-  while (off < nbytes) {
-    uint32_t len;
-    memcpy(&len, buf + off, 4);
-    Header h2;
-    parse(buf + off + 4, len, &h2);
-    log->index_record(base + off, len, h2);
-    off += 4 + len;
-  }
-  if (!log->ensure_mapped()) log->broken = true;
-  return n;
+  return append_packed(log, buf, nbytes, n);
 }
 
 int el_delete(void* h, const uint8_t* id16) {
   Log* log = static_cast<Log*>(h);
   std::unique_lock lk(log->mu);
+  if (log->broken) return -1;
+  log->ensure_id_index();
   std::string id(reinterpret_cast<const char*>(id16), 16);
   auto it = log->by_id.find(id);
   if (it == log->by_id.end()) return 0;
@@ -351,6 +709,11 @@ int el_delete(void* h, const uint8_t* id16) {
 // Returns total bytes, 0 if absent, -1 on error.
 int64_t el_get(void* h, const uint8_t* id16, uint8_t** out) {
   Log* log = static_cast<Log*>(h);
+  {
+    std::unique_lock ul(log->mu);
+    if (log->broken) return -1;
+    log->ensure_id_index();
+  }
   std::shared_lock lk(log->mu);
   if (log->broken) return -1;
   auto it = log->by_id.find(std::string(reinterpret_cast<const char*>(id16), 16));
@@ -370,86 +733,12 @@ int64_t el_get(void* h, const uint8_t* id16, uint8_t** out) {
 // reverse + limit. Output: concatenated records; returns the count.
 int64_t el_find(void* h, const FindReq* req, uint8_t** out, uint64_t* out_bytes) {
   Log* log = static_cast<Log*>(h);
+  ensure_index_for_scan(log);
   std::shared_lock lk(log->mu);
   if (log->broken) return -1;
 
-  uint64_t etype_h = req->entity_type
-      ? fnv1a(reinterpret_cast<const uint8_t*>(req->entity_type), strlen(req->entity_type))
-      : 0;
-  uint64_t eid_h = req->entity_id
-      ? fnv1a(reinterpret_cast<const uint8_t*>(req->entity_id), strlen(req->entity_id))
-      : 0;
-  uint64_t ttype_h = (req->target_type_mode == 2)
-      ? fnv1a(reinterpret_cast<const uint8_t*>(req->target_entity_type),
-              strlen(req->target_entity_type))
-      : 0;
-  uint64_t tid_h = (req->target_id_mode == 2)
-      ? fnv1a(reinterpret_cast<const uint8_t*>(req->target_entity_id),
-              strlen(req->target_entity_id))
-      : 0;
-  std::vector<std::pair<uint64_t, const char*>> name_hashes;
-  {
-    const char* p = req->event_names;
-    for (int32_t i = 0; i < req->n_event_names; ++i) {
-      size_t l = strlen(p);
-      name_hashes.emplace_back(fnv1a(reinterpret_cast<const uint8_t*>(p), l), p);
-      p += l + 1;
-    }
-  }
-
   std::vector<uint64_t> hits;
-  for (uint64_t i = 0; i < log->recs.size(); ++i) {
-    const RecMeta& m = log->recs[i];
-    if (m.time_us < req->start_us || m.time_us >= req->until_us) continue;
-    if (req->entity_type && m.etype_hash != etype_h) continue;
-    if (req->entity_id && m.eid_hash != eid_h) continue;
-    if (req->target_type_mode == 1 && m.has_target_type) continue;
-    if (req->target_type_mode == 2 && (!m.has_target_type || m.ttype_hash != ttype_h)) continue;
-    if (req->target_id_mode == 1 && m.has_target_id) continue;
-    if (req->target_id_mode == 2 && (!m.has_target_id || m.tid_hash != tid_h)) continue;
-    if (req->n_event_names > 0) {
-      bool any = false;
-      for (const auto& nh : name_hashes) {
-        if (nh.first == m.name_hash) { any = true; break; }
-      }
-      if (!any) continue;
-    }
-    // materialize the header to (a) confirm string matches byte-wise
-    // (hash-collision guard), (b) drop tombstoned/superseded records:
-    // a record is live only if it is the current by_id entry for its id
-    Header hd;
-    parse(log->map + m.offset + 4, m.len, &hd);
-    auto live = log->by_id.find(std::string(reinterpret_cast<const char*>(hd.id), 16));
-    if (live == log->by_id.end() || live->second != i) continue;
-    if (req->entity_type && !bytes_eq(hd.etype, hd.len_etype, req->entity_type)) continue;
-    if (req->entity_id && !bytes_eq(hd.eid, hd.len_eid, req->entity_id)) continue;
-    if (req->target_type_mode == 2 &&
-        !bytes_eq(hd.ttype, hd.len_ttype, req->target_entity_type)) continue;
-    if (req->target_id_mode == 2 &&
-        !bytes_eq(hd.tid, hd.len_tid, req->target_entity_id)) continue;
-    if (req->n_event_names > 0) {
-      bool any = false;
-      for (const auto& nh : name_hashes) {
-        if (bytes_eq(hd.event, hd.len_event, nh.second)) { any = true; break; }
-      }
-      if (!any) continue;
-    }
-    hits.push_back(i);
-  }
-
-  auto key_less = [&](uint64_t a, uint64_t b) {
-    const RecMeta& ma = log->recs[a];
-    const RecMeta& mb = log->recs[b];
-    if (ma.time_us != mb.time_us) return ma.time_us < mb.time_us;
-    if (ma.ctime_us != mb.ctime_us) return ma.ctime_us < mb.ctime_us;
-    return a < b;
-  };
-  if (req->reversed)
-    std::sort(hits.begin(), hits.end(), [&](uint64_t a, uint64_t b) { return key_less(b, a); });
-  else
-    std::sort(hits.begin(), hits.end(), key_less);
-  if (req->limit >= 0 && hits.size() > static_cast<uint64_t>(req->limit))
-    hits.resize(req->limit);
+  collect_hits(log, req, &hits);
 
   uint64_t total = 0;
   for (uint64_t i : hits) total += 4 + log->recs[i].len;
@@ -464,6 +753,222 @@ int64_t el_find(void* h, const FindReq* req, uint8_t** out, uint64_t* out_bytes)
   *out = buf;
   *out_bytes = total;
   return static_cast<int64_t>(hits.size());
+}
+
+// Columnar filtered scan: the bulk training-read path (the role of the
+// reference's region-parallel HBase scans feeding RDDs,
+// hbase/HBPEvents.scala:48) — matching events come back dict-encoded
+// (entity id / target id / event name as int32 codes + '\0'-joined
+// dictionaries in first-seen order) plus one numeric property extracted
+// from the record's JSON extra (`value_prop`; NaN when absent), so a
+// 20M-event read never materializes per-event Python objects.
+// Output arrays are malloc'd; caller frees each with el_free. Rows with
+// no target id get tgt_code = -1. Returns the row count, or -1.
+int64_t el_find_columnar(
+    void* h, const FindReq* req, const char* value_prop, int32_t time_ordered,
+    int32_t** ent_codes_out, int32_t** tgt_codes_out,
+    int32_t** name_codes_out, double** values_out, int64_t** times_us_out,
+    uint8_t** ent_dict_out, uint64_t* ent_dict_bytes, int64_t* n_ent,
+    uint8_t** tgt_dict_out, uint64_t* tgt_dict_bytes, int64_t* n_tgt,
+    uint8_t** name_dict_out, uint64_t* name_dict_bytes, int64_t* n_names) {
+  Log* log = static_cast<Log*>(h);
+  ensure_index_for_scan(log);
+  std::shared_lock lk(log->mu);
+  if (log->broken) return -1;
+
+  const double nan = std::numeric_limits<double>::quiet_NaN();
+  DictEncoder ents, tgts, names;
+  ents.codes.reserve(1 << 16);
+  tgts.codes.reserve(1 << 16);
+  std::vector<int32_t> ent_v, tgt_v, name_v;
+  std::vector<double> val_v;
+  std::vector<int64_t> time_v;
+  // no up-front reserve sized to the log: a selective scan would commit
+  // ~28 B/record regardless of matches; amortized growth is fine
+
+  auto emit = [&](const Header& hd) {
+    ent_v.push_back(ents.encode(hd.eid, hd.len_eid));
+    tgt_v.push_back(hd.tid ? tgts.encode(hd.tid, hd.len_tid) : -1);
+    name_v.push_back(names.encode(hd.event, hd.len_event));
+    time_v.push_back(hd.time_us);
+    if (value_prop && hd.len_extra) {
+      const uint8_t* extra = hd.tid ? hd.tid + hd.len_tid
+                           : hd.ttype ? hd.ttype + hd.len_ttype
+                           : hd.eid + hd.len_eid;
+      val_v.push_back(extract_prop(extra, hd.len_extra, value_prop));
+    } else {
+      val_v.push_back(nan);
+    }
+  };
+
+  if (time_ordered || req->limit >= 0) {
+    // order (and therefore limit) needs the full hit set first
+    std::vector<uint64_t> hits;
+    collect_hits(log, req, &hits);
+    Header hd;
+    for (uint64_t i : hits) {
+      parse(log->map + log->recs[i].offset + 4, log->recs[i].len, &hd);
+      emit(hd);
+    }
+  } else {
+    // fused fast path (bulk training reads): filter + encode in ONE
+    // pass, records in log order, no sort — a 20M-row scan parses each
+    // record exactly once
+    FilterCtx ctx = make_filter_ctx(req);
+    Header hd;
+    for (uint64_t i = 0; i < log->recs.size(); ++i) {
+      if (match_rec(log, req, ctx, i, &hd)) emit(hd);
+    }
+  }
+
+  const uint64_t n = ent_v.size();
+  auto copy_out = [](const auto& v, auto** out) {
+    using T = typename std::remove_reference_t<decltype(v)>::value_type;
+    T* buf = static_cast<T*>(malloc(sizeof(T) * (v.size() ? v.size() : 1)));
+    if (!buf) return false;
+    memcpy(buf, v.data(), sizeof(T) * v.size());
+    *out = buf;
+    return true;
+  };
+  int32_t* ent_codes = nullptr;
+  int32_t* tgt_codes = nullptr;
+  int32_t* name_codes = nullptr;
+  double* values = nullptr;
+  int64_t* times_us = nullptr;
+  if (!copy_out(ent_v, &ent_codes) || !copy_out(tgt_v, &tgt_codes) ||
+      !copy_out(name_v, &name_codes) || !copy_out(val_v, &values) ||
+      !copy_out(time_v, &times_us)) {
+    free(ent_codes); free(tgt_codes); free(name_codes); free(values); free(times_us);
+    return -1;
+  }
+
+  uint8_t* ent_dict = ents.dump(ent_dict_bytes);
+  uint8_t* tgt_dict = tgts.dump(tgt_dict_bytes);
+  uint8_t* name_dict = names.dump(name_dict_bytes);
+  if (!ent_dict || !tgt_dict || !name_dict) {
+    free(ent_codes); free(tgt_codes); free(name_codes); free(values); free(times_us);
+    free(ent_dict); free(tgt_dict); free(name_dict);
+    return -1;
+  }
+  *ent_codes_out = ent_codes;
+  *tgt_codes_out = tgt_codes;
+  *name_codes_out = name_codes;
+  *values_out = values;
+  *times_us_out = times_us;
+  *ent_dict_out = ent_dict;
+  *tgt_dict_out = tgt_dict;
+  *name_dict_out = name_dict;
+  *n_ent = static_cast<int64_t>(ents.order.size());
+  *n_tgt = static_cast<int64_t>(tgts.order.size());
+  *n_names = static_cast<int64_t>(names.order.size());
+  return static_cast<int64_t>(n);
+}
+
+// Columnar bulk append: the native ingest path behind pio import /
+// insert_columnar (the role of the reference's PEvents.write RDD bulk
+// writes, hbase/HBPEvents.scala:124) — rows arrive dict-encoded
+// (codes + '\0'-joined vocab with prefix offsets) and are packed into
+// wire records in C++, so a 20M-event ingest never builds per-event
+// Python objects. Event ids are fresh random 16-byte ids; out_ids
+// (optional, n*16 bytes caller-allocated) receives them. `values[i]`
+// NaN means "no property"; otherwise extra = {"p":{"<value_prop>":v}}.
+// Returns rows appended, or -1.
+int64_t el_append_columnar(
+    void* h, int64_t n,
+    const char* entity_type, const char* target_entity_type,
+    const char* value_prop,
+    const uint8_t* ent_dict, const uint64_t* ent_offsets, int64_t n_ent,
+    const uint8_t* tgt_dict, const uint64_t* tgt_offsets, int64_t n_tgt,
+    const uint8_t* name_dict, const uint64_t* name_offsets, int64_t n_names,
+    const int32_t* ent_codes, const int32_t* tgt_codes,
+    const int32_t* name_codes, const int64_t* times_us,
+    const double* values, uint8_t* out_ids) {
+  Log* log = static_cast<Log*>(h);
+  size_t l_etype = strlen(entity_type);
+  size_t l_ttype = target_entity_type ? strlen(target_entity_type) : 0;
+  size_t l_prop = value_prop ? strlen(value_prop) : 0;
+
+  int64_t now_us;
+  {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    now_us = static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+  }
+  std::mt19937_64 rng(std::random_device{}() ^
+                      static_cast<uint64_t>(now_us) ^
+                      reinterpret_cast<uintptr_t>(h));
+
+  std::vector<uint8_t> buf;
+  buf.reserve(static_cast<size_t>(n) * 96);
+  char extra[96];
+  std::unordered_map<double, std::string> fmt_cache;
+  for (int64_t r = 0; r < n; ++r) {
+    int32_t ec = ent_codes[r];
+    if (ec < 0 || ec >= n_ent) return -1;
+    const uint8_t* eid = ent_dict + ent_offsets[ec];
+    uint32_t l_eid = static_cast<uint32_t>(ent_offsets[ec + 1] - ent_offsets[ec]);
+    int32_t tc = tgt_codes ? tgt_codes[r] : -1;
+    const uint8_t* tid = nullptr;
+    uint32_t l_tid = 0;
+    if (tc >= 0) {
+      if (tc >= n_tgt || !target_entity_type) return -1;
+      tid = tgt_dict + tgt_offsets[tc];
+      l_tid = static_cast<uint32_t>(tgt_offsets[tc + 1] - tgt_offsets[tc]);
+    }
+    int32_t nc = name_codes[r];
+    if (nc < 0 || nc >= n_names) return -1;
+    const uint8_t* name = name_dict + name_offsets[nc];
+    uint32_t l_name = static_cast<uint32_t>(name_offsets[nc + 1] - name_offsets[nc]);
+
+    uint32_t l_extra = 0;
+    const char* extra_src = extra;
+    if (value_prop && values && values[r] == values[r]) {  // not NaN
+      // ratings repeat from a tiny value set; format each distinct
+      // double once (snprintf %.17g is ~300ns, the cache ~30ns)
+      auto it = fmt_cache.find(values[r]);
+      if (it == fmt_cache.end()) {
+        int w = snprintf(extra, sizeof(extra), "{\"p\":{\"%s\":%.17g}}",
+                         value_prop, values[r]);
+        if (w <= 0 || static_cast<size_t>(w) >= sizeof(extra)) return -1;
+        it = fmt_cache.emplace(values[r], std::string(extra, w)).first;
+      }
+      extra_src = it->second.data();
+      l_extra = static_cast<uint32_t>(it->second.size());
+    }
+
+    bool has_target = tc >= 0;
+    uint32_t rec_len = kHeaderLen + l_name + l_etype + l_eid +
+                       (has_target ? l_ttype + l_tid : 0) + l_extra;
+    size_t base = buf.size();
+    buf.resize(base + 4 + rec_len);
+    uint8_t* p = buf.data() + base;
+    memcpy(p, &rec_len, 4);
+    p += 4;
+    uint64_t id_hi = rng(), id_lo = rng();
+    memcpy(p, &id_hi, 8);
+    memcpy(p + 8, &id_lo, 8);
+    if (out_ids) memcpy(out_ids + r * 16, p, 16);
+    memcpy(p + 16, &times_us[r], 8);
+    memcpy(p + 24, &now_us, 8);
+    uint16_t u16;
+    u16 = static_cast<uint16_t>(l_name); memcpy(p + 32, &u16, 2);
+    u16 = static_cast<uint16_t>(l_etype); memcpy(p + 34, &u16, 2);
+    u16 = static_cast<uint16_t>(l_eid); memcpy(p + 36, &u16, 2);
+    u16 = has_target ? static_cast<uint16_t>(l_ttype) : kAbsent; memcpy(p + 38, &u16, 2);
+    u16 = has_target ? static_cast<uint16_t>(l_tid) : kAbsent; memcpy(p + 40, &u16, 2);
+    memcpy(p + 42, &l_extra, 4);
+    uint8_t* s = p + kHeaderLen;
+    memcpy(s, name, l_name); s += l_name;
+    memcpy(s, entity_type, l_etype); s += l_etype;
+    memcpy(s, eid, l_eid); s += l_eid;
+    if (has_target) {
+      memcpy(s, target_entity_type, l_ttype); s += l_ttype;
+      memcpy(s, tid, l_tid); s += l_tid;
+    }
+    if (l_extra) memcpy(s, extra_src, l_extra);
+  }
+  // records were built here (fresh ids) — no validation pass, lazy id index
+  return append_packed(log, buf.data(), buf.size(), n, /*fresh_ids=*/true);
 }
 
 }  // extern "C"

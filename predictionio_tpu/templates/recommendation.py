@@ -40,13 +40,27 @@ class RatingEvent:
 
 
 @dataclass
+class RatingColumns:
+    """Columnar triples: vocab lists + dense code/value arrays (the
+    dict-encoded bulk-read product of store.find_columnar)."""
+
+    user_vocab: List[str]
+    item_vocab: List[str]
+    user_idx: np.ndarray    # int into user_vocab, [n]
+    item_idx: np.ndarray    # int into item_vocab, [n]
+    ratings: np.ndarray     # float32 [n]
+
+
+@dataclass
 class RatingsTD(SanityCheck):
-    """TD: raw (user, item, rating) triples from the event store."""
+    """TD: (user, item, rating) triples from the event store — as a
+    row list (small data, eval folds) or columnar arrays (bulk path)."""
 
     ratings: List[RatingEvent] = field(default_factory=list)
+    columns: Optional[RatingColumns] = None
 
     def sanity_check(self) -> None:
-        if not self.ratings:
+        if not self.ratings and (self.columns is None or not len(self.columns.ratings)):
             raise ValueError("RatingsTD is empty — no rate/buy events found")
 
 
@@ -59,6 +73,8 @@ class RecoDataSourceParams(Params):
     buy_rating: float = 4.0
     eval_k: int = 0           # >0 enables k-fold readEval
     eval_query_num: int = 10
+    columnar: bool = True     # bulk dict-encoded read (ML-20M path);
+                              # False forces the per-event row path
 
 
 class RecoDataSource(DataSource):
@@ -85,7 +101,38 @@ class RecoDataSource(DataSource):
             out.append(RatingEvent(user=e.entity_id, item=e.target_entity_id, rating=rating))
         return out
 
+    def _read_columnar(self) -> RatingColumns:
+        """Bulk path: one dict-encoded scan, ratings resolved vectorized
+        (rate -> its rating property, buy -> the constant buy_rating)."""
+        p: RecoDataSourceParams = self.params
+        cols = store.find_columnar(
+            p.app_name,
+            channel_name=p.channel_name,
+            value_property="rating",
+            time_ordered=False,   # ALS is order-blind; skip the sort
+            entity_type="user",
+            event_names=[p.rate_event, p.buy_event],
+            target_entity_type="item",
+        )
+        ratings = np.nan_to_num(cols.values, nan=0.0).astype(np.float32)
+        if p.buy_event in cols.names:
+            buy_code = cols.names.index(p.buy_event)
+            ratings = np.where(
+                cols.name_codes == buy_code, np.float32(p.buy_rating), ratings
+            )
+        keep = cols.target_codes >= 0  # drop events with no target id
+        return RatingColumns(
+            user_vocab=cols.entity_vocab,
+            item_vocab=cols.target_vocab,
+            user_idx=cols.entity_codes[keep],
+            item_idx=cols.target_codes[keep],
+            ratings=ratings[keep],
+        )
+
     def read_training(self, ctx: MeshContext) -> RatingsTD:
+        p: RecoDataSourceParams = self.params
+        if p.columnar:
+            return RatingsTD(columns=self._read_columnar())
         return RatingsTD(ratings=self._read())
 
     def read_eval(self, ctx: MeshContext):
@@ -111,9 +158,19 @@ class RecoDataSource(DataSource):
 
 class RecoPreparator(Preparator):
     """String ids -> dense COO (ref: template Preparator + MLlibs' indexing
-    via BiMap, SURVEY.md §2.4 BiMap row)."""
+    via BiMap, SURVEY.md §2.4 BiMap row). The columnar TD arrives already
+    dict-encoded, so indexing is just wrapping the vocabularies."""
 
     def prepare(self, ctx: MeshContext, td: RatingsTD) -> PreparedRatings:
+        if td.columns is not None:
+            c = td.columns
+            return PreparedRatings(
+                user_ids=BiMap.from_vocab(c.user_vocab),
+                item_ids=BiMap.from_vocab(c.item_vocab),
+                user_idx=c.user_idx.astype(np.int64, copy=False),
+                item_idx=c.item_idx.astype(np.int64, copy=False),
+                ratings=c.ratings,
+            )
         users = BiMap.string_int(r.user for r in td.ratings)
         items = BiMap.string_int(r.item for r in td.ratings)
         n = len(td.ratings)
