@@ -252,6 +252,18 @@ def _force(x: jax.Array) -> None:
     jnp.sum(x).item()
 
 
+def _materialize(x: jax.Array) -> np.ndarray:
+    """Device array -> host numpy, correct under multi-host: an array
+    sharded across processes spans non-addressable devices, so it must
+    be allgathered (every host gets the full factors, as every Spark
+    executor's ALS blocks collect to the driver in the reference)."""
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
 @dataclasses.dataclass
 class ALSFactors:
     user_factors: np.ndarray  # [n_users, K] float32
@@ -386,8 +398,8 @@ class ALSTrainer:
 
     def factors(self) -> ALSFactors:
         return ALSFactors(
-            user_factors=np.asarray(self._X)[: self.n_users],
-            item_factors=np.asarray(self._Y)[: self.n_items],
+            user_factors=_materialize(self._X)[: self.n_users],
+            item_factors=_materialize(self._Y)[: self.n_items],
         )
 
 
