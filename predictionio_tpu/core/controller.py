@@ -139,6 +139,16 @@ class Algorithm(Doer, Generic[PD, M, Q, P]):
         """Inverse of make_persistent_model at deploy time."""
         return persisted
 
+    def warmup(self, model: M, ctx: MeshContext) -> None:
+        """Pre-compile the serve path's standard shape buckets.
+
+        Called by the engine server right after deploy/reload so the
+        FIRST live query doesn't pay XLA compile (SURVEY.md §7.5 hard
+        part #2 — the reference has no compile step to warm; a jitted
+        scorer does). Default: no-op. Implementations should drive the
+        same compiled functions ``predict`` uses, at the default
+        (B, k, ...) buckets, and must tolerate empty models."""
+
 
 class Serving(Doer, Generic[Q, P]):
     """Combines the per-algorithm predictions into one response."""

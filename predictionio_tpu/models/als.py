@@ -192,6 +192,16 @@ class ALSAlgorithm(Algorithm):
                 persisted.sharded_axis = None  # single-device deploy
         return persisted
 
+    def warmup(self, model: ALSModel, ctx: MeshContext) -> None:
+        """Pre-compile the default serve buckets (B=1, E=1, k buckets
+        8 and 16) so the first query after deploy/reload answers at
+        warm latency (SURVEY.md §7.5 hard part #2)."""
+        if len(model.user_ids) == 0 or len(model.item_ids) == 0:
+            return
+        uv = model.user_factors[:1]
+        for k in (5, 10):
+            model.scorer().score(uv, k)
+
     def predict(self, model: ALSModel, query: Dict[str, Any]) -> Dict[str, Any]:
         num = int(query.get("num", 10))
         recs = model.recommend(

@@ -67,6 +67,12 @@ class ECommAlgorithmParams(Params):
     lambda_: float = 0.01
     seed: int = 3
     block_size: int = 4096
+    # serve-time lookup caching (divergence from the reference, which
+    # scans the event store inside EVERY request, :148-251 — that scan
+    # is a disk read inside the latency budget on file backends). TTL
+    # bounds staleness; 0 disables caching (reference behavior).
+    lookup_ttl_sec: float = 3.0
+    seen_cache_size: int = 10_000
 
 
 class ECommModel:
@@ -153,6 +159,31 @@ class ECommAlgorithm(Algorithm):
 
     def __init__(self, params: ECommAlgorithmParams):
         super().__init__(params)
+        import collections
+        import threading
+
+        # bounded TTL caches for the per-request event-store lookups
+        self._cache_lock = threading.Lock()
+        self._seen_cache: "collections.OrderedDict[str, Tuple[Set[str], float]]" = (
+            collections.OrderedDict()
+        )
+        self._unavail_cache: Optional[Tuple[Set[str], float]] = None
+
+    def _cached(self, cache_get, cache_put, compute):
+        import time
+
+        ttl = getattr(self.params, "lookup_ttl_sec", 0.0)
+        if ttl <= 0:
+            return compute()
+        now = time.monotonic()
+        with self._cache_lock:
+            hit = cache_get()
+            if hit is not None and hit[1] > now:
+                return hit[0]
+        value = compute()
+        with self._cache_lock:
+            cache_put((value, now + ttl))
+        return value
 
     def train(self, ctx: MeshContext, pd: ECommTrainingData) -> ECommModel:
         p: ECommAlgorithmParams = self.params
@@ -201,35 +232,55 @@ class ECommAlgorithm(Algorithm):
             rated_items=rated_items,
         )
 
-    # -- serve-time event lookups (ref: lEventsDb.findSingleEntity calls) -----
+    # -- serve-time event lookups (ref: lEventsDb.findSingleEntity calls;
+    # cached with a bounded TTL here, see ECommAlgorithmParams) ------------
     def _seen_items(self, user: str) -> Set[str]:
         p: ECommAlgorithmParams = self.params
         if not p.unseen_only:
             return set()
-        try:
-            events = store.find_by_entity(
-                p.app_name, "user", user,
-                event_names=list(p.seen_events),
-                target_entity_type="item",
-            )
-        except StorageError:
-            return set()
-        return {e.target_entity_id for e in events if e.target_entity_id}
+
+        def compute() -> Set[str]:
+            try:
+                events = store.find_by_entity(
+                    p.app_name, "user", user,
+                    event_names=list(p.seen_events),
+                    target_entity_type="item",
+                )
+            except StorageError:
+                return set()
+            return {e.target_entity_id for e in events if e.target_entity_id}
+
+        def put(entry):
+            self._seen_cache[user] = entry
+            self._seen_cache.move_to_end(user)
+            while len(self._seen_cache) > p.seen_cache_size:
+                self._seen_cache.popitem(last=False)
+
+        return self._cached(
+            lambda: self._seen_cache.get(user), put, compute
+        )
 
     def _unavailable_items(self) -> Set[str]:
         """Latest constraint/unavailableItems $set (ref: :195-215)."""
         p: ECommAlgorithmParams = self.params
-        try:
-            events = store.find_by_entity(
-                p.app_name, "constraint", "unavailableItems",
-                event_names=["$set"], limit=1, latest=True,
-            )
-        except StorageError:
-            return set()
-        if not events:
-            return set()
-        items = events[0].properties.get_opt("items")
-        return set(items) if items else set()
+
+        def compute() -> Set[str]:
+            try:
+                events = store.find_by_entity(
+                    p.app_name, "constraint", "unavailableItems",
+                    event_names=["$set"], limit=1, latest=True,
+                )
+            except StorageError:
+                return set()
+            if not events:
+                return set()
+            items = events[0].properties.get_opt("items")
+            return set(items) if items else set()
+
+        def put(entry):
+            self._unavail_cache = entry
+
+        return self._cached(lambda: self._unavail_cache, put, compute)
 
     def _recent_items(self, user: str) -> List[str]:
         """Latest 10 viewed items (ref: predictNewUser :293-322)."""
@@ -244,6 +295,17 @@ class ECommAlgorithm(Algorithm):
         except StorageError:
             return []
         return [e.target_entity_id for e in events if e.target_entity_id]
+
+    def warmup(self, model: ECommModel, ctx: MeshContext) -> None:
+        """Pre-compile both masked scorers' default buckets (B=1, k
+        buckets 8 and 16) — no storage lookups, no side effects."""
+        if len(model.item_ids) == 0 or len(model.user_ids) == 0:
+            return
+        mask = np.ones(len(model.item_ids), dtype=bool)
+        model.cos_scorer()  # builds _normalized
+        for k in (5, 10):
+            model.scorer().score_masked(model.user_factors[0], k, mask)
+            model.cos_scorer().score_masked(model._normalized[0], k, mask)
 
     def predict(self, model: ECommModel, query: Dict[str, Any]) -> Dict[str, Any]:
         p: ECommAlgorithmParams = self.params

@@ -152,6 +152,17 @@ class SessionRecAlgorithm(Algorithm):
         state = trainer.state(losses)
         return SessionRecModel(state, pd.user_ids, pd.item_ids)
 
+    def warmup(self, model: SessionRecModel, ctx: MeshContext) -> None:
+        """Pre-compile the B=1 encoder + top-k for both excludeSeen
+        variants (the flag is jit-static) so the first live session
+        query answers at warm latency."""
+        if len(model.item_ids) == 0:
+            return
+        seq = np.zeros((1, model.state.cfg.max_len), np.int32)
+        seq[0, 0] = 1  # one real (1-shifted) item position
+        for exclude_seen in (False, True):
+            model.scorer().top_k(seq, 10, exclude_seen=exclude_seen)
+
     def predict(self, model: SessionRecModel, query: Dict[str, Any]) -> Dict[str, Any]:
         recs = model.recommend(query)
         return {"itemScores": [{"item": i, "score": s} for i, s in recs]}

@@ -215,6 +215,15 @@ class SimilarProductAlgorithm(Algorithm):
         )
         return SimilarProductModel(item_factors, item_ids, pd.item_categories)
 
+    def warmup(self, model: SimilarProductModel, ctx: MeshContext) -> None:
+        """Pre-compile the masked-cosine serve buckets (B=1, k buckets
+        8 and 16) through the real query path."""
+        first = next(iter(model.item_ids.keys()), None)
+        if first is None:
+            return
+        for num in (5, 10):
+            model.similar([first], num)
+
     def predict(self, model: SimilarProductModel, query: Dict[str, Any]) -> Dict[str, Any]:
         recs = model.similar(
             [str(i) for i in query["items"]],

@@ -91,6 +91,7 @@ class TwoTowerAlgorithm(Algorithm):
         return model
 
     # identical model/query surface -> share ALS's serve and batched
-    # (matmul + top-k) evaluation paths
+    # (matmul + top-k) evaluation paths, and its deploy-time warmup
     predict = ALSAlgorithm.predict
     batch_predict = ALSAlgorithm.batch_predict
+    warmup = ALSAlgorithm.warmup

@@ -252,10 +252,28 @@ class EngineServer(HTTPServerBase):
                 f"No valid engine instance found for engine {self.engine_id} "
                 f"{self.engine_version} {self.engine_variant}"
             )
-        return prepare_deploy(self.engine, instance, self.ctx, self.storage)
+        deployment = prepare_deploy(self.engine, instance, self.ctx, self.storage)
+        self._warmup(deployment)
+        return deployment
+
+    def _warmup(self, deployment: Deployment) -> None:
+        """Pre-compile each algorithm's serve buckets BEFORE the
+        deployment goes live, so the first query after deploy/reload
+        pays no XLA compile (SURVEY.md §7.5 hard part #2). Warm-up
+        failures never block a deploy — worst case is reference
+        behavior (first query compiles)."""
+        t0 = time.perf_counter()
+        for algo, model in zip(deployment.algorithms, deployment.models):
+            try:
+                algo.warmup(model, self.ctx)
+            except Exception:  # noqa: BLE001
+                log.exception("warmup failed for %s", type(algo).__name__)
+        log.info("serve warm-up done in %.2fs", time.perf_counter() - t0)
 
     def reload(self) -> str:
-        """Hot-swap to the latest completed instance (ref: /reload :592)."""
+        """Hot-swap to the latest completed instance (ref: /reload :592).
+        The swap happens only after the new deployment is warm — live
+        traffic never waits on the new model's compiles."""
         deployment = self._load_latest()
         with self._deployment_lock:
             self.deployment = deployment

@@ -393,6 +393,14 @@ def cmd_run(args) -> int:
         runpy.run_module(target, run_name="__main__")
     except SystemExit as e:   # module mains exit; keep their code
         return exit_code(e.code, from_exit=True)
+    except ImportError as e:
+        # a package without __main__ (or the target itself failing to
+        # import) is a resolution failure, not a user-code crash
+        name = getattr(e, "name", None)
+        if (name and (name == target or name.startswith(target + "."))) or \
+                "cannot be directly executed" in str(e):
+            raise CommandError(f"cannot run {target!r}: {e}") from e
+        raise
     finally:
         sys.argv = old_argv
     return 0

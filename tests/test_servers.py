@@ -404,3 +404,64 @@ def test_event_server_review_regressions(event_server):
     )
     with urllib.request.urlopen(req, timeout=10) as resp:
         assert resp.status == 200
+
+
+def test_deploy_warmup_first_query_is_warm(memory_storage):
+    """Deploy-time warm-up (SURVEY.md §7.5 hard part #2): the first live
+    query after deploy must not pay XLA compile — it has to land within
+    2x the warm p50 (plus a small timer-noise floor)."""
+    import numpy as np
+
+    from predictionio_tpu.core import Engine, EngineParams, FirstServing
+    from predictionio_tpu.models.als import ALSAlgorithm, ALSParams
+    from predictionio_tpu.templates.recommendation import (
+        RecoDataSource,
+        RecoDataSourceParams,
+        RecoPreparator,
+    )
+    from predictionio_tpu.data.event import Event
+
+    app = memory_storage.apps().insert("warm")
+    memory_storage.events().init(app.id)
+    rng = np.random.default_rng(0)
+    events = [
+        Event(event="rate", entity_type="user", entity_id=f"u{rng.integers(20)}",
+              target_entity_type="item", target_entity_id=f"i{rng.integers(12)}",
+              properties={"rating": float(1 + k % 5)})
+        for k in range(200)
+    ]
+    memory_storage.events().insert_batch(events, app.id)
+
+    engine = Engine(RecoDataSource, RecoPreparator, {"als": ALSAlgorithm},
+                    FirstServing)
+    ep = EngineParams(
+        data_source_params=("", RecoDataSourceParams(app_name="warm")),
+        preparator_params=("", None),
+        algorithm_params_list=[("als", ALSParams(rank=8, num_iterations=2,
+                                                 block_size=16))],
+        serving_params=("", None),
+    )
+    run_train(engine, ep, engine_id="warmals", storage=memory_storage)
+
+    server = EngineServer(
+        engine, "warmals", host="127.0.0.1", port=0, storage=memory_storage,
+        micro_batch=False,
+    ).start()
+    try:
+        query = {"user": "u1", "num": 10}
+        t0 = time.perf_counter()
+        first = server.query(query)
+        first_sec = time.perf_counter() - t0
+        assert first["itemScores"]
+        laps = []
+        for _ in range(20):
+            t0 = time.perf_counter()
+            server.query(query)
+            laps.append(time.perf_counter() - t0)
+        warm_p50 = sorted(laps)[len(laps) // 2]
+        assert first_sec <= max(2 * warm_p50, warm_p50 + 0.15), (
+            f"first query {first_sec:.3f}s vs warm p50 {warm_p50:.4f}s — "
+            "deploy warm-up did not pre-compile the serve bucket"
+        )
+    finally:
+        server.stop()
