@@ -243,6 +243,21 @@ class EngineFactory(abc.ABC):
         ...
 
 
+def factory_from_object(obj: Any, name: str) -> Callable[[], Engine]:
+    """Resolved attribute -> zero-arg engine factory (the acceptance
+    rules of WorkflowUtils.getEngine:60: an EngineFactory subclass, an
+    instance, an Engine, or a plain callable)."""
+    if isinstance(obj, type) and issubclass(obj, EngineFactory):
+        return obj().apply
+    if isinstance(obj, EngineFactory):
+        return obj.apply
+    if isinstance(obj, Engine):
+        return lambda: obj
+    if callable(obj):
+        return obj
+    raise TypeError(f"{name} is not an EngineFactory / Engine / callable")
+
+
 def resolve_engine_factory(dotted: str) -> Callable[[], Engine]:
     """'pkg.module.ObjName' -> zero-arg engine factory.
 
@@ -255,12 +270,4 @@ def resolve_engine_factory(dotted: str) -> Callable[[], Engine]:
     if not module_name:
         raise ValueError(f"engine factory {dotted!r} must be a dotted path")
     obj = getattr(importlib.import_module(module_name), attr)
-    if isinstance(obj, type) and issubclass(obj, EngineFactory):
-        return obj().apply
-    if isinstance(obj, EngineFactory):
-        return obj.apply
-    if isinstance(obj, Engine):
-        return lambda: obj
-    if callable(obj):
-        return obj
-    raise TypeError(f"{dotted} is not an EngineFactory / Engine / callable")
+    return factory_from_object(obj, dotted)

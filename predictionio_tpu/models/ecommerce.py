@@ -167,6 +167,9 @@ class ECommAlgorithm(Algorithm):
         self._seen_cache: "collections.OrderedDict[str, Tuple[Set[str], float]]" = (
             collections.OrderedDict()
         )
+        self._recent_cache: "collections.OrderedDict[str, Tuple[List[str], float]]" = (
+            collections.OrderedDict()
+        )
         self._unavail_cache: Optional[Tuple[Set[str], float]] = None
 
     def _cached(self, cache_get, cache_put, compute):
@@ -283,18 +286,32 @@ class ECommAlgorithm(Algorithm):
         return self._cached(lambda: self._unavail_cache, put, compute)
 
     def _recent_items(self, user: str) -> List[str]:
-        """Latest 10 viewed items (ref: predictNewUser :293-322)."""
+        """Latest 10 viewed items (ref: predictNewUser :293-322); TTL
+        cached like the other lookups — the new-user path must not keep
+        a per-request storage scan either."""
         p: ECommAlgorithmParams = self.params
-        try:
-            events = store.find_by_entity(
-                p.app_name, "user", user,
-                event_names=["view"],
-                target_entity_type="item",
-                limit=10, latest=True,
-            )
-        except StorageError:
-            return []
-        return [e.target_entity_id for e in events if e.target_entity_id]
+
+        def compute() -> List[str]:
+            try:
+                events = store.find_by_entity(
+                    p.app_name, "user", user,
+                    event_names=["view"],
+                    target_entity_type="item",
+                    limit=10, latest=True,
+                )
+            except StorageError:
+                return []
+            return [e.target_entity_id for e in events if e.target_entity_id]
+
+        def put(entry):
+            self._recent_cache[user] = entry
+            self._recent_cache.move_to_end(user)
+            while len(self._recent_cache) > p.seen_cache_size:
+                self._recent_cache.popitem(last=False)
+
+        return self._cached(
+            lambda: self._recent_cache.get(user), put, compute
+        )
 
     def warmup(self, model: ECommModel, ctx: MeshContext) -> None:
         """Pre-compile both masked scorers' default buckets (B=1, k

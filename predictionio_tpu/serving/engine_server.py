@@ -212,6 +212,7 @@ class EngineServer(HTTPServerBase):
         storage: Optional[Storage] = None,
         feedback_url: Optional[str] = None,
         feedback_access_key: Optional[str] = None,
+        log_url: Optional[str] = None,
         bind_retries: int = 3,
         micro_batch: bool = True,
         max_batch: int = 64,
@@ -224,6 +225,7 @@ class EngineServer(HTTPServerBase):
         self.storage = storage or get_storage()
         self.feedback_url = feedback_url
         self.feedback_access_key = feedback_access_key
+        self.log_url = log_url
         self.stats = ServingStats()
         self._deployment_lock = threading.Lock()
         self.deployment: Deployment = self._load_latest()
@@ -313,25 +315,51 @@ class EngineServer(HTTPServerBase):
             ).start()
         return result
 
-    def _send_feedback(self, query: Any, prediction: Any, pr_id: str, instance_id: str) -> None:
-        """Async predict-event feedback loop (ref: CreateServer.scala:488-550)."""
+    @staticmethod
+    def _post_json(url: str, payload: Any, what: str) -> None:
+        """One best-effort JSON POST (shared by the feedback loop and
+        remote error log; failures are logged, never raised)."""
         try:
-            event = {
-                "event": "predict",
-                "entityType": "pio_pr",
-                "entityId": instance_id,
-                "prId": pr_id,
-                "properties": {"query": query, "prediction": prediction},
-            }
             req = urllib.request.Request(
-                f"{self.feedback_url}/events.json?accessKey={self.feedback_access_key}",
-                data=json.dumps(event).encode(),
+                url,
+                data=json.dumps(payload).encode(),
                 headers={"Content-Type": "application/json"},
                 method="POST",
             )
             urllib.request.urlopen(req, timeout=5)
-        except Exception as e:  # feedback is best-effort
-            log.warning("feedback loop failed: %s", e)
+        except Exception as e:  # noqa: BLE001 — best-effort
+            log.warning("%s POST failed: %s", what, e)
+
+    def remote_log(self, message: str, level: str = "ERROR") -> None:
+        """POST an error line to the configured --log-url (ref:
+        CreateServer.scala:413-424 remoteLog — fire-and-forget, a dead
+        log endpoint must never affect serving)."""
+        if not self.log_url:
+            return
+        payload = {
+            "level": level,
+            "message": message,
+            "engineId": self.engine_id,
+            "engineVariant": self.engine_variant,
+        }
+        threading.Thread(
+            target=self._post_json, args=(self.log_url, payload, "remote log"),
+            daemon=True,
+        ).start()
+
+    def _send_feedback(self, query: Any, prediction: Any, pr_id: str, instance_id: str) -> None:
+        """Async predict-event feedback loop (ref: CreateServer.scala:488-550)."""
+        event = {
+            "event": "predict",
+            "entityType": "pio_pr",
+            "entityId": instance_id,
+            "prId": pr_id,
+            "properties": {"query": query, "prediction": prediction},
+        }
+        self._post_json(
+            f"{self.feedback_url}/events.json?accessKey={self.feedback_access_key}",
+            event, "feedback loop",
+        )
 
     def stop(self) -> None:
         if self._batcher is not None:
@@ -355,18 +383,69 @@ class EngineServer(HTTPServerBase):
         }
 
 
+_STATUS_HTML = """<!DOCTYPE html>
+<html><head><title>{engine_id} — PredictionIO-TPU engine</title>
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 2rem auto; max-width: 60rem; }}
+ h1 {{ font-size: 1.4rem; }} table {{ border-collapse: collapse; }}
+ td, th {{ border: 1px solid #ccc; padding: .3rem .6rem; text-align: left; }}
+ code {{ background: #f4f4f4; padding: 0 .2rem; }}
+</style></head><body>
+<h1>Engine <code>{engine_id}</code> is deployed</h1>
+<table>
+<tr><th>Engine variant</th><td>{engine_variant}</td></tr>
+<tr><th>Engine instance</th><td>{engine_instance_id}</td></tr>
+<tr><th>Engine factory</th><td>{engine_factory}</td></tr>
+<tr><th>Trained at</th><td>{trained_at}</td></tr>
+<tr><th>Started</th><td>{start_time}</td></tr>
+<tr><th>Requests served</th><td>{request_count}</td></tr>
+<tr><th>Average serving time</th><td>{avg_ms:.2f} ms</td></tr>
+<tr><th>Last serving time</th><td>{last_ms:.2f} ms</td></tr>
+</table>
+<h2>Algorithms</h2><pre>{algorithms}</pre>
+<p>POST queries to <code>/queries.json</code>; JSON status at
+<code>/</code> (Accept: application/json); <code>/reload</code> swaps in
+the latest trained instance.</p>
+</body></html>
+"""
+
+
 class _EngineRequestHandler(JSONRequestHandler):
     server_version = "PIOEngineServer/0.1"
 
     def do_GET(self):
         path = urlparse(self.path).path
         if path == "/":
-            self._send(200, self.server_ref.status())
+            status = self.server_ref.status()
+            # browsers get the operator landing page (ref:
+            # CreateServer.scala:433-459 + the twirl index template);
+            # programmatic clients keep the JSON contract
+            if "text/html" in (self.headers.get("Accept") or ""):
+                import html as _html
+
+                stats = status["stats"]
+                esc = lambda v: _html.escape(str(v))  # noqa: E731
+                html = _STATUS_HTML.format(
+                    engine_id=esc(status["engineId"]),
+                    engine_variant=esc(status["engineVariant"]),
+                    engine_instance_id=esc(status["engineInstanceId"]),
+                    engine_factory=esc(status["engineFactory"]),
+                    trained_at=esc(status["trainedAt"]),
+                    start_time=esc(stats["startTime"]),
+                    request_count=stats["requestCount"],
+                    avg_ms=stats["avgServingSec"] * 1e3,
+                    last_ms=stats["lastServingSec"] * 1e3,
+                    algorithms=esc(json.dumps(status["algorithms"], indent=2)),
+                )
+                self._send(200, html, content_type="text/html; charset=UTF-8")
+            else:
+                self._send(200, status)
         elif path == "/reload":
             try:
                 instance_id = self.server_ref.reload()
                 self._send(200, {"message": "reloaded", "engineInstanceId": instance_id})
             except RuntimeError as e:
+                self.server_ref.remote_log(f"reload failed: {e}")
                 self._send(404, {"message": str(e)})
         else:
             self._send(404, {"message": "Not Found"})
@@ -387,6 +466,9 @@ class _EngineRequestHandler(JSONRequestHandler):
                 return
             except Exception as e:
                 log.exception("query failed")
+                self.server_ref.remote_log(
+                    f"query failed: {type(e).__name__}: {e}"
+                )
                 self._send(500, {"message": str(e)})
                 return
             self._send(200, result)

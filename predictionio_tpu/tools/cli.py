@@ -235,6 +235,7 @@ def cmd_deploy(args) -> int:
         port=args.port,
         feedback_url=args.feedback_url,
         feedback_access_key=args.accesskey,
+        log_url=args.log_url,
     )
     _p(f"Engine {engine_id} deployed on {args.ip}:{server.port}")
     server.serve_forever()
@@ -420,10 +421,16 @@ def cmd_template(args) -> int:
         for name, module in sorted(BUILTIN_TEMPLATES.items()):
             _p(f"{name:28} {module}")
         return 0
-    # template get <name> <dir>: scaffold an engine.json pointing at the
-    # built-in template's factory (gallery download needs egress;
-    # ref behavior: Template.scala:226-415 materializes a working dir)
+    # template get <name> <dir>: materialize a WORKING engine project —
+    # the template module's full source copied in as user-editable code
+    # plus an engine.json whose factory resolves from the project dir
+    # (ref: Template.scala:226-415 downloads + package-renames a full
+    # source tree; here the source ships in the installed package, so
+    # "get" copies and rebinds it — egress-free)
+    import importlib
+    import inspect
     import os
+    import shutil
 
     name = args.name
     if name not in BUILTIN_TEMPLATES:
@@ -431,17 +438,41 @@ def cmd_template(args) -> int:
             f"Unknown template {name!r} (available: {sorted(BUILTIN_TEMPLATES)})"
         )
     os.makedirs(args.directory, exist_ok=True)
+    module = importlib.import_module(BUILTIN_TEMPLATES[name])
+    src = inspect.getsourcefile(module)
+    if src is None:
+        raise CommandError(f"cannot locate source for {BUILTIN_TEMPLATES[name]}")
+    mod_name = f"{name.replace('-', '_')}_engine"
+    engine_py = os.path.join(args.directory, f"{mod_name}.py")
+    shutil.copyfile(src, engine_py)
+
     engine_json = {
         "id": "default",
-        "description": f"{name} template",
-        "engineFactory": f"{BUILTIN_TEMPLATES[name]}.{TEMPLATE_FACTORIES[name]}",
+        "description": f"{name} template (scaffolded from "
+                       f"{BUILTIN_TEMPLATES[name]})",
+        "engineFactory": f"{mod_name}.{TEMPLATE_FACTORIES[name]}",
     }
     path = os.path.join(args.directory, "engine.json")
     with open(path, "w") as f:
         json.dump(engine_json, f, indent=2)
         f.write("\n")
-    _p(f"Created {path} — edit the params blocks, then "
-       f"`pio build|train|deploy --engine-json {path}`.")
+    readme = os.path.join(args.directory, "README.md")
+    with open(readme, "w") as f:
+        f.write(
+            f"# {name} engine\n\n"
+            f"Scaffolded from `{BUILTIN_TEMPLATES[name]}`.\n\n"
+            f"- `{mod_name}.py` — YOUR engine source (DataSource/"
+            "Preparator/Algorithm/Serving + factory). Edit freely; it\n"
+            "  is resolved from this directory, not the installed "
+            "package.\n"
+            "- `engine.json` — the variant: fill the per-component "
+            "`{\"name\": ..., \"params\": {...}}` blocks (e.g. the "
+            "datasource's `app_name`).\n\n"
+            "Run `pio build|train|deploy --engine-json engine.json`.\n"
+        )
+    _p(f"Created {args.directory}: {mod_name}.py (editable engine source), "
+       f"engine.json, README.md")
+    _p(f"Edit params, then `pio train --engine-json {path}`.")
     return 0
 
 
@@ -503,6 +534,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--feedback-url", default=None)
     p.add_argument("--accesskey", default=None)
+    p.add_argument("--log-url", default=None,
+                   help="POST serve errors to this URL "
+                        "(ref: CreateServer.scala:413-424)")
     p.set_defaults(func=cmd_deploy)
 
     p = sub.add_parser("undeploy", help="stop a deployed engine server")

@@ -465,3 +465,62 @@ def test_deploy_warmup_first_query_is_warm(memory_storage):
         )
     finally:
         server.stop()
+
+
+def test_engine_server_html_landing_page(engine_server):
+    """Browsers get the operator landing page at / (ref:
+    CreateServer.scala:433-459 + twirl index template); programmatic
+    clients keep the JSON status contract."""
+    server, engine, storage = engine_server
+    base = f"http://127.0.0.1:{server.port}"
+    req = urllib.request.Request(base + "/", headers={"Accept": "text/html"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.headers["Content-Type"].startswith("text/html")
+        html = resp.read().decode()
+    assert "<html>" in html and "const" in html
+    assert "Requests served" in html
+    # default Accept still returns JSON
+    status, body = http("GET", f"{base}/")
+    assert status == 200 and body["status"] == "alive"
+
+
+def test_log_url_error_forwarding(memory_storage):
+    """--log-url: serve errors POST to the remote log endpoint (ref:
+    CreateServer.scala:413-424); a failing query still answers 500."""
+    received = []
+
+    from predictionio_tpu.serving.http import HTTPServerBase, JSONRequestHandler
+
+    class _SinkHandler(JSONRequestHandler):
+        def do_POST(self):
+            received.append(json.loads(self._read_body()))
+            self._send(200, {"ok": True})
+
+    class _Sink(HTTPServerBase):
+        pass
+
+    sink = _Sink("127.0.0.1", 0, _SinkHandler).start()
+    engine, _ = train_const(memory_storage)
+    server = EngineServer(
+        engine, "const", host="127.0.0.1", port=0, storage=memory_storage,
+        log_url=f"http://127.0.0.1:{sink.port}/log", micro_batch=False,
+    ).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        # ConstAlgo.predict: model * query["mult"] — a string multiplies
+        # a float into TypeError deep in predict -> 400 bad-query path;
+        # use a payload that raises beyond (KeyError/TypeError/ValueError)
+        # via query() machinery: shut down the deployment's serving
+        server.deployment.serving = None  # force an AttributeError
+        status, body = http("POST", f"{base}/queries.json", {"mult": 2})
+        assert status == 500
+        deadline = time.perf_counter() + 5
+        while not received and time.perf_counter() < deadline:
+            time.sleep(0.05)
+        assert received, "no remote log POST arrived"
+        assert received[0]["level"] == "ERROR"
+        assert "query failed" in received[0]["message"]
+        assert received[0]["engineId"] == "const"
+    finally:
+        server.stop()
+        sink.stop()

@@ -248,3 +248,73 @@ class TestDashboard:
             assert http("GET", f"{base}/engine_instances/ghost/evaluator_results.txt")[0] == 404
         finally:
             server.stop()
+
+
+class TestTemplateScaffold:
+    def test_get_materializes_editable_source(self, memory_storage, tmp_path,
+                                              capsys):
+        """`pio template get` must produce a WORKING project whose source
+        the user can edit before training (ref: Template.scala:226-415
+        materializes a renamed source tree)."""
+        import sys
+
+        from predictionio_tpu.data.event import Event
+
+        app = memory_storage.apps().insert("scaffold")
+        memory_storage.events().init(app.id)
+        events = [
+            Event(event="buy", entity_type="user", entity_id=f"u{k % 6}",
+                  target_entity_type="item", target_entity_id=f"i{k % 4}")
+            for k in range(40)
+        ]
+        memory_storage.events().insert_batch(events, app.id)
+
+        tdir = tmp_path / "myreco"
+        assert cli_main(["template", "get", "recommendation", str(tdir)]) == 0
+        src_path = tdir / "recommendation_engine.py"
+        assert src_path.exists() and (tdir / "README.md").exists()
+
+        # the user EDITS the scaffolded source: different buy rating
+        src = src_path.read_text()
+        assert "buy_rating: float = 4.0" in src
+        src_path.write_text(
+            src.replace("buy_rating: float = 4.0", "buy_rating: float = 2.5")
+        )
+        # and fills the variant params
+        ej = tdir / "engine.json"
+        variant = json.load(open(ej))
+        assert variant["engineFactory"] == "recommendation_engine.recommendation_engine"
+        variant["datasource"] = {"params": {"app_name": "scaffold"}}
+        variant["algorithms"] = [
+            {"name": "als", "params": {"rank": 4, "num_iterations": 2,
+                                       "block_size": 8}}
+        ]
+        json.dump(variant, open(ej, "w"))
+
+        assert cli_main(["train", "--engine-json", str(ej)]) == 0
+        assert "COMPLETED" in capsys.readouterr().out
+        # the edited project-local module was loaded (path-keyed, never
+        # the installed package nor another project's same-named file)
+        mod = next(
+            m for k, m in sys.modules.items()
+            if k.startswith("_pio_project_")
+            and getattr(m, "__file__", None) == str(src_path)
+        )
+        assert mod.RecoDataSourceParams().buy_rating == 2.5
+        inst = memory_storage.engine_instances().get_all()[0]
+        assert inst.engine_factory.startswith("recommendation_engine.")
+
+        # a SECOND project with the same module name must not collide
+        tdir2 = tmp_path / "other"
+        assert cli_main(["template", "get", "recommendation", str(tdir2)]) == 0
+        from predictionio_tpu.workflow.variant import EngineVariant
+
+        v2 = EngineVariant.load(str(tdir2 / "engine.json"))
+        engine2 = v2.create_engine()
+        ds_cls = next(iter(engine2.data_source_classes.values()))
+        # unedited copy keeps the 4.0 default even though project 1's
+        # edited 2.5 version is already loaded in this process
+        assert ds_cls.__module__ != mod.__name__
+        import inspect as _inspect
+
+        assert _inspect.getmodule(ds_cls).RecoDataSourceParams().buy_rating == 4.0
