@@ -318,3 +318,134 @@ class TestTemplateScaffold:
         import inspect as _inspect
 
         assert _inspect.getmodule(ds_cls).RecoDataSourceParams().buy_rating == 4.0
+
+
+class TestColumnarImport:
+    """Parquet files with a pure interaction shape bulk-load through the
+    columnar path; anything richer falls back to the row path — both
+    must land identical events."""
+
+    def _write_ratings_parquet(self, path, n=50):
+        import numpy as np
+
+        from predictionio_tpu.tools.eventdata import _write_parquet
+
+        rng = np.random.default_rng(4)
+        dicts = [
+            {
+                "event": "rate" if k % 3 else "buy",
+                "entityType": "user",
+                "entityId": f"u{rng.integers(8)}",
+                "targetEntityType": "item",
+                "targetEntityId": f"i{rng.integers(5)}",
+                "properties": {"rating": float(k % 5) + 0.5} if k % 3 else None,
+                "eventTime": f"2026-01-01T00:{k % 60:02d}:00+00:00",
+            }
+            for k in range(n)
+        ]
+        for d in dicts:
+            if d["properties"] is None:
+                del d["properties"]
+        _write_parquet(path, dicts)
+        return dicts
+
+    def test_interaction_parquet_takes_columnar_path(self, memory_storage,
+                                                     tmp_path, monkeypatch):
+        from predictionio_tpu.tools import eventdata
+
+        app = memory_storage.apps().insert("colimp")
+        memory_storage.events().init(app.id)
+        path = str(tmp_path / "ratings.parquet")
+        dicts = self._write_ratings_parquet(path)
+
+        # prove the fast path ran (row path would call insert_batch with
+        # Event objects built from dicts)
+        spy = {"columnar": 0}
+        real = memory_storage.events().insert_columnar
+
+        def counting(*a, **kw):
+            spy["columnar"] += 1
+            return real(*a, **kw)
+
+        monkeypatch.setattr(memory_storage.events(), "insert_columnar", counting)
+        n = eventdata.import_events("colimp", path, storage=memory_storage)
+        assert n == len(dicts) and spy["columnar"] == 1
+
+        got = memory_storage.events().find(app.id)
+        assert len(got) == len(dicts)
+        want = {
+            (d["event"], d["entityId"], d["targetEntityId"],
+             d.get("properties", {}).get("rating"))
+            for d in dicts
+        }
+        have = {
+            (e.event, e.entity_id, e.target_entity_id,
+             e.properties.get_opt("rating"))
+            for e in got
+        }
+        assert have == want
+
+    def test_rich_properties_fall_back_to_row_path(self, memory_storage,
+                                                   tmp_path):
+        from predictionio_tpu.tools import eventdata
+        from predictionio_tpu.tools.eventdata import _write_parquet
+
+        app = memory_storage.apps().insert("rowimp")
+        memory_storage.events().init(app.id)
+        path = str(tmp_path / "rich.parquet")
+        _write_parquet(path, [
+            {
+                "event": "$set", "entityType": "item", "entityId": "i1",
+                "properties": {"categories": ["a", "b"], "price": 9.5},
+                "eventTime": "2026-01-01T00:00:00+00:00",
+            },
+            {
+                "event": "view", "entityType": "user", "entityId": "u1",
+                "targetEntityType": "item", "targetEntityId": "i1",
+                "eventTime": "2026-01-01T00:01:00+00:00",
+            },
+        ])
+        n = eventdata.import_events("rowimp", path, storage=memory_storage)
+        assert n == 2
+        got = memory_storage.events().find(app.id)
+        assert got[0].properties.get_opt("categories") == ["a", "b"]
+        assert got[1].event == "view"
+
+    def test_columnar_rejects_invalid_events_via_row_path(self, memory_storage,
+                                                          tmp_path):
+        """A shape-conforming file with INVALID events must not bulk-load:
+        the fast path declines and the row path raises with position."""
+        from predictionio_tpu.tools import eventdata
+        from predictionio_tpu.tools.eventdata import _write_parquet
+
+        commands.app_new("badimp", storage=memory_storage)
+        path = str(tmp_path / "bad.parquet")
+        _write_parquet(path, [
+            {   # reserved event WITH a target: validation must reject
+                "event": "$set", "entityType": "user", "entityId": "u1",
+                "targetEntityType": "item", "targetEntityId": "i1",
+                "eventTime": "2026-01-01T00:00:00+00:00",
+            },
+        ])
+        with pytest.raises(ValueError, match="bad.parquet:1"):
+            eventdata.import_events("badimp", path, storage=memory_storage)
+
+    def test_columnar_handles_mixed_no_target_rows(self, memory_storage,
+                                                   tmp_path):
+        from predictionio_tpu.tools import eventdata
+        from predictionio_tpu.tools.eventdata import _write_parquet
+
+        app = commands.app_new("miximp", storage=memory_storage).app
+        path = str(tmp_path / "mix.parquet")
+        _write_parquet(path, [
+            {"event": "view", "entityType": "user", "entityId": "u1",
+             "targetEntityType": "item", "targetEntityId": "i1",
+             "eventTime": "2026-01-01T00:00:00+00:00"},
+            {"event": "login", "entityType": "user", "entityId": "u2",
+             "eventTime": "2026-01-01T00:01:00+00:00"},
+        ])
+        assert eventdata.import_events("miximp", path, storage=memory_storage) == 2
+        got = {e.entity_id: e for e in memory_storage.events().find(app.id)}
+        assert got["u1"].target_entity_id == "i1"
+        assert got["u2"].target_entity_id is None
+        assert got["u2"].target_entity_type is None
