@@ -71,6 +71,7 @@ class _Transport:
         body: Optional[bytes] = None,
         method: str = "POST",
         content_type: str = "application/json",
+        timeout: Optional[float] = None,
     ):
         """(status, body bytes). A 404 is returned (not raised) ONLY when
         the server marks it as a data miss (``{"missing": true}``); a
@@ -78,7 +79,9 @@ class _Transport:
         can never masquerade as empty data."""
         req = self._request_obj(path, body, method, content_type)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(
+                req, timeout=timeout if timeout is not None else self.timeout
+            ) as resp:
                 return resp.status, resp.read()
         except urllib.error.HTTPError as e:
             if e.code == 404:
@@ -159,6 +162,43 @@ class RestEventStore(S.EventStore):
         return bool(self._call("delete", app_id, channel_id,
                                event_id=event_id)["found"])
 
+    _FIND_KEYS = frozenset(
+        {"start_time", "until_time", "entity_type", "entity_id",
+         "event_names", "target_entity_type", "target_entity_id",
+         "limit", "reversed"}
+    )
+
+    @classmethod
+    def _find_payload(cls, app_id, channel_id, find_kwargs) -> Dict[str, Any]:
+        unknown = set(find_kwargs) - cls._FIND_KEYS
+        if unknown:
+            # a typo'd filter must fail loudly, never scan unfiltered
+            # (the eventlog backend enforces the same invariant)
+            raise TypeError(
+                f"got unexpected filters {sorted(unknown)}"
+            )
+        payload: Dict[str, Any] = {
+            "app_id": int(app_id), "channel_id": channel_id,
+        }
+        for key in ("start_time", "until_time"):
+            v = find_kwargs.get(key)
+            payload[key] = v.isoformat() if v is not None else None
+        for key in ("entity_type", "entity_id", "limit"):
+            payload[key] = find_kwargs.get(key)
+        names = find_kwargs.get("event_names")
+        payload["event_names"] = list(names) if names is not None else None
+        payload["reversed"] = bool(find_kwargs.get("reversed", False))
+        # tri-state target filters (absent | null | value) via *_set flags
+        tt = find_kwargs.get("target_entity_type", S.UNSET)
+        if tt is not S.UNSET:
+            payload["target_entity_type_set"] = True
+            payload["target_entity_type"] = tt
+        ti = find_kwargs.get("target_entity_id", S.UNSET)
+        if ti is not S.UNSET:
+            payload["target_entity_id_set"] = True
+            payload["target_entity_id"] = ti
+        return payload
+
     def find(
         self,
         app_id,
@@ -173,28 +213,67 @@ class RestEventStore(S.EventStore):
         limit=None,
         reversed=False,
     ) -> List[Event]:
-        payload: Dict[str, Any] = {
-            "app_id": int(app_id),
-            "channel_id": channel_id,
-            "start_time": start_time.isoformat() if start_time else None,
-            "until_time": until_time.isoformat() if until_time else None,
-            "entity_type": entity_type,
-            "entity_id": entity_id,
-            "event_names": list(event_names) if event_names is not None else None,
-            "limit": limit,
-            "reversed": bool(reversed),
-        }
-        # tri-state target filters (absent | null | value) via *_set flags
-        if target_entity_type is not S.UNSET:
-            payload["target_entity_type_set"] = True
-            payload["target_entity_type"] = target_entity_type
-        if target_entity_id is not S.UNSET:
-            payload["target_entity_id_set"] = True
-            payload["target_entity_id"] = target_entity_id
+        payload = self._find_payload(app_id, channel_id, {
+            "start_time": start_time, "until_time": until_time,
+            "entity_type": entity_type, "entity_id": entity_id,
+            "event_names": event_names,
+            "target_entity_type": target_entity_type,
+            "target_entity_id": target_entity_id,
+            "limit": limit, "reversed": reversed,
+        })
         return [
             Event.from_dict(json.loads(line))
             for line in self._t.stream_lines("/storage/events/find", payload)
         ]
+
+    def find_columnar(
+        self,
+        app_id,
+        channel_id=None,
+        value_property=None,
+        time_ordered=True,
+        **find_kwargs,
+    ) -> S.EventColumns:
+        """Bulk training read over the wire as one binary npz of
+        dict-encoded columns — 20M rows without per-event JSON."""
+        payload = self._find_payload(app_id, channel_id, find_kwargs)
+        payload["value_property"] = value_property
+        payload["time_ordered"] = bool(time_ordered)
+        status, body = self._t.request(
+            "/storage/events/find_columnar", json.dumps(payload).encode(),
+            timeout=max(self._t.timeout, 600.0),  # bulk scans take minutes
+        )
+        return S.npz_to_columns(body)
+
+    def insert_columnar(
+        self,
+        cols: S.EventColumns,
+        app_id,
+        channel_id=None,
+        *,
+        entity_type: str,
+        target_entity_type=None,
+        value_property=None,
+    ) -> int:
+        """Bulk ingest over the wire: npz body, scalar params in the
+        query string (percent-encoded UTF-8 — header values would be
+        latin-1-only)."""
+        from urllib.parse import urlencode
+
+        params = {"app_id": int(app_id), "entity_type": entity_type}
+        if channel_id is not None:
+            params["channel_id"] = int(channel_id)
+        if target_entity_type is not None:
+            params["target_entity_type"] = target_entity_type
+        if value_property is not None:
+            params["value_property"] = value_property
+        status, body = self._t.request(
+            "/storage/events/insert_columnar?" + urlencode(params),
+            S.columns_to_npz(cols),
+            content_type="application/octet-stream",
+            timeout=max(self._t.timeout, 600.0),  # bulk ingest
+        )
+        return int(json.loads(body)["count"])
 
 
 class _RestRepo:

@@ -75,6 +75,71 @@ class EventColumns:
         return len(self.entity_codes)
 
 
+def columns_to_npz(cols: EventColumns) -> bytes:
+    """EventColumns -> one .npz blob — the wire format of the bulk
+    columnar storage routes. Vocabularies travel as concatenated UTF-8
+    bytes plus exact prefix offsets (separator-free, like the native
+    dictionaries), so ids containing ANY byte round-trip correctly."""
+    import io
+
+    import numpy as np
+
+    def vocab_arrays(vocab):
+        bs = [s.encode("utf-8") for s in vocab]
+        offsets = np.zeros(len(bs) + 1, np.uint64)
+        if bs:
+            np.cumsum(
+                np.fromiter((len(b) for b in bs), np.uint64, count=len(bs)),
+                out=offsets[1:],
+            )
+        return np.frombuffer(b"".join(bs), dtype=np.uint8), offsets
+
+    ent_b, ent_off = vocab_arrays(cols.entity_vocab)
+    tgt_b, tgt_off = vocab_arrays(cols.target_vocab)
+    nam_b, nam_off = vocab_arrays(cols.names)
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        entity_codes=cols.entity_codes,
+        target_codes=cols.target_codes,
+        name_codes=cols.name_codes,
+        values=cols.values,
+        times_us=cols.times_us,
+        entity_vocab=ent_b, entity_vocab_offsets=ent_off,
+        target_vocab=tgt_b, target_vocab_offsets=tgt_off,
+        names=nam_b, names_offsets=nam_off,
+    )
+    return buf.getvalue()
+
+
+def npz_to_columns(blob: bytes) -> EventColumns:
+    """Inverse of columns_to_npz."""
+    import io
+
+    import numpy as np
+
+    z = np.load(io.BytesIO(blob))
+
+    def vocab(key):
+        raw = z[key].tobytes()
+        off = z[key + "_offsets"]
+        return [
+            raw[int(off[i]):int(off[i + 1])].decode("utf-8")
+            for i in range(len(off) - 1)
+        ]
+
+    return EventColumns(
+        entity_codes=z["entity_codes"],
+        target_codes=z["target_codes"],
+        name_codes=z["name_codes"],
+        values=z["values"],
+        times_us=z["times_us"],
+        entity_vocab=vocab("entity_vocab"),
+        target_vocab=vocab("target_vocab"),
+        names=vocab("names"),
+    )
+
+
 # ---------------------------------------------------------------------------
 # Abstract DAOs
 # ---------------------------------------------------------------------------

@@ -51,7 +51,9 @@ from predictionio_tpu.data.storage import (
     UNSET,
     Storage,
     StorageError,
+    columns_to_npz,
     get_storage,
+    npz_to_columns,
 )
 from predictionio_tpu.serving.http import HTTPServerBase, JSONRequestHandler
 
@@ -129,7 +131,8 @@ _REPO_SPECS: Dict[str, Dict[str, Any]] = {
 }
 
 _EVENT_METHODS = frozenset(
-    {"init", "remove", "insert", "insert_batch", "get", "delete", "find"}
+    {"init", "remove", "insert", "insert_batch", "get", "delete", "find",
+     "find_columnar", "insert_columnar"}
 )
 
 
@@ -219,7 +222,9 @@ class StorageRequestHandler(JSONRequestHandler):
     def do_POST(self):
         if not self._authorized():
             return self._deny()
-        parts = self.path.strip("/").split("/")
+        from urllib.parse import urlparse
+
+        parts = urlparse(self.path).path.strip("/").split("/")
         if len(parts) == 3 and parts[0] == "storage" and parts[1] == "events":
             return self._guarded(self._handle_events, parts[2])
         if len(parts) == 4 and parts[0] == "storage" and parts[1] == "meta":
@@ -227,11 +232,48 @@ class StorageRequestHandler(JSONRequestHandler):
         return self._send(404, {"message": "not found"})
 
     # -- events -------------------------------------------------------------
+    @staticmethod
+    def _find_kwargs(body: Dict[str, Any]) -> Dict[str, Any]:
+        """find/find_columnar filter params from the JSON body."""
+        kwargs: Dict[str, Any] = {}
+        for key in ("start_time", "until_time"):
+            if body.get(key) is not None:
+                kwargs[key] = _dt.datetime.fromisoformat(body[key])
+        for key in ("entity_type", "entity_id"):
+            if body.get(key) is not None:
+                kwargs[key] = body[key]
+        if body.get("event_names") is not None:
+            kwargs["event_names"] = list(body["event_names"])
+        # target filters: tri-state (absent | null | value) via *_set flags
+        if body.get("target_entity_type_set"):
+            kwargs["target_entity_type"] = body.get("target_entity_type")
+        if body.get("target_entity_id_set"):
+            kwargs["target_entity_id"] = body.get("target_entity_id")
+        if body.get("limit") is not None:
+            kwargs["limit"] = int(body["limit"])
+        kwargs["reversed"] = bool(body.get("reversed", False))
+        return kwargs
+
     def _handle_events(self, method: str):
         if method not in _EVENT_METHODS:
             return self._send(404, {"message": f"unknown events method {method!r}"})
-        body = self._read_json()
         store = self.server_ref.storage.events()
+        if method == "insert_columnar":
+            # binary npz body; scalar params ride in the query string
+            # (percent-encoded UTF-8 — headers are latin-1-only)
+            from urllib.parse import parse_qs, urlparse
+
+            q = {k: v[0] for k, v in parse_qs(urlparse(self.path).query).items()}
+            n = store.insert_columnar(
+                npz_to_columns(self._read_body()),
+                int(q["app_id"]),
+                int(q["channel_id"]) if q.get("channel_id") else None,
+                entity_type=q["entity_type"],
+                target_entity_type=q.get("target_entity_type"),
+                value_property=q.get("value_property"),
+            )
+            return self._send(201, {"count": int(n)})
+        body = self._read_json()
         app_id = int(body["app_id"])
         channel_id = body.get("channel_id")
         if channel_id is not None:
@@ -260,27 +302,23 @@ class StorageRequestHandler(JSONRequestHandler):
         if method == "delete":
             found = store.delete(body["event_id"], app_id, channel_id)
             return self._send(200, {"found": bool(found)})
+        if method == "find_columnar":
+            # bulk training read: dict-encoded columns as one binary npz
+            # (the NDJSON find would pay per-event JSON for 20M rows)
+            cols = store.find_columnar(
+                app_id, channel_id=channel_id,
+                value_property=body.get("value_property"),
+                time_ordered=bool(body.get("time_ordered", True)),
+                **self._find_kwargs(body),
+            )
+            return self._send(200, columns_to_npz(cols),
+                              content_type="application/octet-stream")
 
         # find: NDJSON stream so 20M-event training reads never build one
         # giant JSON document on either side
-        kwargs: Dict[str, Any] = {}
-        for key in ("start_time", "until_time"):
-            if body.get(key) is not None:
-                kwargs[key] = _dt.datetime.fromisoformat(body[key])
-        for key in ("entity_type", "entity_id"):
-            if body.get(key) is not None:
-                kwargs[key] = body[key]
-        if body.get("event_names") is not None:
-            kwargs["event_names"] = list(body["event_names"])
-        # target filters: tri-state (absent | null | value) via *_set flags
-        if body.get("target_entity_type_set"):
-            kwargs["target_entity_type"] = body.get("target_entity_type")
-        if body.get("target_entity_id_set"):
-            kwargs["target_entity_id"] = body.get("target_entity_id")
-        if body.get("limit") is not None:
-            kwargs["limit"] = int(body["limit"])
-        kwargs["reversed"] = bool(body.get("reversed", False))
-        events = store.find(app_id, channel_id=channel_id, **kwargs)
+        events = store.find(
+            app_id, channel_id=channel_id, **self._find_kwargs(body)
+        )
         # genuinely chunked NDJSON: a 20M-event training read never
         # joins into one multi-GB buffer on the server side
         self.send_response(200)

@@ -343,3 +343,89 @@ def test_two_writers_share_one_logical_eventdata(rest_storage):
     # a delete through one host is immediately visible to the other
     assert client_b.events().delete(seen_a[0].event_id, 7)
     assert len(client_a.events().find(7)) == 5
+
+
+def test_columnar_bulk_roundtrip_over_rest(rest_storage):
+    """Bulk training reads/ingest travel as binary npz — 20M-row scale
+    without per-event JSON (the region-scan role of HBPEvents.scala:48,
+    over the wire)."""
+    import numpy as np
+
+    from predictionio_tpu.data.storage import EventColumns
+
+    _, client = rest_storage
+    client.events().init(3)
+    cols = EventColumns(
+        entity_codes=np.array([0, 1, 0], np.int32),
+        target_codes=np.array([0, 1, -1], np.int32),
+        name_codes=np.array([0, 0, 1], np.int32),
+        values=np.array([4.5, np.nan, np.nan], np.float64),
+        times_us=np.array([1_000_000, 2_000_000, 3_000_000], np.int64),
+        entity_vocab=["anna", "bo"],
+        target_vocab=["x1", "x2"],
+        names=["rate", "$set"],
+    )
+    n = client.events().insert_columnar(
+        cols, 3, entity_type="user", target_entity_type="item",
+        value_property="rating",
+    )
+    assert n == 3
+
+    back = client.events().find_columnar(
+        3, value_property="rating", time_ordered=False
+    )
+    assert len(back) == 3
+    resolved = {
+        (back.entity_vocab[back.entity_codes[i]],
+         back.target_vocab[back.target_codes[i]] if back.target_codes[i] >= 0 else None,
+         back.names[back.name_codes[i]])
+        for i in range(3)
+    }
+    assert resolved == {("anna", "x1", "rate"), ("bo", "x2", "rate"),
+                        ("anna", None, "$set")}
+    vals = sorted(back.values[~np.isnan(back.values)])
+    assert vals == [4.5]
+    # filters apply server-side on the bulk route too
+    only_rate = client.events().find_columnar(3, event_names=["rate"])
+    assert len(only_rate) == 2
+    # and the row-level API sees the bulk-ingested events
+    events = client.events().find(3)
+    assert {e.entity_id for e in events} == {"anna", "bo"}
+
+
+def test_columnar_rest_edge_cases(rest_storage):
+    """Unicode entity types (query-string params), NUL bytes inside ids
+    (exact-offset vocab wire format), and loud typo'd filters."""
+    import numpy as np
+
+    from predictionio_tpu.data.storage import EventColumns
+
+    _, client = rest_storage
+    client.events().init(9)
+    cols = EventColumns(
+        entity_codes=np.array([0, 1], np.int32),
+        target_codes=np.array([0, 0], np.int32),
+        name_codes=np.array([0, 0], np.int32),
+        values=np.array([1.0, 2.0], np.float64),
+        times_us=np.array([1, 2], np.int64),
+        entity_vocab=["አበበ", "a\0b"],     # unicode + embedded NUL
+        target_vocab=["商品-1"],
+        names=["rate"],
+    )
+    n = client.events().insert_columnar(
+        cols, 9, entity_type="ユーザー", target_entity_type="商品",
+        value_property="rating",
+    )
+    assert n == 2
+    back = client.events().find_columnar(9, value_property="rating",
+                                         time_ordered=False)
+    assert sorted(back.entity_vocab[c] for c in back.entity_codes) == \
+        sorted(["አበበ", "a\0b"])
+    assert back.target_vocab[back.target_codes[0]] == "商品-1"
+    rows = client.events().find(9)
+    assert {e.entity_type for e in rows} == {"ユーザー"}
+
+    with pytest.raises(TypeError, match="unexpected filters"):
+        client.events().find_columnar(9, event_name=["rate"])  # typo
+    with pytest.raises(TypeError):   # find()'s fixed signature rejects
+        client.events().find(9, entity_types="user")
