@@ -325,3 +325,38 @@ def test_topk_shape_bucketing():
     long_excl = np.arange(12, dtype=np.int32)
     _, idx = scorer.score(u, 5, exclude_idx=long_excl)
     assert not set(idx[0].tolist()) & set(range(4, 12))
+
+
+def test_grid_train_vmapped_matches_sequential():
+    """als_grid_train: all reg grid points in ONE vmapped program
+    (SURVEY.md §7.6 — grid points vmapped, a capability Spark's
+    sequential batchEval never had)."""
+    from predictionio_tpu.ops.als import als_grid_train, predict_rmse
+
+    rng = np.random.default_rng(9)
+    nnz, n_users, n_items = 600, 40, 16
+    coo = (rng.integers(0, n_users, nnz), rng.integers(0, n_items, nnz),
+           (rng.random(nnz) * 4 + 1).astype(np.float32))
+    cfg = ALSConfig(rank=8, iterations=4, block_size=16, seg_len=8,
+                    compute_dtype="float32", cg_dtype="float32")
+
+    out = als_grid_train(coo, n_users, n_items, cfg,
+                         regs=[0.05, 0.05, 1.0, 10.0])
+    assert len(out) == 4
+    # identical regs (+ shared init) -> identical factors
+    np.testing.assert_array_equal(out[0].user_factors, out[1].user_factors)
+    # stronger regularization -> smaller factors, worse train fit
+    n0 = np.linalg.norm(out[0].user_factors)
+    n3 = np.linalg.norm(out[3].user_factors)
+    assert n3 < n0
+    assert predict_rmse(out[0], coo) < predict_rmse(out[3], coo)
+    # each grid point trains as well as a dedicated sequential run
+    for reg, factors in zip((0.05, 1.0), (out[0], out[2])):
+        solo = als_train(coo, n_users, n_items,
+                         ALSConfig(rank=8, iterations=4, reg=reg,
+                                   block_size=16, seg_len=8,
+                                   compute_dtype="float32",
+                                   cg_dtype="float32"))
+        grid_rmse = predict_rmse(factors, coo)
+        solo_rmse = predict_rmse(solo, coo)
+        assert abs(grid_rmse - solo_rmse) < 0.05, (reg, grid_rmse, solo_rmse)
