@@ -11,9 +11,11 @@ COMPLETED — or FAILED on error.
 
 from __future__ import annotations
 
+import contextlib
 import datetime as _dt
 import json
 import logging
+import os
 import pickle
 import uuid
 from typing import Any, List, Optional
@@ -56,6 +58,37 @@ def serialize_models(
             pm = manifest_for(pm)
         persisted.append(pm)
     return pickle.dumps(persisted)
+
+
+@contextlib.contextmanager
+def _maybe_profile(instance_id: str):
+    """First-party training profiler (beyond the reference, whose only
+    training observability is the Spark UI — SURVEY.md §5.1): set
+    ``PIO_PROFILE_DIR`` to capture a JAX/XLA device trace of the whole
+    train into ``<dir>/<instance_id>`` (open with TensorBoard or
+    xprof). Profiling failures never fail training."""
+    profile_dir = os.environ.get("PIO_PROFILE_DIR")
+    if not profile_dir:
+        yield
+        return
+    out = os.path.join(profile_dir, instance_id)
+    try:
+        import jax
+
+        tracer = jax.profiler.trace(out)
+        tracer.__enter__()
+        log.info("profiling train to %s", out)
+    except Exception:  # noqa: BLE001 — observability must not break train
+        log.exception("profiler failed to start; continuing without trace")
+        yield
+        return
+    try:
+        yield
+    finally:
+        try:
+            tracer.__exit__(None, None, None)
+        except Exception:  # noqa: BLE001
+            log.exception("profiler failed to stop")
 
 
 def run_train(
@@ -102,7 +135,8 @@ def run_train(
     try:
         instance.status = "TRAINING"
         storage.engine_instances().update(instance)
-        result: TrainResult = engine.train(ctx, engine_params, wp)
+        with _maybe_profile(instance.id):
+            result: TrainResult = engine.train(ctx, engine_params, wp)
         if result.stopped_after:
             # debug interruption (ref: Engine.scala:624-648): no model persisted
             instance.status = "COMPLETED"

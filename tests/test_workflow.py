@@ -159,3 +159,20 @@ def test_engine_variant_loading(tmp_path):
 def sample_factory():
     """Engine factory resolved by dotted path (ref: WorkflowUtils.getEngine:60)."""
     return make_engine()
+
+
+def test_profile_dir_captures_trace(memory_storage, tmp_path, monkeypatch):
+    """PIO_PROFILE_DIR captures a JAX device trace per training instance
+    (first-party training observability — the reference only has the
+    Spark UI, SURVEY.md §5.1)."""
+    monkeypatch.setenv("PIO_PROFILE_DIR", str(tmp_path / "prof"))
+    engine = make_engine()
+    instance = run_train(
+        engine, make_params(), engine_id="prof", storage=memory_storage
+    )
+    assert instance.status == "COMPLETED"
+    trace_root = tmp_path / "prof" / instance.id
+    assert trace_root.is_dir()
+    # the profiler wrote something (plugins/profile/<ts>/*)
+    files = [p for p in trace_root.rglob("*") if p.is_file()]
+    assert files, "no trace files captured"
