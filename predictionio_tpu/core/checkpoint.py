@@ -1,0 +1,147 @@
+"""Mid-training checkpoint/resume for iterative trainers.
+
+Beyond the reference, which persists models ONLY after training
+completes (SURVEY.md §5.4: "model-level only, no mid-training
+checkpoints" — a failed Spark job just fails the instance): the neural
+trainers (two-tower, sessionrec) can write an atomic checkpoint every
+``every`` epochs and resume exactly — optimizer state, epoch counter
+and RNG streams included, so an interrupted-and-resumed run produces
+the SAME parameters as an uninterrupted one.
+
+Safety properties owned here (NOT by the trainers):
+  - a ``fingerprint`` of (config, data dims, data sample) travels with
+    every checkpoint; restore ignores checkpoints whose fingerprint
+    differs, so a later run on NEW data or a changed config starts
+    fresh instead of silently adopting stale parameters or wrong-shape
+    embedding tables. (A rerun with an IDENTICAL fingerprint resuming
+    to completion is correct by construction: deterministic seeds mean
+    the checkpointed parameters ARE that run's result.)
+  - multi-host: only process 0 writes (no torn concurrent writes to a
+    shared filesystem); cross-process-sharded arrays are allgathered
+    to host before pickling.
+  - atomicity: write to ``.tmp`` then ``os.replace``; a crash mid-write
+    never corrupts the latest good checkpoint; a torn newest file falls
+    back to the previous one. The two most recent checkpoints are kept.
+
+Format: one pickle per checkpoint (pytrees with numpy leaves — device
+arrays are materialized on save and re-placed by the trainer on
+restore).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import pickle
+import re
+from typing import Any, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+_CKPT_RE = re.compile(r"^ckpt_(\d+)\.pkl$")
+
+
+def train_fingerprint(*parts: Any) -> str:
+    """Stable digest of a training run's identity: pass the config
+    dataclass, dimension ints, and cheap data samples (numpy arrays are
+    hashed by content)."""
+    import numpy as np
+
+    h = hashlib.md5()
+    for part in parts:
+        if isinstance(part, np.ndarray):
+            h.update(str(part.dtype).encode())
+            h.update(str(part.shape).encode())
+            h.update(np.ascontiguousarray(part).tobytes())
+        else:
+            h.update(repr(part).encode())
+        h.update(b"|")
+    return h.hexdigest()
+
+
+def _to_host(x: Any) -> Any:
+    """Device array -> numpy, allgathering cross-process shards (see
+    parallel.multihost.to_host); non-arrays pass through."""
+    import jax
+
+    if not isinstance(x, jax.Array):
+        return x
+    from predictionio_tpu.parallel.multihost import to_host
+
+    return to_host(x)
+
+
+class TrainCheckpointer:
+    """Epoch-granular checkpoint writer/reader over one directory."""
+
+    def __init__(self, directory: str, every: int = 1, keep: int = 2,
+                 fingerprint: Optional[str] = None):
+        self.directory = directory
+        self.every = max(1, int(every))
+        self.keep = max(1, int(keep))
+        self.fingerprint = fingerprint
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, epoch: int) -> str:
+        return os.path.join(self.directory, f"ckpt_{epoch}.pkl")
+
+    def _epochs_on_disk(self):
+        out = []
+        for name in os.listdir(self.directory):
+            m = _CKPT_RE.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def maybe_save(self, epoch: int, state: Any) -> bool:
+        """Save after ``epoch`` completed epochs when due; returns
+        whether a checkpoint was written. ``state`` is any picklable
+        pytree — device arrays are pulled to host first. Multi-host:
+        process 0 is the single writer."""
+        if epoch % self.every:
+            return False
+        import jax
+
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return False
+        host_state = jax.tree_util.tree_map(_to_host, state)
+        path = self._path(epoch)
+        with open(path + ".tmp", "wb") as f:
+            pickle.dump(
+                {"epoch": epoch, "state": host_state,
+                 "fingerprint": self.fingerprint},
+                f,
+            )
+        os.replace(path + ".tmp", path)
+        for old in self._epochs_on_disk()[: -self.keep]:
+            try:
+                os.remove(self._path(old))
+            except FileNotFoundError:
+                pass
+        log.info("checkpoint written: %s", path)
+        return True
+
+    def restore(self) -> Optional[Tuple[int, Any]]:
+        """(completed_epochs, state) from the newest readable checkpoint
+        whose fingerprint matches this run, or None. A torn newest file
+        falls back to the previous one; a fingerprint mismatch (other
+        data/config trained into this directory) is skipped with a
+        warning."""
+        for epoch in reversed(self._epochs_on_disk()):
+            try:
+                with open(self._path(epoch), "rb") as f:
+                    doc = pickle.load(f)
+            except Exception:  # noqa: BLE001 — fall back to older
+                log.warning("unreadable checkpoint %s; trying older",
+                            self._path(epoch))
+                continue
+            if doc.get("fingerprint") != self.fingerprint:
+                log.warning(
+                    "checkpoint %s belongs to a different run "
+                    "(config/data changed) — starting fresh",
+                    self._path(epoch),
+                )
+                return None
+            return int(doc["epoch"]), doc["state"]
+        return None

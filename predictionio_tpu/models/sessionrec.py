@@ -69,6 +69,8 @@ class SessionRecParams(Params):
     seed: int = 13
     attn_block: int = 0              # >0: flash-style blockwise attention
     seq_axis: Optional[str] = None   # mesh axis for ring attention (SP)
+    checkpoint_dir: Optional[str] = None   # mid-training checkpoint/resume
+    checkpoint_every: int = 1
 
 
 class SessionRecModel:
@@ -140,6 +142,8 @@ class SessionRecAlgorithm(Algorithm):
             learning_rate=p.learning_rate, weight_decay=p.weight_decay,
             epochs=p.epochs, batch_size=p.batch_size, seed=p.seed,
             attn_block=p.attn_block, seq_axis=p.seq_axis,
+            checkpoint_dir=p.checkpoint_dir,
+            checkpoint_every=p.checkpoint_every,
         )
         # ring attention needs a mesh even when the caller didn't build
         # one (same contract as ALSAlgorithm: require on demand)

@@ -42,6 +42,8 @@ class TwoTowerParams(Params):
     min_rating: float = 0.0       # keep events with rating >= this as positives
     weight_by_rating: bool = False
     shard_embeddings: bool = False
+    checkpoint_dir: Optional[str] = None   # mid-training checkpoint/resume
+    checkpoint_every: int = 1
 
 
 class TwoTowerModel(ALSModel):
@@ -75,6 +77,8 @@ class TwoTowerAlgorithm(Algorithm):
             batch_size=p.batch_size,
             seed=p.seed,
             shard_embeddings=p.shard_embeddings,
+            checkpoint_dir=p.checkpoint_dir,
+            checkpoint_every=p.checkpoint_every,
         )
         trainer = TwoTowerTrainer(
             (u, i, r if p.weight_by_rating else None),

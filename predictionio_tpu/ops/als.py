@@ -267,15 +267,12 @@ def _init_factors(key, n_groups: int, n_real: int, rank: int,
 
 
 def _materialize(x: jax.Array) -> np.ndarray:
-    """Device array -> host numpy, correct under multi-host: an array
-    sharded across processes spans non-addressable devices, so it must
-    be allgathered (every host gets the full factors, as every Spark
-    executor's ALS blocks collect to the driver in the reference)."""
-    if getattr(x, "is_fully_addressable", True):
-        return np.asarray(x)
-    from jax.experimental import multihost_utils
+    """Device array -> host numpy, multi-host-safe (every host gets the
+    full factors, as every Spark executor's ALS blocks collect to the
+    driver in the reference)."""
+    from predictionio_tpu.parallel.multihost import to_host
 
-    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return to_host(x)
 
 
 @dataclasses.dataclass

@@ -56,6 +56,8 @@ class SessionRecConfig:
     seed: int = 13
     attn_block: int = 0            # >0: blockwise attention block size
     seq_axis: Optional[str] = None  # mesh axis for ring attention (SP)
+    checkpoint_dir: Optional[str] = None  # mid-training checkpoint/resume
+    checkpoint_every: int = 1             # epochs between checkpoints
 
 
 class _Block(nn.Module):
@@ -208,6 +210,39 @@ class SessionRecTrainer:
             self._batch_sharding = None
         self._step = jax.jit(self._make_step(), donate_argnums=(0, 1))
         self._shuffle = np.random.default_rng(cfg.seed)
+        self._rng = jax.random.PRNGKey(cfg.seed + 1)
+        self._epochs_done = 0
+        self._losses: List[float] = []
+
+        # mid-training checkpoint/resume (core.checkpoint — beyond the
+        # reference's train-to-completion-or-nothing, SURVEY.md §5.4)
+        self._ckpt = None
+        if cfg.checkpoint_dir:
+            from predictionio_tpu.core.checkpoint import (
+                TrainCheckpointer,
+                train_fingerprint,
+            )
+
+            fp = train_fingerprint(
+                cfg, n_users, n_items, self.inputs.shape,
+                self.inputs[:512], self.inputs[-512:],
+            )
+            self._ckpt = TrainCheckpointer(cfg.checkpoint_dir,
+                                           every=cfg.checkpoint_every,
+                                           fingerprint=fp)
+            restored = self._ckpt.restore()
+            if restored is not None:
+                epoch, state = restored
+                params, opt_state = state["params"], state["opt_state"]
+                if mesh is not None:
+                    rep = NamedSharding(mesh, P())
+                    params = jax.device_put(params, rep)
+                    opt_state = jax.device_put(opt_state, rep)
+                self._params, self._opt_state = params, opt_state
+                self._shuffle.bit_generator.state = state["shuffle_state"]
+                self._rng = jnp.asarray(state["rng_key"])
+                self._epochs_done = epoch
+                self._losses = list(state["losses"])
 
     def _make_step(self):
         apply, tx, n_items = self.encoder.apply, self._tx, self.n_items
@@ -231,9 +266,11 @@ class SessionRecTrainer:
         return step
 
     def run(self, epochs: Optional[int] = None) -> List[float]:
-        losses = []
-        rng = jax.random.PRNGKey(self.cfg.seed + 1)
-        for _ in range(epochs if epochs is not None else self.cfg.epochs):
+        """Train up to ``epochs`` TOTAL epochs (resume-aware: epochs
+        already completed by a restored checkpoint are not repeated)."""
+        target = epochs if epochs is not None else self.cfg.epochs
+        rng = self._rng
+        while self._epochs_done < target:
             order = self._shuffle.permutation(self._train_rows)
             total, batches = 0.0, 0
             for s in range(0, len(order), self.batch):
@@ -253,8 +290,18 @@ class SessionRecTrainer:
                 )
                 total += float(loss)
                 batches += 1
-            losses.append(total / max(batches, 1))
-        return losses
+            self._losses.append(total / max(batches, 1))
+            self._epochs_done += 1
+            self._rng = rng
+            if self._ckpt is not None:
+                self._ckpt.maybe_save(self._epochs_done, {
+                    "params": self._params,
+                    "opt_state": self._opt_state,
+                    "shuffle_state": self._shuffle.bit_generator.state,
+                    "rng_key": self._rng,
+                    "losses": list(self._losses),
+                })
+        return list(self._losses)
 
     def state(self, losses: Optional[List[float]] = None) -> SessionRecModelState:
         # serve-time input: the last max_len REAL items (drop the held

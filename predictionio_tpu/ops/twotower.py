@@ -48,6 +48,8 @@ class TwoTowerConfig:
     batch_size: int = 1024
     seed: int = 11
     shard_embeddings: bool = False     # row-shard tables over the "model" axis
+    checkpoint_dir: Optional[str] = None  # mid-training checkpoint/resume
+    checkpoint_every: int = 1             # epochs between checkpoints
 
 
 class Tower(nn.Module):
@@ -141,6 +143,41 @@ class TwoTowerTrainer:
         self._params, self._opt_state = params, opt_state
         self._step = jax.jit(self._make_step(), donate_argnums=(0, 1))
         self._epoch_rng = np.random.default_rng(cfg.seed)
+        self._epochs_done = 0
+        self._losses: List[float] = []
+
+        # mid-training checkpoint/resume (core.checkpoint — beyond the
+        # reference's train-to-completion-or-nothing, SURVEY.md §5.4)
+        self._ckpt = None
+        if cfg.checkpoint_dir:
+            from predictionio_tpu.core.checkpoint import (
+                TrainCheckpointer,
+                train_fingerprint,
+            )
+
+            fp = train_fingerprint(
+                cfg, n_users, n_items, len(self._u),
+                self._u[:4096], self._u[-4096:],
+                self._i[:4096], self._w[:4096],
+            )
+            self._ckpt = TrainCheckpointer(cfg.checkpoint_dir,
+                                           every=cfg.checkpoint_every,
+                                           fingerprint=fp)
+            restored = self._ckpt.restore()
+            if restored is not None:
+                epoch, state = restored
+                params, opt_state = state["params"], state["opt_state"]
+                if mesh is not None:
+                    params = jax.device_put(
+                        params,
+                        _param_shardings(params, mesh, cfg.shard_embeddings))
+                    opt_state = jax.device_put(
+                        opt_state,
+                        _param_shardings(opt_state, mesh, cfg.shard_embeddings))
+                self._params, self._opt_state = params, opt_state
+                self._epoch_rng.bit_generator.state = state["rng_state"]
+                self._epochs_done = epoch
+                self._losses = list(state["losses"])
 
     def _make_step(self):
         temp = self.cfg.temperature
@@ -190,8 +227,10 @@ class TwoTowerTrainer:
             yield u, i, w
 
     def run(self, epochs: Optional[int] = None) -> List[float]:
-        losses = []
-        for _ in range(epochs if epochs is not None else self.cfg.epochs):
+        """Train up to ``epochs`` TOTAL epochs (resume-aware: epochs
+        already completed by a restored checkpoint are not repeated)."""
+        target = epochs if epochs is not None else self.cfg.epochs
+        while self._epochs_done < target:
             total, batches = 0.0, 0
             for u, i, w in self._batches():
                 args = (jnp.asarray(u), jnp.asarray(i), jnp.asarray(w))
@@ -202,8 +241,16 @@ class TwoTowerTrainer:
                 )
                 total += float(loss)
                 batches += 1
-            losses.append(total / max(batches, 1))
-        return losses
+            self._losses.append(total / max(batches, 1))
+            self._epochs_done += 1
+            if self._ckpt is not None:
+                self._ckpt.maybe_save(self._epochs_done, {
+                    "params": self._params,
+                    "opt_state": self._opt_state,
+                    "rng_state": self._epoch_rng.bit_generator.state,
+                    "losses": list(self._losses),
+                })
+        return list(self._losses)
 
     def _all_vecs(self, tower: Tower, side: str, n: int) -> np.ndarray:
         apply = jax.jit(tower.apply)

@@ -130,6 +130,17 @@ def global_array(
     return jax.make_array_from_process_local_data(sharding, local)
 
 
+def to_host(x: "jax.Array") -> np.ndarray:
+    """Device array -> host numpy, correct under multi-host: an array
+    sharded across processes spans non-addressable devices and must be
+    allgathered first (every host receives the full value)."""
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
 def all_hosts_sum(x: np.ndarray, mesh: Mesh) -> np.ndarray:
     """Sum a small host-local array across hosts (metadata reconciliation,
     e.g. per-host event counts). Rides the mesh collectives so it works

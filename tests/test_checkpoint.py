@@ -1,0 +1,121 @@
+"""Mid-training checkpoint/resume (core.checkpoint) — beyond the
+reference's train-to-completion-or-nothing (SURVEY.md §5.4): an
+interrupted-and-resumed run must produce the SAME parameters as an
+uninterrupted one (optimizer state, epoch counter and RNG streams all
+persist)."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.core.checkpoint import TrainCheckpointer
+
+
+def test_checkpointer_atomicity_and_retention(tmp_path):
+    ck = TrainCheckpointer(str(tmp_path), every=2, keep=2)
+    assert ck.restore() is None
+    assert ck.maybe_save(1, {"a": 1}) is False      # not due
+    assert ck.maybe_save(2, {"a": 2}) is True
+    assert ck.maybe_save(4, {"a": 4}) is True
+    assert ck.maybe_save(6, {"a": 6}) is True       # evicts epoch 2
+    names = sorted(p.name for p in tmp_path.iterdir())
+    assert names == ["ckpt_4.pkl", "ckpt_6.pkl"]
+    assert ck.restore() == (6, {"a": 6})
+    # a torn newest checkpoint falls back to the previous good one
+    (tmp_path / "ckpt_6.pkl").write_bytes(b"torn")
+    assert ck.restore() == (4, {"a": 4})
+
+
+def _toy_data(n=400, n_users=30, n_items=12, seed=2):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, n_users, n), rng.integers(0, n_items, n),
+            np.arange(n, dtype=np.float64))
+
+
+def test_twotower_resume_matches_uninterrupted(tmp_path):
+    from predictionio_tpu.ops.twotower import TwoTowerConfig, TwoTowerTrainer
+
+    u, i, _ = _toy_data()
+    kw = dict(dim=8, epochs=4, batch_size=64, seed=5)
+
+    straight = TwoTowerTrainer((u, i, None), 30, 12, TwoTowerConfig(**kw))
+    losses_straight = straight.run()
+
+    ckdir = str(tmp_path / "tt")
+    cfg = TwoTowerConfig(**kw, checkpoint_dir=ckdir, checkpoint_every=1)
+    first = TwoTowerTrainer((u, i, None), 30, 12, cfg)
+    first.run(epochs=2)                      # "crash" after 2 epochs
+
+    resumed = TwoTowerTrainer((u, i, None), 30, 12, cfg)  # fresh process stand-in
+    assert resumed._epochs_done == 2
+    losses_resumed = resumed.run()           # finishes epochs 3..4
+
+    assert np.allclose(losses_resumed, losses_straight, atol=1e-5)
+    for a, b in zip(
+        np.asarray(resumed.embeddings().item_vecs),
+        np.asarray(straight.embeddings().item_vecs),
+    ):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_sessionrec_resume_matches_uninterrupted(tmp_path):
+    from predictionio_tpu.ops.sessionrec import (
+        SessionRecConfig,
+        SessionRecTrainer,
+    )
+
+    u, i, t = _toy_data()
+    kw = dict(dim=8, heads=2, layers=1, max_len=8, dropout=0.1,
+              epochs=3, batch_size=32, seed=7)
+
+    straight = SessionRecTrainer((u, i, t), 30, 12, SessionRecConfig(**kw))
+    losses_straight = straight.run()
+
+    ckdir = str(tmp_path / "sr")
+    cfg = SessionRecConfig(**kw, checkpoint_dir=ckdir, checkpoint_every=1)
+    first = SessionRecTrainer((u, i, t), 30, 12, cfg)
+    first.run(epochs=1)
+
+    resumed = SessionRecTrainer((u, i, t), 30, 12, cfg)
+    assert resumed._epochs_done == 1
+    losses_resumed = resumed.run()
+
+    assert np.allclose(losses_resumed, losses_straight, atol=1e-5)
+    import jax
+
+    sa = straight.state(losses_straight)
+    sb = resumed.state(losses_resumed)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        sa.params, sb.params,
+    )
+
+
+def test_fingerprint_guards_stale_and_wrong_shape(tmp_path):
+    """A checkpoint from different data/config is IGNORED — no silent
+    stale-model no-op, no wrong-shape embedding adoption."""
+    from predictionio_tpu.ops.twotower import TwoTowerConfig, TwoTowerTrainer
+
+    u, i, _ = _toy_data()
+    ckdir = str(tmp_path / "fp")
+    cfg = TwoTowerConfig(dim=8, epochs=2, batch_size=64, seed=5,
+                         checkpoint_dir=ckdir)
+    t1 = TwoTowerTrainer((u, i, None), 30, 12, cfg)
+    t1.run()
+    assert t1._epochs_done == 2
+
+    # same data + config: resume-to-completion is the correct result
+    t_same = TwoTowerTrainer((u, i, None), 30, 12, cfg)
+    assert t_same._epochs_done == 2
+
+    # new data (the week-later retrain): fingerprint mismatch -> fresh
+    u2, i2, _ = _toy_data(seed=99)
+    t_new = TwoTowerTrainer((u2, i2, None), 30, 12, cfg)
+    assert t_new._epochs_done == 0
+    # grown catalog: never adopts the 12-item embedding table
+    t_grown = TwoTowerTrainer((u, i, None), 30, 20, cfg)
+    assert t_grown._epochs_done == 0
+    assert t_grown.run()  # trains cleanly from scratch
