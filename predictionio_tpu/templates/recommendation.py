@@ -102,17 +102,15 @@ class RecoDataSource(DataSource):
         return out
 
     def _read_columnar(self) -> RatingColumns:
-        """Bulk path: one dict-encoded scan, ratings resolved vectorized
-        (rate -> its rating property, buy -> the constant buy_rating)."""
+        """Bulk path: one dict-encoded scan (templates/_columnar.py),
+        ratings resolved vectorized (rate -> its rating property,
+        buy -> the constant buy_rating)."""
+        from predictionio_tpu.templates._columnar import read_interactions
+
         p: RecoDataSourceParams = self.params
-        cols = store.find_columnar(
-            p.app_name,
-            channel_name=p.channel_name,
-            value_property="rating",
-            time_ordered=False,   # ALS is order-blind; skip the sort
-            entity_type="user",
-            event_names=[p.rate_event, p.buy_event],
-            target_entity_type="item",
+        cols = read_interactions(
+            p.app_name, p.channel_name, "user",
+            [p.rate_event, p.buy_event], "item", value_property="rating",
         )
         ratings = np.nan_to_num(cols.values, nan=0.0).astype(np.float32)
         if p.buy_event in cols.names:
@@ -120,13 +118,12 @@ class RecoDataSource(DataSource):
             ratings = np.where(
                 cols.name_codes == buy_code, np.float32(p.buy_rating), ratings
             )
-        keep = cols.target_codes >= 0  # drop events with no target id
         return RatingColumns(
             user_vocab=cols.entity_vocab,
             item_vocab=cols.target_vocab,
-            user_idx=cols.entity_codes[keep],
-            item_idx=cols.target_codes[keep],
-            ratings=ratings[keep],
+            user_idx=cols.entity_idx,
+            item_idx=cols.target_idx,
+            ratings=ratings,
         )
 
     def read_training(self, ctx: MeshContext) -> RatingsTD:

@@ -39,11 +39,24 @@ class SeqEvent:
 
 
 @dataclass
+class SequenceColumns:
+    """Columnar interactions: vocab lists + dense code/time arrays (the
+    dict-encoded bulk-read product of store.find_columnar)."""
+
+    user_vocab: List[str]
+    item_vocab: List[str]
+    user_idx: np.ndarray    # int into user_vocab, [n]
+    item_idx: np.ndarray    # int into item_vocab, [n]
+    times: np.ndarray       # float64 epoch seconds, [n]
+
+
+@dataclass
 class SequencesTD(SanityCheck):
     events: List[SeqEvent] = field(default_factory=list)
+    columns: Optional[SequenceColumns] = None
 
     def sanity_check(self) -> None:
-        if not self.events:
+        if not self.events and (self.columns is None or not len(self.columns.times)):
             raise ValueError("SequencesTD is empty — no interaction events found")
 
 
@@ -54,6 +67,8 @@ class SeqDataSourceParams(Params):
     event_names: Tuple[str, ...] = ("view", "buy", "rate")
     eval_query_num: int = 10
     eval_enabled: bool = False
+    columnar: bool = True    # bulk dict-encoded read (20M-event path);
+                             # False forces the per-event row path
 
 
 class SeqDataSource(DataSource):
@@ -80,36 +95,82 @@ class SeqDataSource(DataSource):
             for e in events
         ]
 
+    def _read_columnar(self) -> SequenceColumns:
+        """Bulk path: one dict-encoded scan (templates/_columnar.py),
+        event times kept — the sequence model is the one consumer the
+        reference's order-blind reads could never serve."""
+        from predictionio_tpu.templates._columnar import read_interactions
+
+        p: SeqDataSourceParams = self.params
+        cols = read_interactions(
+            p.app_name, p.channel_name, "user", p.event_names, "item",
+        )
+        return SequenceColumns(
+            user_vocab=cols.entity_vocab,
+            item_vocab=cols.target_vocab,
+            user_idx=cols.entity_idx,
+            item_idx=cols.target_idx,
+            times=cols.times,
+        )
+
     def read_training(self, ctx: MeshContext) -> SequencesTD:
+        p: SeqDataSourceParams = self.params
+        if p.columnar:
+            return SequencesTD(columns=self._read_columnar())
         return SequencesTD(events=self._read())
 
     def read_eval(self, ctx: MeshContext):
         """Leave-last-out: hold out each user's chronologically final
-        event; one fold."""
+        event; one fold. Vectorized over the columnar read (the split is
+        a lexsort + last-occurrence mask — usable at 20M events)."""
         p: SeqDataSourceParams = self.params
         if not p.eval_enabled:
             return []
-        events = sorted(self._read(), key=lambda e: (e.user, e.time))
-        train: List[SeqEvent] = []
-        last: Dict[str, SeqEvent] = {}
-        for ev in events:
-            if ev.user in last:
-                train.append(last[ev.user])
-            last[ev.user] = ev
-        train_users = {t.user for t in train}
+        c = self._read_columnar()
+        n = len(c.times)
+        if n == 0:
+            return [(SequencesTD(columns=c), {"protocol": "leave-last-out"}, [])]
+        order = np.lexsort((c.times, c.user_idx))
+        u_sorted = c.user_idx[order]
+        # last row of each user's run in the (user, time) sort
+        is_last = np.ones(n, dtype=bool)
+        is_last[:-1] = u_sorted[1:] != u_sorted[:-1]
+        held = order[is_last]                     # one held-out row per user
+        train_rows = order[~is_last]
+        train = SequencesTD(columns=SequenceColumns(
+            user_vocab=c.user_vocab,
+            item_vocab=c.item_vocab,
+            user_idx=c.user_idx[train_rows],
+            item_idx=c.item_idx[train_rows],
+            times=c.times[train_rows],
+        ))
+        # users with a single event have no history left to query from
+        train_users = set(np.unique(c.user_idx[train_rows]).tolist())
         qa = [
-            ({"user": u, "num": p.eval_query_num}, {"item": ev.item})
-            for u, ev in sorted(last.items())
-            # users with a single event have no history left to query from
-            if u in train_users
+            ({"user": c.user_vocab[int(c.user_idx[r])], "num": p.eval_query_num},
+             {"item": c.item_vocab[int(c.item_idx[r])]})
+            for r in held
+            if int(c.user_idx[r]) in train_users
         ]
-        return [(SequencesTD(events=train), {"protocol": "leave-last-out"}, qa)]
+        qa.sort(key=lambda pair: pair[0]["user"])
+        return [(train, {"protocol": "leave-last-out"}, qa)]
 
 
 class SeqPreparator(Preparator):
-    """String ids -> dense indices, times kept (BiMap row, SURVEY.md §2.4)."""
+    """String ids -> dense indices, times kept (BiMap row, SURVEY.md §2.4).
+    The columnar TD arrives already dict-encoded: indexing is just
+    wrapping the vocabularies."""
 
     def prepare(self, ctx: MeshContext, td: SequencesTD) -> PreparedSequences:
+        if td.columns is not None:
+            c = td.columns
+            return PreparedSequences(
+                user_ids=BiMap.from_vocab(c.user_vocab),
+                item_ids=BiMap.from_vocab(c.item_vocab),
+                user_idx=c.user_idx.astype(np.int64, copy=False),
+                item_idx=c.item_idx.astype(np.int64, copy=False),
+                times=c.times,
+            )
         users = BiMap.string_int(e.user for e in td.events)
         items = BiMap.string_int(e.item for e in td.events)
         n = len(td.events)

@@ -52,12 +52,12 @@ def test_datasource_orders_and_eval_holds_out_last(memory_storage, seq_app):
     ds = seq_t.SeqDataSource(
         seq_t.SeqDataSourceParams(app_name="seqapp", eval_enabled=True))
     td = ds.read_training(ctx)
-    assert len(td.events) == N_USERS * HIST
+    assert len(td.columns.times) == N_USERS * HIST  # columnar by default
     folds = ds.read_eval(ctx)
     assert len(folds) == 1
     train_td, info, qa = folds[0]
     assert info["protocol"] == "leave-last-out"
-    assert len(train_td.events) == N_USERS * (HIST - 1)
+    assert len(train_td.columns.times) == N_USERS * (HIST - 1)
     assert len(qa) == N_USERS
     # the held-out actual is each user's final item in the cycle
     for q, a in qa:
@@ -139,3 +139,22 @@ def test_batch_predict_matches_predict(memory_storage, seq_app):
         assert [x["item"] for x in batched[i]["itemScores"]] == [
             x["item"] for x in single["itemScores"]
         ]
+
+
+def test_columnar_read_matches_row_path(memory_storage, seq_app):
+    """The bulk dict-encoded read must produce the same prepared
+    sequences as the per-event row path."""
+    prep = seq_t.SeqPreparator(None)
+
+    def resolved(columnar):
+        ds = seq_t.SeqDataSource(
+            seq_t.SeqDataSourceParams(app_name="seqapp", columnar=columnar)
+        )
+        pd = prep.prepare(ctx, ds.read_training(ctx))
+        inv_u, inv_i = pd.user_ids.inverse(), pd.item_ids.inverse()
+        return sorted(
+            (inv_u[int(u)], inv_i[int(i)], float(t))
+            for u, i, t in zip(pd.user_idx, pd.item_idx, pd.times)
+        )
+
+    assert resolved(True) == resolved(False)
