@@ -18,7 +18,12 @@ Safety properties owned here (NOT by the trainers):
     the checkpointed parameters ARE that run's result.)
   - multi-host: only process 0 writes (no torn concurrent writes to a
     shared filesystem); cross-process-sharded arrays are allgathered
-    to host before pickling.
+    to host before pickling. ``directory`` MUST be a filesystem shared
+    by all processes — every process restores from it at trainer
+    construction. restore() enforces this: process 0's restored epoch
+    is broadcast and any process that disagrees (the symptom of
+    host-local directories) raises instead of silently desynchronizing
+    the jitted collective training steps.
   - atomicity: write to ``.tmp`` then ``os.replace``; a crash mid-write
     never corrupts the latest good checkpoint; a torn newest file falls
     back to the previous one. The two most recent checkpoints are kept.
@@ -127,7 +132,18 @@ class TrainCheckpointer:
         whose fingerprint matches this run, or None. A torn newest file
         falls back to the previous one; a fingerprint mismatch (other
         data/config trained into this directory) is skipped with a
-        warning."""
+        warning.
+
+        Multi-host: the result is validated against process 0's — all
+        processes must resume from the SAME epoch (requires ``directory``
+        on a shared filesystem), otherwise the jitted collective steps
+        would desynchronize (hang or silent divergence). Disagreement
+        fails fast here; if process 0 starts fresh, every process does.
+        """
+        local = self._restore_local()
+        return self._reconcile_multihost(local)
+
+    def _restore_local(self) -> Optional[Tuple[int, Any]]:
         for epoch in reversed(self._epochs_on_disk()):
             try:
                 with open(self._path(epoch), "rb") as f:
@@ -145,3 +161,32 @@ class TrainCheckpointer:
                 return None
             return int(doc["epoch"]), doc["state"]
         return None
+
+    def _reconcile_multihost(
+        self, local: Optional[Tuple[int, Any]]
+    ) -> Optional[Tuple[int, Any]]:
+        import jax
+
+        if jax.process_count() <= 1:
+            return local
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        my_epoch = local[0] if local is not None else -1
+        epoch0 = int(
+            multihost_utils.broadcast_one_to_all(np.int64(my_epoch))
+        )
+        if epoch0 == -1:
+            # process 0 starts fresh -> everyone starts fresh (a local
+            # checkpoint here would mean a stale/non-shared directory)
+            return None
+        if my_epoch != epoch0:
+            raise RuntimeError(
+                f"checkpoint desync: process 0 restored epoch {epoch0} but "
+                f"process {jax.process_index()} found "
+                f"{'epoch %d' % my_epoch if my_epoch >= 0 else 'no checkpoint'} "
+                f"in {self.directory!r} — checkpoint_dir must be a filesystem "
+                "shared by ALL processes (process 0 is the single writer; "
+                "every process restores from it)"
+            )
+        return local

@@ -91,6 +91,9 @@ def _load():
         ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
         ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
         ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),  # ent dict offsets
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),  # tgt dict offsets
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),  # name dict offsets
     ]
     lib.el_append_columnar.restype = ctypes.c_int64
     lib.el_append_columnar.argtypes = [
@@ -406,6 +409,8 @@ class EventLogEventStore(S.EventStore):
         ent_d, tgt_d, nam_d = u8p(), u8p(), u8p()
         ent_db, tgt_db, nam_db = ctypes.c_uint64(), ctypes.c_uint64(), ctypes.c_uint64()
         n_ent, n_tgt, n_nam = ctypes.c_int64(), ctypes.c_int64(), ctypes.c_int64()
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        ent_o, tgt_o, nam_o = u64p(), u64p(), u64p()
         n = self._lib.el_find_columnar(
             h, ctypes.byref(req),
             value_property.encode() if value_property is not None else None,
@@ -415,6 +420,7 @@ class EventLogEventStore(S.EventStore):
             ctypes.byref(ent_d), ctypes.byref(ent_db), ctypes.byref(n_ent),
             ctypes.byref(tgt_d), ctypes.byref(tgt_db), ctypes.byref(n_tgt),
             ctypes.byref(nam_d), ctypes.byref(nam_db), ctypes.byref(n_nam),
+            ctypes.byref(ent_o), ctypes.byref(tgt_o), ctypes.byref(nam_o),
         )
         if n < 0:
             raise S.StorageError("columnar find failed in native event log")
@@ -425,11 +431,17 @@ class EventLogEventStore(S.EventStore):
             ).copy() if count else np.empty(0, np_dtype)
             return arr.astype(np_dtype, copy=False)
 
-        def vocab(ptr, nbytes, count):
+        def vocab(ptr, nbytes, offs_ptr, count):
+            # exact prefix offsets: ids containing ANY byte (incl. NUL)
+            # round-trip, matching the npz wire format of the REST tier
             if not count:
                 return []
             raw = ctypes.string_at(ptr, nbytes)
-            return raw.decode("utf-8").split("\0")[:count]
+            offs = ctypes.cast(offs_ptr, u64p)
+            return [
+                raw[offs[i]:offs[i + 1]].decode("utf-8")
+                for i in range(count)
+            ]
 
         try:
             cols = S.EventColumns(
@@ -438,12 +450,13 @@ class EventLogEventStore(S.EventStore):
                 name_codes=take(nam, ctypes.c_int32, n, np.int32),
                 values=take(val, ctypes.c_double, n, np.float64),
                 times_us=take(tim, ctypes.c_int64, n, np.int64),
-                entity_vocab=vocab(ent_d, ent_db.value, n_ent.value),
-                target_vocab=vocab(tgt_d, tgt_db.value, n_tgt.value),
-                names=vocab(nam_d, nam_db.value, n_nam.value),
+                entity_vocab=vocab(ent_d, ent_db.value, ent_o, n_ent.value),
+                target_vocab=vocab(tgt_d, tgt_db.value, tgt_o, n_tgt.value),
+                names=vocab(nam_d, nam_db.value, nam_o, n_nam.value),
             )
         finally:
-            for p in (ent, tgt, nam, val, tim, ent_d, tgt_d, nam_d):
+            for p in (ent, tgt, nam, val, tim, ent_d, tgt_d, nam_d,
+                      ent_o, tgt_o, nam_o):
                 self._lib.el_free(p)
         return cols
 
@@ -466,14 +479,16 @@ class EventLogEventStore(S.EventStore):
 
         # dictionaries packed WITHOUT separators; prefix offsets are exact
         def dict_concat(vocab):
-            bs = [s.encode("utf-8") for s in vocab]
-            offsets = np.zeros(len(bs) + 1, np.uint64)
-            if bs:
-                np.cumsum(
-                    np.fromiter((len(b) for b in bs), np.uint64, count=len(bs)),
-                    out=offsets[1:],
+            joined, offsets = S.pack_vocab(vocab)
+            # u16 wire header: >= 0xFFFF wraps/aliases the absent
+            # sentinel; fail loudly like the row path's struct 'H'
+            widths = np.diff(offsets.astype(np.int64))
+            if widths.size and int(widths.max()) >= 0xFFFF:
+                raise S.StorageError(
+                    f"id/name of {int(widths.max())} bytes exceeds the "
+                    "65534-byte wire-format limit"
                 )
-            return b"".join(bs), offsets
+            return joined, offsets
 
         ent_b, ent_off = dict_concat(cols.entity_vocab)
         tgt_b, tgt_off = dict_concat(cols.target_vocab)

@@ -75,24 +75,33 @@ class EventColumns:
         return len(self.entity_codes)
 
 
+def pack_vocab(vocab) -> tuple:
+    """Concatenated UTF-8 bytes + exact (len+1) uint64 prefix offsets —
+    the ONE separator-free dictionary layout shared by the npz wire
+    format and the native columnar ABI, so ids containing ANY byte
+    round-trip correctly."""
+    import numpy as np
+
+    bs = [s.encode("utf-8") for s in vocab]
+    offsets = np.zeros(len(bs) + 1, np.uint64)
+    if bs:
+        np.cumsum(
+            np.fromiter((len(b) for b in bs), np.uint64, count=len(bs)),
+            out=offsets[1:],
+        )
+    return b"".join(bs), offsets
+
+
 def columns_to_npz(cols: EventColumns) -> bytes:
     """EventColumns -> one .npz blob — the wire format of the bulk
-    columnar storage routes. Vocabularies travel as concatenated UTF-8
-    bytes plus exact prefix offsets (separator-free, like the native
-    dictionaries), so ids containing ANY byte round-trip correctly."""
+    columnar storage routes. Vocabularies travel via pack_vocab."""
     import io
 
     import numpy as np
 
     def vocab_arrays(vocab):
-        bs = [s.encode("utf-8") for s in vocab]
-        offsets = np.zeros(len(bs) + 1, np.uint64)
-        if bs:
-            np.cumsum(
-                np.fromiter((len(b) for b in bs), np.uint64, count=len(bs)),
-                out=offsets[1:],
-            )
-        return np.frombuffer(b"".join(bs), dtype=np.uint8), offsets
+        joined, offsets = pack_vocab(vocab)
+        return np.frombuffer(joined, dtype=np.uint8), offsets
 
     ent_b, ent_off = vocab_arrays(cols.entity_vocab)
     tgt_b, tgt_off = vocab_arrays(cols.target_vocab)

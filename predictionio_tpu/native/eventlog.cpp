@@ -489,9 +489,11 @@ double extract_prop(const uint8_t* extra, uint32_t len, const char* key) {
 }
 
 // dict encoder for string columns: string -> code in first-seen order,
-// dictionary emitted as '\0'-joined bytes. Keys are string_views into
-// the mmap'ed log (stable under the shared lock held for the whole
-// scan), so encoding 20M rows allocates nothing per row.
+// dictionary emitted as concatenated bytes + exact prefix offsets (ids
+// may legally contain ANY byte, including NUL, so a separator-joined
+// format would be ambiguous). Keys are string_views into the mmap'ed
+// log (stable under the shared lock held for the whole scan), so
+// encoding 20M rows allocates nothing per row.
 struct DictEncoder {
   std::unordered_map<std::string_view, int32_t> codes;
   std::vector<std::string_view> order;
@@ -506,19 +508,29 @@ struct DictEncoder {
     return code;
   }
 
-  // '\0'-joined dictionary; caller owns (el_free)
-  uint8_t* dump(uint64_t* nbytes) const {
+  // concatenated dictionary bytes + (order.size()+1) prefix offsets;
+  // caller owns both (el_free)
+  uint8_t* dump(uint64_t* nbytes, uint64_t** offsets_out) const {
     uint64_t total = 0;
-    for (const auto& s : order) total += s.size() + 1;
+    for (const auto& s : order) total += s.size();
     uint8_t* buf = static_cast<uint8_t*>(malloc(total ? total : 1));
     if (!buf) return nullptr;
+    uint64_t* offs =
+        static_cast<uint64_t*>(malloc(sizeof(uint64_t) * (order.size() + 1)));
+    if (!offs) {
+      free(buf);
+      return nullptr;
+    }
     uint64_t w = 0;
+    size_t i = 0;
     for (const auto& s : order) {
+      offs[i++] = w;
       memcpy(buf + w, s.data(), s.size());
       w += s.size();
-      buf[w++] = 0;
     }
+    offs[i] = w;
     *nbytes = total;
+    *offsets_out = offs;
     return buf;
   }
 };
@@ -758,10 +770,13 @@ int64_t el_find(void* h, const FindReq* req, uint8_t** out, uint64_t* out_bytes)
 // Columnar filtered scan: the bulk training-read path (the role of the
 // reference's region-parallel HBase scans feeding RDDs,
 // hbase/HBPEvents.scala:48) — matching events come back dict-encoded
-// (entity id / target id / event name as int32 codes + '\0'-joined
-// dictionaries in first-seen order) plus one numeric property extracted
-// from the record's JSON extra (`value_prop`; NaN when absent), so a
-// 20M-event read never materializes per-event Python objects.
+// (entity id / target id / event name as int32 codes + concatenated
+// dictionaries with exact prefix offsets, first-seen order) plus one
+// numeric property extracted from the record's JSON extra
+// (`value_prop`; NaN when absent), so a 20M-event read never
+// materializes per-event Python objects. Offsets (n_x + 1 uint64s per
+// dictionary) make ids containing ANY byte — including NUL — round-trip
+// exactly, matching the npz wire format of the REST tier.
 // Output arrays are malloc'd; caller frees each with el_free. Rows with
 // no target id get tgt_code = -1. Returns the row count, or -1.
 int64_t el_find_columnar(
@@ -770,7 +785,9 @@ int64_t el_find_columnar(
     int32_t** name_codes_out, double** values_out, int64_t** times_us_out,
     uint8_t** ent_dict_out, uint64_t* ent_dict_bytes, int64_t* n_ent,
     uint8_t** tgt_dict_out, uint64_t* tgt_dict_bytes, int64_t* n_tgt,
-    uint8_t** name_dict_out, uint64_t* name_dict_bytes, int64_t* n_names) {
+    uint8_t** name_dict_out, uint64_t* name_dict_bytes, int64_t* n_names,
+    uint64_t** ent_offsets_out, uint64_t** tgt_offsets_out,
+    uint64_t** name_offsets_out) {
   Log* log = static_cast<Log*>(h);
   ensure_index_for_scan(log);
   std::shared_lock lk(log->mu);
@@ -842,12 +859,16 @@ int64_t el_find_columnar(
     return -1;
   }
 
-  uint8_t* ent_dict = ents.dump(ent_dict_bytes);
-  uint8_t* tgt_dict = tgts.dump(tgt_dict_bytes);
-  uint8_t* name_dict = names.dump(name_dict_bytes);
+  uint64_t* ent_offs = nullptr;
+  uint64_t* tgt_offs = nullptr;
+  uint64_t* name_offs = nullptr;
+  uint8_t* ent_dict = ents.dump(ent_dict_bytes, &ent_offs);
+  uint8_t* tgt_dict = tgts.dump(tgt_dict_bytes, &tgt_offs);
+  uint8_t* name_dict = names.dump(name_dict_bytes, &name_offs);
   if (!ent_dict || !tgt_dict || !name_dict) {
     free(ent_codes); free(tgt_codes); free(name_codes); free(values); free(times_us);
     free(ent_dict); free(tgt_dict); free(name_dict);
+    free(ent_offs); free(tgt_offs); free(name_offs);
     return -1;
   }
   *ent_codes_out = ent_codes;
@@ -858,6 +879,9 @@ int64_t el_find_columnar(
   *ent_dict_out = ent_dict;
   *tgt_dict_out = tgt_dict;
   *name_dict_out = name_dict;
+  *ent_offsets_out = ent_offs;
+  *tgt_offsets_out = tgt_offs;
+  *name_offsets_out = name_offs;
   *n_ent = static_cast<int64_t>(ents.order.size());
   *n_tgt = static_cast<int64_t>(tgts.order.size());
   *n_names = static_cast<int64_t>(names.order.size());
@@ -887,6 +911,10 @@ int64_t el_append_columnar(
   size_t l_etype = strlen(entity_type);
   size_t l_ttype = target_entity_type ? strlen(target_entity_type) : 0;
   size_t l_prop = value_prop ? strlen(value_prop) : 0;
+  // u16 header fields: any string length >= 0xFFFF (the kAbsent
+  // sentinel) would wrap or alias the framing — fail the whole batch,
+  // mirroring the Python row path where struct.pack('H') raises
+  if (l_etype >= kAbsent || l_ttype >= kAbsent) return -1;
 
   int64_t now_us;
   {
@@ -907,6 +935,7 @@ int64_t el_append_columnar(
     if (ec < 0 || ec >= n_ent) return -1;
     const uint8_t* eid = ent_dict + ent_offsets[ec];
     uint32_t l_eid = static_cast<uint32_t>(ent_offsets[ec + 1] - ent_offsets[ec]);
+    if (l_eid >= kAbsent) return -1;
     int32_t tc = tgt_codes ? tgt_codes[r] : -1;
     const uint8_t* tid = nullptr;
     uint32_t l_tid = 0;
@@ -914,11 +943,13 @@ int64_t el_append_columnar(
       if (tc >= n_tgt || !target_entity_type) return -1;
       tid = tgt_dict + tgt_offsets[tc];
       l_tid = static_cast<uint32_t>(tgt_offsets[tc + 1] - tgt_offsets[tc]);
+      if (l_tid >= kAbsent) return -1;
     }
     int32_t nc = name_codes[r];
     if (nc < 0 || nc >= n_names) return -1;
     const uint8_t* name = name_dict + name_offsets[nc];
     uint32_t l_name = static_cast<uint32_t>(name_offsets[nc + 1] - name_offsets[nc]);
+    if (l_name >= kAbsent) return -1;
 
     uint32_t l_extra = 0;
     const char* extra_src = extra;

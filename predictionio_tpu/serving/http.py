@@ -36,6 +36,18 @@ class JSONRequestHandler(BaseHTTPRequestHandler):
             data = body.encode()
         else:
             data = json.dumps(body).encode()
+        # Consume any unread request body before responding: under
+        # HTTP/1.1 keep-alive an unread body desynchronizes the
+        # connection — the next request would be parsed from leftover
+        # body bytes (matters for short-circuit responses: auth denial,
+        # unknown route). Cheap no-op when the handler already read it.
+        try:
+            unread = int(self.headers.get("Content-Length") or 0)
+        except (TypeError, ValueError):
+            unread = 0
+        if unread and not getattr(self, "_body_consumed", False):
+            self.rfile.read(unread)
+        self._body_consumed = False  # reset for the next keep-alive request
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
@@ -46,6 +58,7 @@ class JSONRequestHandler(BaseHTTPRequestHandler):
 
     def _read_body(self) -> bytes:
         length = int(self.headers.get("Content-Length", 0))
+        self._body_consumed = True
         return self.rfile.read(length) if length else b""
 
     def _read_json(self) -> Any:

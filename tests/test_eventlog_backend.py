@@ -156,6 +156,71 @@ def test_second_process_gets_clean_lock_error(tmp_path):
     st.events().close()
 
 
+def test_columnar_nul_bytes_in_ids_round_trip(tmp_path):
+    """The native columnar dictionaries use exact prefix offsets, so ids
+    containing embedded NUL bytes round-trip on the NATIVE path (a
+    '\\0'-joined dictionary would silently shift every later vocab
+    entry). Covers the native backend directly — the REST edge-case test
+    only exercises the npz fallback."""
+    st = _mk(tmp_path)
+    app = st.apps().insert("nul")
+    st.events().init(app.id)
+    weird = ["a\0b", "plain", "\0lead", "trail\0", "double\0\0mid"]
+    batch = [
+        Event(
+            event="rate",
+            entity_type="user",
+            entity_id=uid,
+            target_entity_type="item",
+            target_entity_id=f"i\0{i}",
+            properties={"rating": float(i)},
+            event_time=dt.datetime(2026, 3, 1, 12, i, tzinfo=UTC),
+        )
+        for i, uid in enumerate(weird)
+    ]
+    st.events().insert_batch(batch, app.id)
+    cols = st.events().find_columnar(
+        app.id, value_property="rating", time_ordered=True
+    )
+    got_ents = [cols.entity_vocab[c] for c in cols.entity_codes]
+    got_tgts = [cols.target_vocab[c] for c in cols.target_codes]
+    assert got_ents == weird
+    assert got_tgts == [f"i\0{i}" for i in range(len(weird))]
+    assert list(cols.values) == [float(i) for i in range(len(weird))]
+    st.events().close()
+
+
+def test_columnar_append_rejects_u16_overflow(tmp_path):
+    """A string >= 65535 bytes would wrap the u16 wire header length (or
+    alias the absent sentinel); insert_columnar must fail loudly like
+    the row path's struct.pack('H'), never corrupt record framing."""
+    import numpy as np
+
+    from predictionio_tpu.data.storage import EventColumns, StorageError
+
+    st = _mk(tmp_path)
+    app = st.apps().insert("overflow")
+    st.events().init(app.id)
+    cols = EventColumns(
+        entity_codes=np.array([0], np.int32),
+        target_codes=np.array([0], np.int32),
+        name_codes=np.array([0], np.int32),
+        values=np.array([1.0]),
+        times_us=np.array([0], np.int64),
+        entity_vocab=["u" * 0xFFFF],
+        target_vocab=["i1"],
+        names=["rate"],
+    )
+    with pytest.raises(StorageError):
+        st.events().insert_columnar(
+            cols, app.id, entity_type="user", target_entity_type="item",
+            value_property="rating",
+        )
+    # the log is untouched — no partially-framed record
+    assert st.events().find(app.id) == []
+    st.events().close()
+
+
 def test_bulk_throughput_sanity(tmp_path):
     """50k events in one batch append + filtered scan — exercises the
     native index path at a size where Python-side filtering would show."""

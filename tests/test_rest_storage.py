@@ -429,3 +429,39 @@ def test_columnar_rest_edge_cases(rest_storage):
         client.events().find_columnar(9, event_name=["rate"])  # typo
     with pytest.raises(TypeError):   # find()'s fixed signature rejects
         client.events().find(9, entity_types="user")
+
+
+def test_keepalive_survives_short_circuit_responses(memory_storage):
+    """HTTP/1.1 keep-alive: responses sent before the handler reads the
+    request body (auth denial, unknown route) must still drain it, or
+    the next request on the same connection is parsed from leftover
+    body bytes."""
+    import http.client
+
+    server = StorageServer(
+        storage=memory_storage, host="127.0.0.1", port=0, auth_key="sekret"
+    ).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port)
+        body = json.dumps({"app_id": 1, "junk": "x" * 4096})
+        # 1) denied POST with a body (no auth header)
+        conn.request("POST", "/storage/events/init", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 401
+        resp.read()
+        # 2) unknown route with a body, authed
+        conn.request("POST", "/storage/events/nope", body=body,
+                     headers={"X-PIO-Storage-Key": "sekret"})
+        resp = conn.getresponse()
+        assert resp.status == 404
+        resp.read()
+        # 3) a real request on the SAME connection still parses cleanly
+        conn.request("POST", "/storage/events/init", body=json.dumps({"app_id": 1}),
+                     headers={"X-PIO-Storage-Key": "sekret"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read()) == {"ok": True}
+        conn.close()
+    finally:
+        server.stop()
