@@ -1,46 +1,95 @@
-"""Headline benchmark: the full events->model pipeline at MovieLens-20M
-scale, ending in ALS training throughput on-chip.
+"""Headline benchmark: the full events->model->serving pipeline at
+MovieLens-20M scale on one chip.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": {...}}
 
 Unlike a kernel microbench, this drives the framework's own data path —
-the `pio train` call stack (SURVEY.md §3.1):
+the `pio train` call stack (SURVEY.md §3.1) — TWICE, in two fresh
+processes sharing one on-disk store and one persistent compilation
+cache, so both halves of the compile story are measured:
 
-  synth   - structured ratings (latent-factor signal + noise, so the
-            RMSE gate below measures real generalization, not luck)
-  ingest  - 20M events into the native eventlog via the storage write
-            API (columnar bulk path = PEvents.write role; the row path
-            insert_batch is sampled separately)
-  read    - RecoDataSource.read_training: native columnar scan with
-            dict-encoded string ids (HBPEvents.scala:48 role)
-  prepare - RecoPreparator: BiMap id indexing over the vocabularies
-  bin     - ragged->segmented static blocks + device placement + XLA
-            compile + one throwaway run (ALSTrainer.compile)
-  train   - the timed region: pure device ALS alternations, synced by a
-            scalar readback
-  rmse    - model-quality gate on a 5% held-out split: the model must
-            beat the global-mean predictor's RMSE by >=15%, so a
-            numerically-degraded fast path cannot "win" the benchmark
+  cold stage (fresh cache):
+    synth   - structured ratings (latent-factor signal + noise, so the
+              RMSE gates below measure real generalization, not luck)
+    ingest  - 20M events into the native eventlog via the storage write
+              API (columnar bulk path = PEvents.write role; the row
+              path insert_batch is sampled separately)
+    read    - RecoDataSource.read_training: native columnar scan
+    prepare - RecoPreparator: BiMap id indexing
+    bin     - ragged->segmented static blocks + device placement
+    compile - XLA compile + one throwaway run (cache MISS: the full
+              compile tax, persisted to the cache for the warm stage)
+    train   - the timed region: pure device ALS alternations, synced by
+              a scalar readback
+    rmse    - quality gates on a 5% held-out split: beat the
+              global-mean predictor by >=15% AND (at default knobs)
+              land inside the absolute band for this fixed generator —
+              a silent half-regression in solve quality zeroes the
+              headline, not just total breakage
+    serve   - the trained model is persisted through the models repo,
+              deployed via the REAL EngineServer (prepare_deploy +
+              warm-up), and driven over HTTP POST /queries.json:
+              sequential p50/p99 + concurrent throughput. Gate:
+              p50 < 10 ms (BASELINE.json north-star) or the headline
+              is zeroed.
+
+  warm stage (fresh process, same cache): read -> prepare -> bin ->
+    compile -> train again. Compile becomes a disk-cache HIT; this is
+    what every repeat train / deploy warm-up / /reload pays in
+    production.
+
+Roofline: analytic FLOP/byte counts from the trainer's actual padded
+device shapes (ALSTrainer.work_model — documented under-estimate of
+bytes) against TPU v5e public peaks, recorded so the headline number is
+grounded in what the chip can do: the train region is expected near the
+HBM roof (gather-bound), which is also why the fused Pallas gather
+kernel lost to XLA and was removed (ops/als.py measurement note).
 
 Headline metric: rating-updates/sec/chip = n_train_ratings * iterations
-/ train_sec. ``vs_baseline`` divides by an ASSUMED PROXY of 1e6
-ratings*iters/sec for a Spark-MLlib-ALS CPU node — the reference
+/ train_sec (cold stage). ``vs_baseline`` divides by an ASSUMED PROXY
+of 1e6 ratings*iters/sec for a Spark-MLlib-ALS CPU node — the reference
 publishes no benchmark numbers at all (BASELINE.json "published": {});
 the proxy is our own stated assumption, recorded in the detail block,
 and the >=5x north-star (BASELINE.md) reads as vs_baseline >= 5.
-If the RMSE gate fails, value is reported as 0.0.
+If ANY gate fails (relative RMSE, absolute RMSE band, serving p50),
+value is reported as 0.0 with the gate flags telling which.
 
-Scale knobs via env: PIO_BENCH_USERS/ITEMS/RATINGS/RANK/ITERS.
+Scale knobs via env: PIO_BENCH_USERS/ITEMS/RATINGS/RANK/ITERS (the
+absolute RMSE band only applies at the default knobs).
 """
 
+import argparse
 import json
 import os
 import shutil
+import subprocess
+import sys
 import tempfile
 import time
 
 import numpy as np
+
+# public TPU v5e per-chip peaks (cloud.google.com/tpu/docs/v5e):
+# 197 TFLOP/s bf16, 819 GB/s HBM bandwidth
+V5E_PEAK_BF16_FLOPS = 197e12
+V5E_PEAK_HBM_BYTES = 819e9
+
+DEFAULT_KNOBS = (138_493, 26_744, 20_000_000, 64, 5)  # ML-20M + rank/iters
+# absolute held-out RMSE band for the DEFAULT synthetic generator at the
+# default knobs (measured 0.427 across rounds; the band catches silent
+# solve-quality regressions that still beat the trivial 15% gate)
+RMSE_BAND = (0.38, 0.48)
+
+
+def knobs():
+    return (
+        int(os.environ.get("PIO_BENCH_USERS", DEFAULT_KNOBS[0])),
+        int(os.environ.get("PIO_BENCH_ITEMS", DEFAULT_KNOBS[1])),
+        int(os.environ.get("PIO_BENCH_RATINGS", DEFAULT_KNOBS[2])),
+        int(os.environ.get("PIO_BENCH_RANK", DEFAULT_KNOBS[3])),
+        int(os.environ.get("PIO_BENCH_ITERS", DEFAULT_KNOBS[4])),
+    )
 
 
 def synthesize(n_users, n_items, n_ratings, rng):
@@ -56,15 +105,24 @@ def synthesize(n_users, n_items, n_ratings, rng):
     return uu, ii, vals
 
 
-def main() -> None:
-    n_users = int(os.environ.get("PIO_BENCH_USERS", 138_493))   # ML-20M
-    n_items = int(os.environ.get("PIO_BENCH_ITEMS", 26_744))    # cardinalities
-    n_ratings = int(os.environ.get("PIO_BENCH_RATINGS", 20_000_000))
-    rank = int(os.environ.get("PIO_BENCH_RANK", 64))
-    iterations = int(os.environ.get("PIO_BENCH_ITERS", 5))
+def _storage(base_dir):
+    from predictionio_tpu.data.storage import Storage, set_storage
 
-    from predictionio_tpu.data.storage import EventColumns, Storage, set_storage
-    from predictionio_tpu.ops.als import ALSConfig, ALSTrainer, predict_rmse
+    st = Storage.from_env({
+        "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+        "PIO_STORAGE_SOURCES_EL_PATH": base_dir,
+        **{f"PIO_STORAGE_REPOSITORIES_{r}_{k}": v
+           for r in ("METADATA", "EVENTDATA", "MODELDATA")
+           for k, v in (("NAME", r.lower()), ("SOURCE", "EL"))},
+    })
+    set_storage(st)
+    return st
+
+
+def _read_prepare_bin_train(detail, n_expected):
+    """The shared events->model path (both stages): returns everything
+    the caller needs for quality gates / serving."""
+    from predictionio_tpu.ops.als import ALSConfig, ALSTrainer
     from predictionio_tpu.parallel.mesh import MeshContext
     from predictionio_tpu.templates.recommendation import (
         RecoDataSource,
@@ -72,121 +130,330 @@ def main() -> None:
         RecoPreparator,
     )
 
+    _, _, _, rank, iterations = knobs()
+    ctx = MeshContext()
+    ds = RecoDataSource(RecoDataSourceParams(app_name="bench"))
+    t0 = time.perf_counter()
+    td = ds.read_training(ctx)
+    read_sec = time.perf_counter() - t0
+    detail["read_sec"] = round(read_sec, 2)
+    n_read = len(td.columns.ratings)
+    assert n_read == n_expected, (n_read, n_expected)
+
+    t0 = time.perf_counter()
+    pd = RecoPreparator(None).prepare(ctx, td)
+    detail["prepare_sec"] = round(time.perf_counter() - t0, 2)
+
+    hold = np.arange(n_read) % 20 == 0   # 5% held out
+    tr_u, tr_i, tr_r = pd.user_idx[~hold], pd.item_idx[~hold], pd.ratings[~hold]
+    ho = (pd.user_idx[hold], pd.item_idx[hold], pd.ratings[hold])
+
+    cfg = ALSConfig(rank=rank, iterations=iterations, reg=0.05,
+                    block_size=4096)
+    t0 = time.perf_counter()
+    trainer = ALSTrainer((tr_u, tr_i, tr_r), len(pd.user_ids),
+                         len(pd.item_ids), cfg)
+    detail["bin_sec"] = round(time.perf_counter() - t0, 2)
+    # barrier on the async host->device puts, so compile_sec below is
+    # genuinely compile (+1 throwaway run), not hidden bulk transfer —
+    # on this tunneled chip the transfer is the larger of the two
+    t0 = time.perf_counter()
+    trainer.wait_device()
+    detail["transfer_sec"] = round(time.perf_counter() - t0, 2)
+    t0 = time.perf_counter()
+    trainer.compile()
+    detail["compile_sec"] = round(time.perf_counter() - t0, 2)
+    # continuity with BENCH_r01/r02 (one one-time-costs number)
+    detail["bin_compile_sec"] = round(
+        detail["bin_sec"] + detail["transfer_sec"] + detail["compile_sec"], 2
+    )
+
+    t0 = time.perf_counter()
+    trainer.step_n(iterations)
+    train_sec = time.perf_counter() - t0
+    detail["train_sec"] = round(train_sec, 2)
+    detail["events_to_model_sec"] = round(
+        read_sec + detail["prepare_sec"] + detail["bin_compile_sec"] + train_sec, 2
+    )
+    detail["events_to_model_events_per_sec"] = round(
+        n_read / detail["events_to_model_sec"], 1
+    )
+    return trainer, pd, ho, (tr_u, tr_i, tr_r), cfg, train_sec
+
+
+def _roofline(trainer, train_sec, iterations):
+    wm = trainer.work_model()
+    achieved_flops = wm["flops_per_iter"] * iterations / train_sec
+    achieved_bytes = wm["hbm_bytes_per_iter"] * iterations / train_sec
+    return {
+        "model": ("analytic counts from actual padded device shapes "
+                  "(ALSTrainer.work_model); bytes are a documented "
+                  "UNDER-estimate, so hbm fraction is a lower bound"),
+        "flops_per_iter": wm["flops_per_iter"],
+        "hbm_bytes_per_iter": wm["hbm_bytes_per_iter"],
+        "achieved_tflops": round(achieved_flops / 1e12, 2),
+        "achieved_hbm_gb_per_sec": round(achieved_bytes / 1e9, 1),
+        "peak_bf16_tflops": V5E_PEAK_BF16_FLOPS / 1e12,
+        "peak_hbm_gb_per_sec": V5E_PEAK_HBM_BYTES / 1e9,
+        "mxu_fraction": round(achieved_flops / V5E_PEAK_BF16_FLOPS, 3),
+        "hbm_fraction": round(achieved_bytes / V5E_PEAK_HBM_BYTES, 3),
+    }
+
+
+def _serve_stage(storage, factors, pd, cfg, detail):
+    """Persist the trained model through the models repo, deploy it via
+    the REAL EngineServer (prepare_deploy + warm-up), and measure the
+    live HTTP route (ref: CreateServer.scala:552-559 serving path)."""
+    import datetime as dt
+    import http.client
+    import pickle
+    import threading
+    import uuid
+
+    from predictionio_tpu.core.params import EngineParams
+    from predictionio_tpu.data.metadata import EngineInstance, Model
+    from predictionio_tpu.models.als import ALSModel, ALSParams
+    from predictionio_tpu.serving.engine_server import EngineServer
+    from predictionio_tpu.templates.recommendation import (
+        RecoDataSourceParams,
+        recommendation_engine,
+    )
+
+    engine = recommendation_engine()
+    ep = EngineParams(
+        data_source_params=("", RecoDataSourceParams(app_name="bench")),
+        preparator_params=("", None),
+        algorithm_params_list=[("als", ALSParams(
+            rank=cfg.rank, num_iterations=cfg.iterations, lambda_=cfg.reg))],
+        serving_params=("", None),
+    )
+    ep_json = ep.to_json_dict()
+    now = dt.datetime.now(tz=dt.timezone.utc)
+    instance = EngineInstance(
+        id=uuid.uuid4().hex, status="COMPLETED", start_time=now, end_time=now,
+        engine_id="bench_reco", engine_version="0", engine_variant="default",
+        engine_factory="bench", batch="bench",
+        data_source_params=json.dumps(ep_json["dataSourceParams"]),
+        preparator_params=json.dumps(ep_json["preparatorParams"]),
+        algorithms_params=json.dumps(ep_json["algorithmParamsList"]),
+        serving_params=json.dumps(ep_json["servingParams"]),
+    )
+    storage.engine_instances().insert(instance)
+    model = ALSModel(factors, pd.user_ids, pd.item_ids)
+    storage.models().insert(Model(id=instance.id, models=pickle.dumps([model])))
+
+    server = EngineServer(
+        engine, "bench_reco", host="127.0.0.1", port=0, storage=storage,
+    ).start()
+    try:
+        rng = np.random.default_rng(7)
+        inv = pd.user_ids.inverse()
+        users = [inv[int(u)]
+                 for u in rng.integers(0, len(pd.user_ids), size=512)]
+
+        import socket
+
+        def connect():
+            c = http.client.HTTPConnection("127.0.0.1", server.port)
+            c.connect()
+            # what every production HTTP client (curl, urllib3) does;
+            # stdlib http.client leaves Nagle on
+            c.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return c
+
+        def one(conn, user):
+            body = json.dumps({"user": user, "num": 10})
+            conn.request("POST", "/queries.json", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            assert resp.status == 200 and b"itemScores" in data, data[:200]
+
+        conn = connect()
+        for u in users[:16]:            # settle connection + code paths
+            one(conn, u)
+        laps = []
+        for u in users[16:376]:         # 360 timed sequential requests
+            t0 = time.perf_counter()
+            one(conn, u)
+            laps.append(time.perf_counter() - t0)
+        conn.close()
+        laps.sort()
+        p50 = laps[len(laps) // 2]
+        p99 = laps[int(len(laps) * 0.99)]
+
+        # concurrent throughput: 4 keep-alive connections
+        n_threads, per_thread = 4, 120
+        errs = []
+
+        def worker(tid):
+            try:
+                c = connect()
+                for j in range(per_thread):
+                    one(c, users[(tid * per_thread + j) % len(users)])
+                c.close()
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        assert not errs, errs[0]
+
+        detail["serve_p50_ms"] = round(p50 * 1e3, 2)
+        detail["serve_p99_ms"] = round(p99 * 1e3, 2)
+        detail["serve_qps"] = round(n_threads * per_thread / wall, 1)
+        detail["serve_gate_passed"] = bool(p50 * 1e3 < 10.0)  # BASELINE north-star
+    finally:
+        server.stop()
+
+
+def stage_cold(base_dir, out_path):
+    from predictionio_tpu.data.storage import EventColumns, set_storage
+    from predictionio_tpu.ops.als import predict_rmse
+    from predictionio_tpu.parallel.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    n_users, n_items, n_ratings, rank, iterations = knobs()
     detail = {"n_users": n_users, "n_items": n_items, "n_ratings": n_ratings,
               "rank": rank, "iterations": iterations}
     rng = np.random.default_rng(0)
+
+    t0 = time.perf_counter()
+    uu, ii, vals = synthesize(n_users, n_items, n_ratings, rng)
+    cols = EventColumns(
+        entity_codes=uu.astype(np.int32),
+        target_codes=ii.astype(np.int32),
+        name_codes=np.zeros(n_ratings, np.int32),
+        values=vals,
+        times_us=np.arange(n_ratings, dtype=np.int64) * 1_000_000,
+        entity_vocab=[f"u{i}" for i in range(n_users)],
+        target_vocab=[f"i{i}" for i in range(n_items)],
+        names=["rate"],
+    )
+    detail["synth_sec"] = round(time.perf_counter() - t0, 2)
+
+    storage = _storage(base_dir)
+    app = storage.apps().insert("bench")
+    storage.events().init(app.id)
+
+    t0 = time.perf_counter()
+    storage.events().insert_columnar(
+        cols, app.id, entity_type="user", target_entity_type="item",
+        value_property="rating",
+    )
+    ingest_sec = time.perf_counter() - t0
+    detail["ingest_sec"] = round(ingest_sec, 2)
+    detail["ingest_events_per_sec"] = round(n_ratings / ingest_sec, 1)
+
+    # row-path write rate, sampled (the per-request API the event
+    # server uses for live traffic). Timed in two phases: building the
+    # Event objects (the handler's job, from parsed JSON — hence plain
+    # python values below) and the DAO insert_batch append itself.
+    sample = min(100_000, n_ratings)
+    import datetime as dt
+
+    from predictionio_tpu.data.event import Event
+
+    uu_py, ii_py = uu[:sample].tolist(), ii[:sample].tolist()
+    vals_py = vals[:sample].tolist()
+    epoch = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+    second = dt.timedelta(seconds=1)
+    t0 = time.perf_counter()
+    events = [
+        Event(event="rate", entity_type="user", entity_id=f"u{uu_py[k]}",
+              target_entity_type="item", target_entity_id=f"i{ii_py[k]}",
+              properties={"rating": vals_py[k]},
+              event_time=epoch + k * second)
+        for k in range(sample)
+    ]
+    t1 = time.perf_counter()
+    storage.events().insert_batch(events, app.id)
+    t2 = time.perf_counter()
+    detail["event_build_events_per_sec"] = round(sample / (t1 - t0), 1)
+    detail["insert_batch_events_per_sec"] = round(sample / (t2 - t1), 1)
+    detail["row_lane_events_per_sec"] = round(sample / (t2 - t0), 1)
+
+    trainer, pd, ho, train_coo, cfg, train_sec = _read_prepare_bin_train(
+        detail, n_ratings + sample
+    )
+    factors = trainer.factors()
+
+    # quality gates (baseline: the global-mean predictor fit on train)
+    rmse = predict_rmse(factors, ho)
+    base_rmse = float(np.sqrt(np.mean((ho[2] - train_coo[2].mean()) ** 2)))
+    detail["rmse_heldout"] = round(rmse, 4)
+    detail["rmse_global_mean_baseline"] = round(base_rmse, 4)
+    detail["rmse_gate_passed"] = bool(rmse <= 0.85 * base_rmse)
+    at_default = knobs() == DEFAULT_KNOBS
+    detail["rmse_band"] = list(RMSE_BAND) if at_default else None
+    detail["rmse_band_passed"] = (
+        bool(RMSE_BAND[0] <= rmse <= RMSE_BAND[1]) if at_default else True
+    )
+
+    effective = (trainer.kept_user_entries + trainer.kept_item_entries) / 2
+    assert int(effective) == len(train_coo[2]), (effective, len(train_coo[2]))
+    detail["updates_per_sec"] = round(effective * iterations / train_sec, 1)
+    detail["roofline"] = _roofline(trainer, train_sec, iterations)
+    # release the trainer's HBM before the serving deployment compiles
+    del trainer
+
+    _serve_stage(storage, factors, pd, cfg, detail)
+
+    set_storage(None)
+    with open(out_path, "w") as f:
+        json.dump(detail, f)
+
+
+def stage_warm(base_dir, out_path):
+    """Fresh process, same store + same compilation cache: the repeat
+    events->model path every retrain / deploy / reload pays."""
+    from predictionio_tpu.data.storage import set_storage
+    from predictionio_tpu.parallel.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    n_users, n_items, n_ratings, _, _ = knobs()
+    sample = min(100_000, n_ratings)
+    _storage(base_dir)
+    detail = {}
+    _read_prepare_bin_train(detail, n_ratings + sample)
+    set_storage(None)
+    with open(out_path, "w") as f:
+        json.dump(detail, f)
+
+
+def orchestrate():
+    """Parent: never touches JAX (the chip is exclusive per process);
+    runs the two stages as children sharing one store + compile cache."""
     base_dir = tempfile.mkdtemp(prefix="pio_bench_")
+    env = dict(os.environ)
+    env["PIO_COMPILE_CACHE_DIR"] = os.path.join(base_dir, "compile_cache")
     try:
-        # -- synth ----------------------------------------------------------
-        t0 = time.perf_counter()
-        uu, ii, vals = synthesize(n_users, n_items, n_ratings, rng)
-        cols = EventColumns(
-            entity_codes=uu.astype(np.int32),
-            target_codes=ii.astype(np.int32),
-            name_codes=np.zeros(n_ratings, np.int32),
-            values=vals,
-            times_us=np.arange(n_ratings, dtype=np.int64) * 1_000_000,
-            entity_vocab=[f"u{i}" for i in range(n_users)],
-            target_vocab=[f"i{i}" for i in range(n_items)],
-            names=["rate"],
-        )
-        detail["synth_sec"] = round(time.perf_counter() - t0, 2)
+        stages = {}
+        for stage in ("cold", "warm"):
+            out = os.path.join(base_dir, f"{stage}.json")
+            # child stdout -> our stderr: the stdout contract is ONE line
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--stage", stage, "--base", base_dir, "--out", out],
+                env=env, stdout=sys.stderr, stderr=sys.stderr,
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(f"bench {stage} stage failed "
+                                   f"(rc {proc.returncode})")
+            with open(out) as f:
+                stages[stage] = json.load(f)
 
-        # -- ingest (storage write path, native eventlog) -------------------
-        storage = Storage.from_env({
-            "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
-            "PIO_STORAGE_SOURCES_EL_PATH": base_dir,
-            **{f"PIO_STORAGE_REPOSITORIES_{r}_{k}": v
-               for r in ("METADATA", "EVENTDATA", "MODELDATA")
-               for k, v in (("NAME", r.lower()), ("SOURCE", "EL"))},
-        })
-        set_storage(storage)
-        app = storage.apps().insert("bench")
-        storage.events().init(app.id)
-
-        t0 = time.perf_counter()
-        storage.events().insert_columnar(
-            cols, app.id, entity_type="user", target_entity_type="item",
-            value_property="rating",
-        )
-        ingest_sec = time.perf_counter() - t0
-        detail["ingest_sec"] = round(ingest_sec, 2)
-        detail["ingest_events_per_sec"] = round(n_ratings / ingest_sec, 1)
-
-        # row-path write rate, sampled (the per-request API the event
-        # server uses; full 20M through Python Event objects would add
-        # ~10 min of pure object churn to every bench run)
-        sample = min(100_000, n_ratings)
-        from predictionio_tpu.data.event import Event
-        import datetime as dt
-
-        t0 = time.perf_counter()
-        epoch = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
-        events = [
-            Event(event="rate", entity_type="user", entity_id=f"u{uu[k]}",
-                  target_entity_type="item", target_entity_id=f"i{ii[k]}",
-                  properties={"rating": float(vals[k])},
-                  event_time=epoch + dt.timedelta(seconds=int(k)))
-            for k in range(sample)
-        ]
-        storage.events().insert_batch(events, app.id)
-        detail["insert_batch_events_per_sec"] = round(
-            sample / (time.perf_counter() - t0), 1
-        )
-        extra_rows = sample  # the sampled rows are real events in the log
-
-        # -- read (the DataSource the recommendation template ships) --------
-        ctx = MeshContext()
-        ds = RecoDataSource(RecoDataSourceParams(app_name="bench"))
-        t0 = time.perf_counter()
-        td = ds.read_training(ctx)
-        read_sec = time.perf_counter() - t0
-        detail["read_sec"] = round(read_sec, 2)
-        n_read = len(td.columns.ratings)
-        assert n_read == n_ratings + extra_rows, (n_read, n_ratings, extra_rows)
-
-        # -- prepare (BiMap string-id indexing) ------------------------------
-        t0 = time.perf_counter()
-        pd = RecoPreparator(None).prepare(ctx, td)
-        detail["prepare_sec"] = round(time.perf_counter() - t0, 2)
-
-        # -- held-out split for the quality gate -----------------------------
-        hold = np.arange(n_read) % 20 == 0   # 5%
-        tr_u, tr_i, tr_r = pd.user_idx[~hold], pd.item_idx[~hold], pd.ratings[~hold]
-        ho = (pd.user_idx[hold], pd.item_idx[hold], pd.ratings[hold])
-        n_train = len(tr_r)
-
-        # -- bin + place + compile (one-time costs) --------------------------
-        cfg = ALSConfig(rank=rank, iterations=iterations, reg=0.05,
-                        block_size=4096)
-        t0 = time.perf_counter()
-        trainer = ALSTrainer((tr_u, tr_i, tr_r), len(pd.user_ids),
-                             len(pd.item_ids), cfg)
-        trainer.compile()
-        detail["bin_compile_sec"] = round(time.perf_counter() - t0, 2)
-
-        # -- train (timed region: pure device work) --------------------------
-        t0 = time.perf_counter()
-        trainer.step_n(iterations)
-        train_sec = time.perf_counter() - t0
-        factors = trainer.factors()
-        detail["train_sec"] = round(train_sec, 2)
-
-        # -- quality gate -----------------------------------------------------
-        rmse = predict_rmse(factors, ho)
-        base_rmse = float(np.sqrt(np.mean((ho[2] - tr_r.mean()) ** 2)))
-        gate = rmse <= 0.85 * base_rmse
-        detail["rmse_heldout"] = round(rmse, 4)
-        detail["rmse_global_mean_baseline"] = round(base_rmse, 4)
-        detail["rmse_gate_passed"] = bool(gate)
-
-        # -- headline + honest accounting ------------------------------------
-        effective = (trainer.kept_user_entries + trainer.kept_item_entries) / 2
-        assert int(effective) == n_train, (effective, n_train)
-        value = effective * iterations / train_sec if gate else 0.0
-        e2e_sec = read_sec + detail["prepare_sec"] + detail["bin_compile_sec"] + train_sec
-        detail["events_to_model_sec"] = round(e2e_sec, 2)
-        detail["events_to_model_events_per_sec"] = round(n_read / e2e_sec, 1)
+        detail = stages["cold"]
+        detail["warm"] = stages["warm"]
+        gates = (detail["rmse_gate_passed"] and detail["rmse_band_passed"]
+                 and detail["serve_gate_passed"])
+        value = detail.pop("updates_per_sec") if gates else 0.0
         detail["baseline_proxy"] = {
             "value": 1e6,
             "unit": "ratings*iters/sec",
@@ -203,8 +470,21 @@ def main() -> None:
             "detail": detail,
         }))
     finally:
-        set_storage(None)
         shutil.rmtree(base_dir, ignore_errors=True)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--stage", choices=["cold", "warm"])
+    parser.add_argument("--base")
+    parser.add_argument("--out")
+    args = parser.parse_args()
+    if args.stage == "cold":
+        stage_cold(args.base, args.out)
+    elif args.stage == "warm":
+        stage_warm(args.base, args.out)
+    else:
+        orchestrate()
 
 
 if __name__ == "__main__":

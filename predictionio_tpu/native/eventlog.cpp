@@ -678,7 +678,13 @@ int64_t append_packed(Log* log, const uint8_t* buf, uint64_t nbytes, int64_t n,
 // writing anything (all-or-nothing). Returns records appended, or -1.
 // The append is durable even if the subsequent remap fails (the handle
 // then reports errors on reads until reopened, rather than crashing).
-int64_t el_append_batch(void* h, const uint8_t* buf, uint64_t nbytes) {
+// ``fresh_ids`` != 0 asserts every id in the batch was freshly
+// generated by the caller (the event server's normal live lane):
+// collision with an existing id is impossible, so the append uses the
+// lazy id index — no per-row by_id insert and, crucially, no paying of
+// a 20M-record lazy-indexing debt left by a columnar bulk ingest.
+int64_t el_append_batch(void* h, const uint8_t* buf, uint64_t nbytes,
+                        int32_t fresh_ids) {
   Log* log = static_cast<Log*>(h);
   // validation pass (no lock needed; reads only the input)
   uint64_t off = 0;
@@ -693,7 +699,7 @@ int64_t el_append_batch(void* h, const uint8_t* buf, uint64_t nbytes) {
     off += 4 + len;
     ++n;
   }
-  return append_packed(log, buf, nbytes, n);
+  return append_packed(log, buf, nbytes, n, fresh_ids != 0);
 }
 
 int el_delete(void* h, const uint8_t* id16) {

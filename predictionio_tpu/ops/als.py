@@ -374,6 +374,20 @@ class ALSTrainer:
             self._run_cache[n] = fn
         return fn
 
+    def wait_device(self) -> "ALSTrainer":
+        """Block until the binned arrays are resident on device.
+
+        Device puts are async: on a tunneled/remote backend the bulk
+        transfer (~GBs at ML-20M scale) otherwise completes inside the
+        FIRST execution, silently attributing transfer time to compile.
+        Reading one element of each buffer is the reliable barrier here
+        (block_until_ready can return early on tunneled backends — see
+        _force)."""
+        for arrs in (self._ud, self._it):
+            for a in arrs:
+                jax.device_get(a[(0,) * a.ndim])
+        return self
+
     def compile(self) -> "ALSTrainer":
         """Warm the default-iteration-count program (bench warm-up).
 
@@ -407,6 +421,43 @@ class ALSTrainer:
             user_factors=_materialize(self._X)[: self.n_users],
             item_factors=_materialize(self._Y)[: self.n_items],
         )
+
+    def work_model(self) -> dict:
+        """Analytic FLOP/byte counts per full alternation (both half
+        steps), from the ACTUAL padded array shapes on device — the
+        basis for the benchmark's roofline accounting (achieved vs chip
+        peak). Padded slots count: they cost real gather issue slots,
+        MXU cycles and HBM beats.
+
+        The byte model counts the dominant streams of `_solve_shard`:
+        gather-read of the opposing factors, the materialized [B, L, K]
+        block (one write + one einsum read), idx/val/mask input reads,
+        per-row partial Gramians (f32 write + segment-sum read), and
+        the CG solve re-reading A each iteration (cg_dtype). It is an
+        UNDER-estimate of true traffic (ignores fusion-dependent
+        intermediates), so achieved-bandwidth derived from it is a
+        lower bound.
+        """
+        K = self.cfg.rank
+        cs = jnp.dtype(self.cfg.compute_dtype).itemsize
+        cg_b = jnp.dtype(self.cfg.cg_dtype).itemsize
+        cg_iters = self.cfg.cg_iters if self.cfg.solver == "cg" else 0
+        flops = 0.0
+        bytes_ = 0.0
+        for side, n_groups in ((self._ud, self._g_users), (self._it, self._g_items)):
+            idx = side[0]
+            S = float(idx.shape[0]) * float(idx.shape[1])  # slots incl. pad
+            G = float(n_groups)
+            flops += 2.0 * S * K * K          # partial Gramians (MXU)
+            flops += 2.0 * S * K              # rhs
+            flops += (cg_iters + 1) * 2.0 * G * K * K  # CG matvecs
+            bytes_ += S * K * cs              # factor gather read
+            bytes_ += 2.0 * S * K * cs        # materialized Yg write+read
+            bytes_ += S * (4 + 4 + 1)         # idx/val/mask reads
+            bytes_ += 2.0 * float(idx.shape[0]) * K * K * 4  # partials w+r
+            bytes_ += (cg_iters + 1) * G * K * K * cg_b      # CG A re-reads
+            bytes_ += G * K * 4               # solved factors write
+        return {"flops_per_iter": flops, "hbm_bytes_per_iter": bytes_}
 
 
 def als_train(

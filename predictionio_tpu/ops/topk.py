@@ -1,4 +1,4 @@
-"""HBM-resident top-k scoring for serving.
+"""Top-k scoring for serving, with latency-aware placement.
 
 Serve-path design (SURVEY.md §7.5): model factors stay resident on the
 device; a query is one embedding-row lookup plus a [1, K] x [K, I]
@@ -8,6 +8,19 @@ is ALSModel.recommendProducts' driver-side dot-product scan
 (MLlib MatrixFactorizationModel, used by
 examples/scala-parallel-recommendation templates).
 
+Placement policy: a single-user query against a modest catalog is a
+few-MFLOP matvec — microseconds of compute — so its latency is pure
+dispatch overhead. On a locally-attached chip that overhead is ~100us
+and the device path wins outright; on a remote/tunneled backend it can
+be tens of ms, at which point the HOST path (numpy matvec + partial
+sort, exactly the reference's driver-side scan) is orders of magnitude
+faster. ``TopKScorer`` measures the backend's per-dispatch latency
+once per process and routes EACH call by modeled cost (batch x catalog
+FLOPs vs dispatch floor): big batches and big catalogs go to the MXU,
+tiny lone queries go wherever they're actually fastest. Override with
+PIO_SERVE_PLACEMENT=device|host|auto. Catalogs beyond one chip's HBM
+use the sharded scorer (make_sharded_topk), device-only by nature.
+
 Batched variants score many users at once (evaluation batchPredict and
 micro-batched serving).
 """
@@ -15,6 +28,8 @@ micro-batched serving).
 from __future__ import annotations
 
 import functools
+import os
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -22,6 +37,33 @@ import jax.numpy as jnp
 import numpy as np
 
 NEG_INF = jnp.float32(-1e30)
+
+# assumed host throughput for the routing cost model (conservative
+# single-core sgemv); only the CROSSOVER matters, not the estimate's
+# absolute accuracy, so order-of-magnitude is enough
+_HOST_FLOPS = 5e9
+_DEVICE_FLOPS = 5e13
+
+_dispatch_latency: Optional[float] = None
+
+
+def measured_dispatch_latency() -> float:
+    """Seconds for one tiny jit dispatch + scalar readback on the
+    default backend — the serving latency floor of the DEVICE path.
+    Measured once per process (a locally-attached TPU sits at ~1e-4,
+    a tunneled development backend at ~1e-1)."""
+    global _dispatch_latency
+    if _dispatch_latency is None:
+        f = jax.jit(lambda a: a.sum())
+        x = jnp.zeros((8, 128), jnp.float32)
+        float(f(x))  # compile outside the timed region
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(f(x))
+            best = min(best, time.perf_counter() - t0)
+        _dispatch_latency = best
+    return _dispatch_latency
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
@@ -108,11 +150,71 @@ class TopKScorer:
     batch size are bucketed to powers of two (exclusions capped at
     ``max_exclude``) so arbitrary per-request values hit a handful of
     compiled shapes instead of retracing per novel (B, E, k).
+
+    ``placement``: "device", "host", or "auto" (default, overridable
+    via PIO_SERVE_PLACEMENT) — see the module docstring. "auto" routes
+    per CALL: the device path needs batch*catalog FLOPs large enough to
+    amortize the measured dispatch floor, otherwise the host matvec
+    answers in microseconds.
     """
 
-    def __init__(self, item_factors: np.ndarray, max_exclude: int = 64):
-        self.item_factors = jnp.asarray(item_factors, dtype=jnp.float32)
+    def __init__(self, item_factors: np.ndarray, max_exclude: int = 64,
+                 placement: Optional[str] = None):
+        self.placement = (placement
+                          or os.environ.get("PIO_SERVE_PLACEMENT", "auto"))
+        if self.placement not in ("auto", "device", "host"):
+            raise ValueError(f"bad placement {self.placement!r}")
+        self._host_factors = np.asarray(item_factors, dtype=np.float32)
+        # device copy made lazily: a host-routed deployment never pays
+        # HBM for the catalog
+        self._device_factors: Optional[jax.Array] = None
         self.max_exclude = max_exclude
+
+    @property
+    def item_factors(self) -> jax.Array:
+        if self._device_factors is None:
+            self._device_factors = jnp.asarray(self._host_factors)
+        return self._device_factors
+
+    def _route(self, batch: int) -> str:
+        if self.placement != "auto":
+            return self.placement
+        n_items, rank = self._host_factors.shape
+        flops = 2.0 * batch * n_items * rank
+        host_est = flops / _HOST_FLOPS + batch * n_items * 1e-9  # + partial sort
+        device_est = measured_dispatch_latency() + flops / _DEVICE_FLOPS
+        return "host" if host_est < device_est else "device"
+
+    @staticmethod
+    def _host_topk(scores: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Partial-sort top-k over host scores [B, I] -> ([B,k], [B,k])."""
+        n_items = scores.shape[1]
+        k = min(k, n_items)
+        if k < n_items:
+            part = np.argpartition(-scores, k - 1, axis=1)[:, :k]
+        else:
+            part = np.broadcast_to(np.arange(n_items), scores.shape).copy()
+        part_scores = np.take_along_axis(scores, part, axis=1)
+        order = np.argsort(-part_scores, axis=1)
+        idx = np.take_along_axis(part, order, axis=1)
+        return np.take_along_axis(part_scores, order, axis=1), idx
+
+    def _score_host(self, user_vecs, k, exclude_idx):
+        """The reference's driver-side scan (MatrixFactorizationModel
+        .recommendProducts), vectorized: matvec + argpartition. Same
+        contract as the device path, including the max_exclude cap."""
+        uv = np.atleast_2d(np.asarray(user_vecs, dtype=np.float32))
+        scores = uv @ self._host_factors.T             # [B, I]
+        if exclude_idx is not None:
+            excl = np.asarray(exclude_idx, dtype=np.int64)
+            if excl.ndim == 1:
+                excl = np.broadcast_to(excl, (uv.shape[0], excl.shape[0]))
+            excl = excl[:, -self.max_exclude:]
+            rows = np.repeat(np.arange(uv.shape[0]), excl.shape[1])
+            cols = excl.reshape(-1)
+            keep = cols >= 0
+            scores[rows[keep], cols[keep]] = float(NEG_INF)
+        return self._host_topk(scores, k)
 
     def score(
         self,
@@ -126,6 +228,9 @@ class TopKScorer:
         first) — callers needing exact long blacklists should filter
         host-side on the returned ranking.
         """
+        B_in = np.atleast_2d(np.asarray(user_vecs)).shape[0]
+        if self._route(B_in) == "host":
+            return self._score_host(user_vecs, k, exclude_idx)
         user_vecs, exclude_idx, k, k_bucket, B = _prepare_score_inputs(
             user_vecs, k, exclude_idx, self.item_factors.shape[0],
             self.max_exclude)
@@ -146,6 +251,14 @@ class TopKScorer:
         make the top-k (fewer candidates than k) come back with score
         <= NEG_INF — callers drop them by score threshold.
         """
+        B_in = np.atleast_2d(np.asarray(user_vecs)).shape[0]
+        if self._route(B_in) == "host":
+            uv = np.atleast_2d(np.asarray(user_vecs, dtype=np.float32))
+            scores = uv @ self._host_factors.T
+            m = np.asarray(mask, dtype=bool)
+            scores = np.where(m if m.ndim == 2 else m[None, :],
+                              scores, float(NEG_INF))
+            return self._host_topk(scores, k)
         user_vecs = jnp.atleast_2d(jnp.asarray(user_vecs, dtype=jnp.float32))
         B = user_vecs.shape[0]
         b_bucket = _pow2_bucket(B, 1, 1 << 30)

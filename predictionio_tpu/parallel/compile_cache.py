@@ -1,0 +1,72 @@
+"""Persistent XLA compilation cache.
+
+Everything under ``jit`` is traced once and compiled; at ML-20M scale
+the ALS training program costs ~30s of XLA compile — paid, without this
+module, on EVERY train, deploy warm-up, and ``/reload``. The framework's
+fixed-shape bucketing discipline (ops/ragged.py) exists precisely so
+that repeat runs produce byte-identical programs; this module makes
+that pay off by caching compiled executables on disk, keyed by program
+fingerprint, so warm trains skip XLA entirely.
+
+The reference has no analogue (Spark jobs are interpreted JVM code);
+this is a TPU-economics subsystem: compile time is the TPU world's
+job-startup tax, as JVM spin-up + jar shipping is Spark's
+(SURVEY.md §3.1 runtime notes).
+
+Config:
+  PIO_COMPILE_CACHE_DIR  cache directory (default
+                         $PIO_FS_BASEDIR/compile_cache, i.e. the same
+                         home the localfs storage tier uses)
+  PIO_COMPILE_CACHE=0    disable
+
+Multi-process safe: JAX writes entries atomically (temp + rename), so
+N trainers sharing one cache dir (e.g. over NFS) only ever read
+complete entries; concurrent writers of the same key are idempotent.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_enabled_dir: Optional[str] = None
+
+
+def cache_dir_default() -> str:
+    base = os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store"))
+    return os.path.join(base, "compile_cache")
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at the PIO home.
+
+    Idempotent; returns the active cache directory (None when disabled
+    via PIO_COMPILE_CACHE=0 or on failure — the framework must run
+    without a writable home, just slower).
+    """
+    global _enabled_dir
+    if os.environ.get("PIO_COMPILE_CACHE", "1") == "0":
+        return None
+    if _enabled_dir is not None:
+        return _enabled_dir
+    path = (cache_dir or os.environ.get("PIO_COMPILE_CACHE_DIR")
+            or cache_dir_default())
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        # the default 1s floor skips small serving/eval programs whose
+        # recompiles still dominate /reload latency; cache everything
+        # that took meaningful compile time
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # noqa: BLE001 — cache is an optimization
+        log.warning("persistent compilation cache unavailable: %s", e)
+        return None
+    _enabled_dir = path
+    log.info("persistent compilation cache at %s", path)
+    return path

@@ -41,6 +41,12 @@ def create_mesh(
     pure DP, the layout matching the reference's Spark data parallelism
     (SURVEY.md §2.9).
     """
+    # every training/serving path builds a mesh before compiling; hook
+    # the persistent executable cache here so repeat programs (fixed
+    # shapes by design) skip XLA across processes
+    from predictionio_tpu.parallel.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     axes = dict(axes or {"data": -1, "model": 1})

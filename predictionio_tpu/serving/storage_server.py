@@ -147,11 +147,6 @@ def _encode_result(value: Any, kind: str) -> Any:
 class StorageRequestHandler(JSONRequestHandler):
     """Dispatch /storage/* to the wrapped Storage's DAOs."""
 
-    # Transfer-Encoding: chunked (the NDJSON find stream) is an
-    # HTTP/1.1-only construct; every non-streaming response carries
-    # Content-Length via _send, so persistent connections are safe —
-    # and bulk clients get connection reuse for free.
-    protocol_version = "HTTP/1.1"
 
     # -- auth ---------------------------------------------------------------
     def _authorized(self) -> bool:

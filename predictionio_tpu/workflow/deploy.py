@@ -98,6 +98,9 @@ def prepare_deploy(
     storage: Optional[Storage] = None,
 ) -> Deployment:
     """ref: Engine.prepareDeploy:174."""
+    from predictionio_tpu.parallel.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()  # deploy warm-ups reuse cached executables
     storage = storage or get_storage()
     ctx = ctx or MeshContext()
     engine_params = engine_params_from_instance(engine, instance)

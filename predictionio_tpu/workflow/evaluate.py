@@ -49,6 +49,9 @@ def run_evaluation(
     use_fast_eval: bool = True,
 ) -> MetricEvaluatorResult:
     """ref: CoreWorkflow.runEvaluation:96. Returns the evaluator result."""
+    from predictionio_tpu.parallel.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     storage = storage or get_storage()
     ctx = ctx or MeshContext()
     evaluator = evaluator or MetricEvaluator()

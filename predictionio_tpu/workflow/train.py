@@ -106,9 +106,11 @@ def run_train(
     """ref: CoreWorkflow.runTrain:42. Returns the COMPLETED instance."""
     # multi-host opt-in: PIO_COORDINATOR_ADDRESS brings up jax.distributed
     # before any mesh is built, so ctx meshes span all hosts (§7.9)
+    from predictionio_tpu.parallel.compile_cache import enable_persistent_cache
     from predictionio_tpu.parallel.multihost import initialize_from_env
 
     initialize_from_env()
+    enable_persistent_cache()
     storage = storage or get_storage()
     ctx = ctx or MeshContext()
     wp = workflow_params or WorkflowParams()
