@@ -405,6 +405,10 @@ def stage_cold(base_dir, out_path):
 
     _serve_stage(storage, factors, pd, cfg, detail)
 
+    # clean close persists the eventlog index snapshot, so the warm
+    # stage's open skips the full-log replay (production parity: servers
+    # close their stores on shutdown)
+    storage.events().close()
     set_storage(None)
     with open(out_path, "w") as f:
         json.dump(detail, f)

@@ -71,6 +71,11 @@ def _load():
     ]
     lib.el_delete.restype = ctypes.c_int
     lib.el_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.el_compact.restype = ctypes.c_int64
+    lib.el_compact.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ]
     lib.el_get.restype = ctypes.c_int64
     lib.el_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
     lib.el_find.restype = ctypes.c_int64
@@ -562,6 +567,24 @@ class EventLogEventStore(S.EventStore):
                 )
             total += m
         return total
+
+    def compact(self, app_id, channel_id=None) -> Dict[str, int]:
+        """Rewrite the log keeping only live records: reclaims the space
+        of $delete'd / superseded events and persists a fresh index
+        snapshot (the role of an HBase major compaction — delete markers
+        and shadowed cells physically removed). Returns
+        {"dropped", "before_bytes", "after_bytes"}."""
+        h = self._handle(app_id, channel_id)
+        before = ctypes.c_uint64()
+        after = ctypes.c_uint64()
+        dropped = self._lib.el_compact(h, ctypes.byref(before), ctypes.byref(after))
+        if dropped < 0:
+            raise S.StorageError("compaction failed in native event log")
+        return {
+            "dropped": int(dropped),
+            "before_bytes": int(before.value),
+            "after_bytes": int(after.value),
+        }
 
     def close(self) -> None:
         with self._lock:

@@ -144,6 +144,11 @@ class RestEventStore(S.EventStore):
     def remove(self, app_id, channel_id=None):
         self._call("remove", app_id, channel_id)
 
+    def compact(self, app_id, channel_id=None):
+        # runs ON the storage server, against its local backend; None
+        # when that backend stores events in place
+        return self._call("compact", app_id, channel_id)["stats"]
+
     def insert(self, event: Event, app_id, channel_id=None) -> str:
         out = self._call("insert", app_id, channel_id,
                          event=event.to_dict(api_format=False))

@@ -313,6 +313,12 @@ class EventStore(abc.ABC):
             self.insert_batch(events, app_id, channel_id)
         return n
 
+    def compact(self, app_id: int, channel_id: Optional[int] = None):
+        """Reclaim space held by deleted/superseded events (the HBase
+        major-compaction role). Backends without physical garbage (the
+        in-place stores) return None; the native eventlog overrides."""
+        return None
+
     def aggregate_properties(
         self,
         app_id: int,

@@ -66,6 +66,23 @@ namespace {
 constexpr uint32_t kHeaderLen = 46;  // bytes after record_len, before strings
 constexpr uint16_t kAbsent = 0xFFFF;
 constexpr uint64_t kMapChunk = 64ULL << 20;  // mapping granularity
+// index snapshot (see write_index_snapshot): rewritten on close and
+// after every kSnapshotInterval of appended bytes, so reopening a 20M-
+// event log costs one sequential array read + a short suffix replay
+// instead of re-parsing the whole log (the open-cost complaint HBase
+// answers with persistent region indexes)
+constexpr uint64_t kSnapshotInterval = 1ULL << 30;
+constexpr uint32_t kIndexMagic = 0x58494C45;  // "ELIX"
+constexpr uint32_t kIndexVersion = 2;
+// Compaction commit protocol: log+tombstones for generation N live in
+// log.<N>.bin / tombstones.<N>.bin (generation 0 keeps the legacy
+// names log.bin / tombstones.bin). The CURRENT file names the active
+// generation; el_compact writes the next generation's files, then
+// commits by atomically renaming CURRENT — so a crash at ANY point
+// leaves a consistent (old or new) generation, never a compacted log
+// paired with stale tombstone cutoffs that could mask relocated live
+// records. Orphaned files from aborted compactions are removed on
+// open (safe under the flock).
 
 inline uint64_t fnv1a(const uint8_t* data, size_t n, uint64_t h = 1469598103934665603ULL) {
   for (size_t i = 0; i < n; ++i) {
@@ -133,7 +150,10 @@ struct Log {
   int fd = -1;
   int tomb_fd = -1;
   int lock_fd = -1;
+  std::string dir;
+  uint64_t generation = 0;        // compaction generation (see CURRENT)
   uint64_t file_size = 0;
+  uint64_t snapshot_covered = 0;  // log bytes covered by index.bin
   uint8_t* map = nullptr;
   uint64_t map_size = 0;
   bool broken = false;  // mapping failed after a durable append; reads error
@@ -142,6 +162,10 @@ struct Log {
   std::unordered_map<std::string, uint64_t> tombs;  // id -> max cutoff offset
   bool has_dupes = false;  // an id was ever re-inserted; scans must
                            // consult by_id for liveness when set
+  bool needs_id_verify = false;  // records were replayed past an index
+                                 // snapshot after an unclean shutdown:
+                                 // their dupe status is unknown until
+                                 // ensure_id_index runs once
   // records appended via el_append_columnar carry fresh random ids, so
   // they are indexed lazily: by_id covers recs[0, indexed_upto) and is
   // completed on demand by el_get/el_delete (ensure_id_index). A bulk
@@ -154,7 +178,9 @@ struct Log {
   // scans skip the per-record by_id lookup (the dominant cost of a
   // 20M-row scan — one random DRAM access per record otherwise).
   // Unindexed records are fresh-id columnar appends — never dupes.
-  bool all_live() const { return tombs.empty() && !has_dupes; }
+  bool all_live() const {
+    return tombs.empty() && !has_dupes && !needs_id_verify;
+  }
 
   ~Log() {
     if (map) munmap(map, map_size);
@@ -239,6 +265,7 @@ struct Log {
       }
     }
     indexed_upto = recs.size();
+    needs_id_verify = false;  // dupe status now exact
   }
 };
 
@@ -535,6 +562,180 @@ struct DictEncoder {
   }
 };
 
+// ---------------------------------------------------------------------------
+// persisted index snapshot: header + the raw RecMeta array. A local
+// cache file (same-machine, same-build reader — sizeof(RecMeta) is
+// checked), written atomically via tmp+rename. by_id is NOT persisted:
+// it is rebuilt lazily (ensure_id_index) only when an id-keyed
+// operation or a non-all-live scan needs it; the all-live fast path —
+// bulk training reads — never does.
+// ---------------------------------------------------------------------------
+
+struct IndexHeader {
+  uint32_t magic;
+  uint32_t version;
+  uint32_t recmeta_size;
+  uint8_t has_dupes;
+  uint8_t pad[3];
+  uint64_t generation;
+  uint64_t covered_bytes;
+  uint64_t n_recs;
+  uint64_t checksum;  // fnv1a over the RecMeta array bytes
+};
+
+bool write_all(int fd, const void* data, uint64_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  uint64_t w = 0;
+  while (w < n) {
+    ssize_t r = write(fd, p + w, n - w);
+    if (r < 0) return false;
+    w += static_cast<uint64_t>(r);
+  }
+  return true;
+}
+
+std::string log_path_for(const std::string& dir, uint64_t gen) {
+  return gen == 0 ? dir + "/log.bin"
+                  : dir + "/log." + std::to_string(gen) + ".bin";
+}
+
+std::string tomb_path_for(const std::string& dir, uint64_t gen) {
+  return gen == 0 ? dir + "/tombstones.bin"
+                  : dir + "/tombstones." + std::to_string(gen) + ".bin";
+}
+
+// active generation: contents of <dir>/CURRENT (absent -> 0)
+uint64_t read_generation(const std::string& dir) {
+  FILE* f = fopen((dir + "/CURRENT").c_str(), "r");
+  if (!f) return 0;
+  unsigned long long gen = 0;
+  int n = fscanf(f, "%llu", &gen);
+  fclose(f);
+  return n == 1 ? static_cast<uint64_t>(gen) : 0;
+}
+
+// atomically commit a new generation; returns false (leaving the old
+// generation active) on any failure
+bool commit_generation(const std::string& dir, uint64_t gen) {
+  std::string tmp = dir + "/CURRENT.tmp";
+  int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return false;
+  std::string body = std::to_string(gen) + "\n";
+  bool ok = write_all(fd, body.data(), body.size()) && fdatasync(fd) == 0;
+  close(fd);
+  if (!ok || rename(tmp.c_str(), (dir + "/CURRENT").c_str()) != 0) {
+    unlink(tmp.c_str());
+    return false;
+  }
+  return true;
+}
+
+// remove log/tombstone files of other generations (aborted compactions
+// or superseded generations); caller holds the flock
+void remove_orphan_generations(const std::string& dir, uint64_t keep_gen) {
+  for (uint64_t g = 0; g <= keep_gen + 1; ++g) {
+    if (g == keep_gen) continue;
+    unlink(log_path_for(dir, g).c_str());
+    unlink(tomb_path_for(dir, g).c_str());
+  }
+}
+
+// caller holds the exclusive lock
+bool write_index_snapshot(Log* log) {
+  // the header's has_dupes must be exact — resolve any post-crash
+  // lazily-replayed region before persisting it
+  if (log->needs_id_verify) log->ensure_id_index();
+  std::string tmp = log->dir + "/index.bin.tmp";
+  std::string final_path = log->dir + "/index.bin";
+  int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return false;
+  IndexHeader hdr{};
+  hdr.magic = kIndexMagic;
+  hdr.version = kIndexVersion;
+  hdr.recmeta_size = sizeof(RecMeta);
+  hdr.has_dupes = log->has_dupes ? 1 : 0;
+  hdr.generation = log->generation;
+  hdr.covered_bytes = log->file_size;
+  hdr.n_recs = log->recs.size();
+  hdr.checksum = fnv1a(reinterpret_cast<const uint8_t*>(log->recs.data()),
+                       sizeof(RecMeta) * log->recs.size());
+  bool ok = write_all(fd, &hdr, sizeof(hdr)) &&
+            write_all(fd, log->recs.data(), sizeof(RecMeta) * log->recs.size());
+  if (ok) ok = fdatasync(fd) == 0;
+  close(fd);
+  if (!ok || rename(tmp.c_str(), final_path.c_str()) != 0) {
+    unlink(tmp.c_str());
+    return false;
+  }
+  log->snapshot_covered = log->file_size;
+  return true;
+}
+
+// loads recs/has_dupes from index.bin when it matches this log; returns
+// the number of log bytes covered (0 = no usable snapshot, replay all).
+// A corrupt/stale cache file must DEGRADE (full replay), never crash or
+// poison the index: the header is bounds-checked against the index
+// file's own size before any allocation, the array is checksummed, and
+// the record chain is verified contiguous over [0, covered_bytes).
+uint64_t load_index_snapshot(Log* log) {
+  std::string path = log->dir + "/index.bin";
+  int fd = open(path.c_str(), O_RDONLY);
+  if (fd < 0) return 0;
+  struct stat ist;
+  IndexHeader hdr{};
+  bool ok = fstat(fd, &ist) == 0 &&
+            read(fd, &hdr, sizeof(hdr)) == static_cast<ssize_t>(sizeof(hdr)) &&
+            hdr.magic == kIndexMagic && hdr.version == kIndexVersion &&
+            hdr.recmeta_size == sizeof(RecMeta) &&
+            hdr.generation == log->generation &&
+            hdr.covered_bytes <= log->file_size &&
+            static_cast<uint64_t>(ist.st_size) ==
+                sizeof(IndexHeader) + sizeof(RecMeta) * hdr.n_recs;
+  if (ok) {
+    log->recs.resize(hdr.n_recs);
+    uint64_t want = sizeof(RecMeta) * hdr.n_recs;
+    uint64_t got = 0;
+    while (got < want) {
+      ssize_t r = read(fd, reinterpret_cast<uint8_t*>(log->recs.data()) + got,
+                       want - got);
+      if (r <= 0) break;
+      got += static_cast<uint64_t>(r);
+    }
+    ok = got == want &&
+         fnv1a(reinterpret_cast<const uint8_t*>(log->recs.data()), want) ==
+             hdr.checksum;
+    // the snapshot must describe THIS log's exact record chain:
+    // contiguous from offset 0 to covered_bytes, in-bounds lengths
+    if (ok) {
+      uint64_t expect = 0;
+      for (const RecMeta& m : log->recs) {
+        if (m.offset != expect || m.len < kHeaderLen ||
+            m.offset + 4 + m.len > hdr.covered_bytes) {
+          ok = false;
+          break;
+        }
+        expect = m.offset + 4 + m.len;
+      }
+      if (ok && expect != hdr.covered_bytes) ok = false;
+    }
+    // spot-parse the last record as a final cross-check against the log
+    if (ok && !log->recs.empty()) {
+      Header h;
+      const RecMeta& last = log->recs.back();
+      ok = parse(log->map + last.offset + 4, last.len, &h);
+    }
+  }
+  close(fd);
+  if (!ok) {
+    log->recs.clear();
+    return 0;
+  }
+  log->has_dupes = hdr.has_dupes != 0;
+  log->indexed_upto = 0;  // by_id rebuilt lazily when actually needed
+  log->snapshot_covered = hdr.covered_bytes;
+  return hdr.covered_bytes;
+}
+
 }  // namespace
 
 extern "C" {
@@ -545,6 +746,7 @@ void* el_open(const char* dir, int fsync_on_append) {
   std::string base(dir);
   if (mkdir(base.c_str(), 0755) != 0 && errno != EEXIST) return nullptr;
   auto log = std::make_unique<Log>();
+  log->dir = base;
   log->fsync_on_append = fsync_on_append != 0;
 
   // single-writer-process guard: held until el_close
@@ -553,10 +755,12 @@ void* el_open(const char* dir, int fsync_on_append) {
   if (log->lock_fd < 0) return nullptr;
   if (flock(log->lock_fd, LOCK_EX | LOCK_NB) != 0) return nullptr;
 
-  std::string log_path = base + "/log.bin";
+  log->generation = read_generation(base);
+  remove_orphan_generations(base, log->generation);
+  std::string log_path = log_path_for(base, log->generation);
   log->fd = open(log_path.c_str(), O_RDWR | O_CREAT | O_APPEND, 0644);
   if (log->fd < 0) return nullptr;
-  std::string tomb_path = base + "/tombstones.bin";
+  std::string tomb_path = tomb_path_for(base, log->generation);
   log->tomb_fd = open(tomb_path.c_str(), O_RDWR | O_CREAT | O_APPEND, 0644);
   if (log->tomb_fd < 0) return nullptr;
 
@@ -577,18 +781,28 @@ void* el_open(const char* dir, int fsync_on_append) {
   log->file_size = static_cast<uint64_t>(st.st_size);
   if (!log->ensure_mapped()) return nullptr;
 
-  // replay the log into the index; a torn tail (crash mid-append) is
-  // truncated away, mirroring WAL replay semantics
-  uint64_t off = 0;
+  // fast open: load the persisted index snapshot (clean shutdowns
+  // cover the whole log), then replay only the uncovered suffix; a
+  // torn tail (crash mid-append) is truncated away, mirroring WAL
+  // replay semantics. Suffix records are indexed lazily — their dupe
+  // status is resolved by ensure_id_index on first need.
+  uint64_t off = load_index_snapshot(log.get());
+  uint64_t n_suffix = 0;
   while (off + 4 <= log->file_size) {
     uint32_t len;
     memcpy(&len, log->map + off, 4);
     if (off + 4 + len > log->file_size) break;  // torn tail
     Header h;
     if (!parse(log->map + off + 4, len, &h)) break;
-    log->index_record(off, len, h);
+    if (log->snapshot_covered > 0) {
+      log->index_record(off, len, h, /*fresh_ids=*/true);
+      ++n_suffix;
+    } else {
+      log->index_record(off, len, h);
+    }
     off += 4 + len;
   }
+  if (n_suffix > 0) log->needs_id_verify = true;
   if (off < log->file_size) {
     if (ftruncate(log->fd, off) != 0) return nullptr;
     log->file_size = off;
@@ -596,14 +810,11 @@ void* el_open(const char* dir, int fsync_on_append) {
   return log.release();
 }
 
-void el_close(void* h) { delete static_cast<Log*>(h); }
-
-int64_t el_count(void* h) {
+void el_close(void* h) {
   Log* log = static_cast<Log*>(h);
-  std::shared_lock lk(log->mu);
-  // unindexed (fresh-id columnar) records are all live
-  return static_cast<int64_t>(log->by_id.size() +
-                              (log->recs.size() - log->indexed_upto));
+  if (!log->broken && log->file_size != log->snapshot_covered)
+    write_index_snapshot(log);
+  delete log;
 }
 
 namespace {
@@ -624,6 +835,17 @@ void ensure_index_for_scan(Log* log) {
 }
 
 }  // namespace
+
+int64_t el_count(void* h) {
+  Log* log = static_cast<Log*>(h);
+  // non-all-live logs need exact liveness (e.g. tombstones + a lazily
+  // indexed region after a snapshot load)
+  ensure_index_for_scan(log);
+  std::shared_lock lk(log->mu);
+  // unindexed (fresh-id columnar) records are all live
+  return static_cast<int64_t>(log->by_id.size() +
+                              (log->recs.size() - log->indexed_upto));
+}
 
 namespace {
 
@@ -669,6 +891,11 @@ int64_t append_packed(Log* log, const uint8_t* buf, uint64_t nbytes, int64_t n,
     off += 4 + len;
   }
   if (!log->ensure_mapped()) log->broken = true;
+  // amortized snapshot: bounds both crash-replay work and the close-
+  // time snapshot write after a bulk ingest
+  if (!log->broken &&
+      log->file_size - log->snapshot_covered >= kSnapshotInterval)
+    write_index_snapshot(log);
   return n;
 }
 
@@ -1006,6 +1233,122 @@ int64_t el_append_columnar(
   }
   // records were built here (fresh ids) — no validation pass, lazy id index
   return append_packed(log, buf.data(), buf.size(), n, /*fresh_ids=*/true);
+}
+
+// Compaction: rewrite the log keeping only LIVE records (drops
+// tombstone-masked records and superseded duplicate ids — the space
+// HBase reclaims with major compaction), truncate the tombstone file,
+// and persist a fresh index snapshot. Record order is preserved.
+// Returns the number of records dropped, or -1; before/after log byte
+// sizes come back via the out params.
+int64_t el_compact(void* h, uint64_t* before_bytes, uint64_t* after_bytes) {
+  Log* log = static_cast<Log*>(h);
+  std::unique_lock lk(log->mu);
+  if (log->broken) return -1;
+  log->ensure_id_index();
+  *before_bytes = log->file_size;
+
+  if (log->all_live()) {  // nothing to drop
+    *after_bytes = log->file_size;
+    if (log->file_size != log->snapshot_covered) write_index_snapshot(log);
+    return 0;
+  }
+
+  uint64_t new_gen = log->generation + 1;
+  std::string new_log_path = log_path_for(log->dir, new_gen);
+  std::string new_tomb_path = tomb_path_for(log->dir, new_gen);
+  int nfd = open(new_log_path.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (nfd < 0) return -1;
+
+  std::vector<RecMeta> new_recs;
+  std::unordered_map<std::string, uint64_t> new_by_id;
+  new_recs.reserve(log->by_id.size());
+  new_by_id.reserve(log->by_id.size());
+  uint64_t new_size = 0;
+  int64_t dropped = 0;
+  bool ok = true;
+  // buffered copy: records are contiguous runs of live bytes most of
+  // the time; coalesce adjacent live records into one write
+  uint64_t run_start = 0, run_len = 0;
+  auto flush_run = [&]() {
+    if (run_len && ok) ok = write_all(nfd, log->map + run_start, run_len);
+    run_len = 0;
+  };
+  Header hd;
+  for (uint64_t i = 0; i < log->recs.size() && ok; ++i) {
+    const RecMeta& m = log->recs[i];
+    parse(log->map + m.offset + 4, m.len, &hd);
+    std::string id(reinterpret_cast<const char*>(hd.id), 16);
+    auto it = log->by_id.find(id);
+    if (it == log->by_id.end() || it->second != i) {
+      ++dropped;
+      flush_run();
+      continue;
+    }
+    if (run_len == 0) run_start = m.offset;
+    else if (run_start + run_len != m.offset) {
+      flush_run();
+      run_start = m.offset;
+    }
+    run_len += 4 + m.len;
+    RecMeta nm = m;
+    nm.offset = new_size;
+    new_by_id.emplace(std::move(id), new_recs.size());
+    new_recs.push_back(nm);
+    new_size += 4 + m.len;
+  }
+  flush_run();
+  if (ok) ok = fdatasync(nfd) == 0;
+  close(nfd);
+  // the new generation's tombstone file starts empty
+  if (ok) {
+    int tfd = open(new_tomb_path.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    ok = tfd >= 0;
+    if (ok) {
+      ok = fdatasync(tfd) == 0;
+      close(tfd);
+    }
+  }
+  // commit point: CURRENT now names the new generation. A crash before
+  // this line leaves the old generation fully intact (the new files are
+  // orphans, removed on next open); a crash after it leaves the
+  // compacted log with its empty tombstones — never a mix.
+  if (!ok || !commit_generation(log->dir, new_gen)) {
+    unlink(new_log_path.c_str());
+    unlink(new_tomb_path.c_str());
+    return -1;
+  }
+
+  if (log->map) {
+    munmap(log->map, log->map_size);
+    log->map = nullptr;
+    log->map_size = 0;
+  }
+  close(log->fd);
+  close(log->tomb_fd);
+  log->fd = open(new_log_path.c_str(), O_RDWR | O_CREAT | O_APPEND, 0644);
+  log->tomb_fd = open(new_tomb_path.c_str(), O_RDWR | O_CREAT | O_APPEND, 0644);
+  if (log->fd < 0 || log->tomb_fd < 0) {
+    log->broken = true;
+    return -1;
+  }
+  log->generation = new_gen;
+  log->file_size = new_size;
+  log->recs = std::move(new_recs);
+  log->by_id = std::move(new_by_id);
+  log->indexed_upto = log->recs.size();
+  log->has_dupes = false;
+  log->needs_id_verify = false;
+  log->tombs.clear();
+  log->snapshot_covered = 0;  // the on-disk snapshot is for the old gen
+  if (!log->ensure_mapped()) {
+    log->broken = true;
+    return -1;
+  }
+  remove_orphan_generations(log->dir, new_gen);
+  write_index_snapshot(log);
+  *after_bytes = log->file_size;
+  return dropped;
 }
 
 }  // extern "C"

@@ -132,7 +132,7 @@ _REPO_SPECS: Dict[str, Dict[str, Any]] = {
 
 _EVENT_METHODS = frozenset(
     {"init", "remove", "insert", "insert_batch", "get", "delete", "find",
-     "find_columnar", "insert_columnar"}
+     "find_columnar", "insert_columnar", "compact"}
 )
 
 
@@ -283,6 +283,8 @@ class StorageRequestHandler(JSONRequestHandler):
         if method == "init":
             store.init(app_id, channel_id)
             return self._send(200, {"ok": True})
+        if method == "compact":
+            return self._send(200, {"stats": store.compact(app_id, channel_id)})
         if method == "remove":
             store.remove(app_id, channel_id)
             return self._send(200, {"ok": True})

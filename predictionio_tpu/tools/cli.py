@@ -3,7 +3,7 @@
 Behavior contract from the reference CLI (tools/.../console/
 Console.scala:128-735 command surface; bin/pio:17-42 wrapper):
 
-  app new|list|show|delete|data-delete|channel-new|channel-delete
+  app new|list|show|delete|data-delete|compact|channel-new|channel-delete
   accesskey new|list|delete
   build                 (register the engine manifest; no compile step —
                          engines are Python, ref: RegisterEngine.scala:50)
@@ -100,6 +100,13 @@ def cmd_app(args) -> int:
     elif args.app_command == "data-delete":
         commands.app_data_delete(args.name, args.channel, st)
         _p(f"App data deleted: {args.name}")
+    elif args.app_command == "compact":
+        stats = commands.app_compact(args.name, args.channel, st)
+        if stats is None:
+            _p("Backend stores events in place; nothing to compact.")
+        else:
+            _p(f"Compacted: dropped {stats['dropped']} records, "
+               f"{stats['before_bytes']} -> {stats['after_bytes']} bytes")
     elif args.app_command == "channel-new":
         ch = commands.channel_new(args.name, args.channel, st)
         _p(f"Channel created: {ch.name} (id {ch.id})")
@@ -493,6 +500,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = app_sub.add_parser("show"); p.add_argument("name")
     p = app_sub.add_parser("delete"); p.add_argument("name")
     p = app_sub.add_parser("data-delete"); p.add_argument("name")
+    p.add_argument("--channel", default=None)
+    p = app_sub.add_parser("compact"); p.add_argument("name")
     p.add_argument("--channel", default=None)
     p = app_sub.add_parser("channel-new"); p.add_argument("name"); p.add_argument("channel")
     p = app_sub.add_parser("channel-delete"); p.add_argument("name"); p.add_argument("channel")

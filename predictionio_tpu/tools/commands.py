@@ -121,6 +121,25 @@ def app_data_delete(
     st.events().init(info.app.id, ch.id)
 
 
+def app_compact(
+    name: str,
+    channel: Optional[str] = None,
+    storage: Optional[Storage] = None,
+):
+    """Physically reclaim deleted/superseded event space (eventlog
+    backend; no-op None elsewhere). The pio-side entry for the HBase
+    major-compaction role."""
+    st = _storage(storage)
+    info = app_show(name, st)
+    channel_id = None
+    if channel is not None:
+        ch = next((c for c in info.channels if c.name == channel), None)
+        if ch is None:
+            raise CommandError(f"Channel {channel} does not exist. Aborting.")
+        channel_id = ch.id
+    return st.events().compact(info.app.id, channel_id)
+
+
 # -- channels ----------------------------------------------------------------
 
 def channel_new(

@@ -465,3 +465,28 @@ def test_keepalive_survives_short_circuit_responses(memory_storage):
         conn.close()
     finally:
         server.stop()
+
+
+def test_compact_over_rest(tmp_path):
+    """`pio app compact` against a rest-configured client must run the
+    compaction ON the storage server's backend and return real stats
+    (HBase major-compaction role reached through the network tier)."""
+    from tests.test_storage import make_storage
+
+    server_storage = make_storage("eventlog", tmp_path)
+    server = StorageServer(storage=server_storage, host="127.0.0.1", port=0).start()
+    try:
+        client = _client_storage(server.port)
+        app = client.apps().insert("rc")
+        client.events().init(app.id)
+        ids = client.events().insert_batch(
+            [_event(eid=f"u{i}") for i in range(40)], app.id)
+        for eid in ids[:30]:
+            client.events().delete(eid, app.id)
+        stats = client.events().compact(app.id)
+        assert stats["dropped"] == 30
+        assert stats["after_bytes"] < stats["before_bytes"]
+        assert len(client.events().find(app.id)) == 10
+    finally:
+        server.stop()
+        server_storage.events().close()
