@@ -20,8 +20,11 @@ Source config (reference env grammar, conf/pio-env.sh.template):
 from __future__ import annotations
 
 import json
+import random
+import time
 import urllib.error
 import urllib.request
+from http.client import IncompleteRead
 from typing import Any, Dict, List, Optional
 
 from predictionio_tpu.data.event import Event
@@ -39,12 +42,23 @@ from predictionio_tpu.data import storage as S
 
 
 class _Transport:
-    """One storage-server endpoint + auth; shared by all proxy DAOs."""
+    """One storage-server endpoint + auth; shared by all proxy DAOs.
 
-    def __init__(self, base_url: str, auth_key: Optional[str], timeout: float):
+    Resilience (the role HBase's client plays with its connection pool
+    and bounded retries, hbase/StorageClient.scala): connection-level
+    failures — refused, reset, timed out — are classified as
+    StorageUnavailableError and, for IDEMPOTENT operations, retried
+    with capped exponential backoff + jitter. Non-idempotent writes
+    (event/metadata inserts) never auto-retry: their first attempt's
+    outcome is unknown, and a blind replay could double-write."""
+
+    def __init__(self, base_url: str, auth_key: Optional[str], timeout: float,
+                 retries: int = 3, backoff: float = 0.2):
         self.base_url = base_url.rstrip("/")
         self.auth_key = auth_key
         self.timeout = timeout
+        self.retries = max(0, int(retries))
+        self.backoff = backoff
 
     def _request_obj(self, path, body, method, content_type) -> urllib.request.Request:
         req = urllib.request.Request(
@@ -65,6 +79,9 @@ class _Transport:
             f"storage server {self.base_url}{path}: HTTP {e.code}: {message}"
         )
 
+    def _sleep_backoff(self, attempt: int) -> None:
+        time.sleep(self.backoff * (2 ** attempt) * (1 + random.random()))
+
     def request(
         self,
         path: str,
@@ -72,45 +89,59 @@ class _Transport:
         method: str = "POST",
         content_type: str = "application/json",
         timeout: Optional[float] = None,
+        idempotent: bool = False,
     ):
         """(status, body bytes). A 404 is returned (not raised) ONLY when
         the server marks it as a data miss (``{"missing": true}``); a
         bare 404 means route/version skew and raises StorageError, so it
-        can never masquerade as empty data."""
-        req = self._request_obj(path, body, method, content_type)
-        try:
-            with urllib.request.urlopen(
-                req, timeout=timeout if timeout is not None else self.timeout
-            ) as resp:
-                return resp.status, resp.read()
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                payload = e.read()
-                try:
-                    missing = json.loads(payload).get("missing", False)
-                except Exception:  # noqa: BLE001
-                    missing = False
-                if missing:
-                    return 404, payload
-                raise S.StorageError(
-                    f"storage server {self.base_url}{path}: unknown route "
-                    "(server/client version skew?)"
-                ) from None
-            raise self._error(path, e) from None
-        except urllib.error.URLError as e:
-            raise S.StorageError(
-                f"storage server {self.base_url} unreachable: {e.reason}"
-            ) from None
+        can never masquerade as empty data. Connection-level failures
+        raise StorageUnavailableError, after bounded retries when
+        ``idempotent``."""
+        attempts = 1 + (self.retries if idempotent else 0)
+        last: Optional[S.StorageError] = None
+        for attempt in range(attempts):
+            if attempt:
+                self._sleep_backoff(attempt - 1)
+            req = self._request_obj(path, body, method, content_type)
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=timeout if timeout is not None else self.timeout
+                ) as resp:
+                    return resp.status, resp.read()
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    payload = e.read()
+                    try:
+                        missing = json.loads(payload).get("missing", False)
+                    except Exception:  # noqa: BLE001
+                        missing = False
+                    if missing:
+                        return 404, payload
+                    raise S.StorageError(
+                        f"storage server {self.base_url}{path}: unknown route "
+                        "(server/client version skew?)"
+                    ) from None
+                raise self._error(path, e) from None
+            except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
+                reason = getattr(e, "reason", e)
+                last = S.StorageUnavailableError(
+                    f"storage server {self.base_url} unreachable: {reason}"
+                )
+        raise last from None
 
-    def json_call(self, path: str, payload: Dict[str, Any]) -> Any:
-        status, body = self.request(path, json.dumps(payload).encode())
+    def json_call(self, path: str, payload: Dict[str, Any],
+                  idempotent: bool = False) -> Any:
+        status, body = self.request(path, json.dumps(payload).encode(),
+                                    idempotent=idempotent)
         if status == 404:
             return None
         return json.loads(body)
 
     def stream_lines(self, path: str, payload: Dict[str, Any]):
         """Yield non-empty response lines without buffering the body
-        (the server chunk-streams finds; urllib decodes transparently)."""
+        (the server chunk-streams finds; urllib decodes transparently).
+        Connection failures — at connect or mid-stream — raise
+        StorageUnavailableError so read callers can retry the scan."""
         req = self._request_obj(
             path, json.dumps(payload).encode(), "POST", "application/json"
         )
@@ -118,38 +149,50 @@ class _Transport:
             resp = urllib.request.urlopen(req, timeout=self.timeout)
         except urllib.error.HTTPError as e:
             raise self._error(path, e) from None
-        except urllib.error.URLError as e:
-            raise S.StorageError(
-                f"storage server {self.base_url} unreachable: {e.reason}"
+        except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
+            raise S.StorageUnavailableError(
+                f"storage server {self.base_url} unreachable: "
+                f"{getattr(e, 'reason', e)}"
             ) from None
-        with resp:
-            for line in resp:
-                line = line.strip()
-                if line:
-                    yield line
+        try:
+            with resp:
+                for line in resp:
+                    line = line.strip()
+                    if line:
+                        yield line
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                IncompleteRead) as e:
+            raise S.StorageUnavailableError(
+                f"storage server {self.base_url}: connection lost "
+                f"mid-stream: {getattr(e, 'reason', e)}"
+            ) from None
 
 
 class RestEventStore(S.EventStore):
     def __init__(self, transport: _Transport):
         self._t = transport
 
-    def _call(self, method: str, app_id, channel_id, **extra) -> Any:
+    def _call(self, method: str, app_id, channel_id, idempotent=False,
+              **extra) -> Any:
         payload = {"app_id": int(app_id), "channel_id": channel_id}
         payload.update(extra)
-        return self._t.json_call(f"/storage/events/{method}", payload)
+        return self._t.json_call(f"/storage/events/{method}", payload,
+                                 idempotent=idempotent)
 
     def init(self, app_id, channel_id=None):
-        self._call("init", app_id, channel_id)
+        self._call("init", app_id, channel_id, idempotent=True)
 
     def remove(self, app_id, channel_id=None):
-        self._call("remove", app_id, channel_id)
+        self._call("remove", app_id, channel_id, idempotent=True)
 
     def compact(self, app_id, channel_id=None):
         # runs ON the storage server, against its local backend; None
         # when that backend stores events in place
-        return self._call("compact", app_id, channel_id)["stats"]
+        return self._call("compact", app_id, channel_id,
+                          idempotent=True)["stats"]
 
     def insert(self, event: Event, app_id, channel_id=None) -> str:
+        # NOT retried: a lost response would double-insert
         out = self._call("insert", app_id, channel_id,
                          event=event.to_dict(api_format=False))
         return out["eventId"]
@@ -160,12 +203,15 @@ class RestEventStore(S.EventStore):
         return out["eventIds"]
 
     def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
-        out = self._call("get", app_id, channel_id, event_id=event_id)
+        out = self._call("get", app_id, channel_id, event_id=event_id,
+                         idempotent=True)
         return Event.from_dict(out["event"]) if out else None
 
     def delete(self, event_id, app_id, channel_id=None) -> bool:
+        # retried: deleting an id twice converges to the same state (the
+        # replay may report found=False if the first attempt landed)
         return bool(self._call("delete", app_id, channel_id,
-                               event_id=event_id)["found"])
+                               event_id=event_id, idempotent=True)["found"])
 
     _FIND_KEYS = frozenset(
         {"start_time", "until_time", "entity_type", "entity_id",
@@ -226,10 +272,20 @@ class RestEventStore(S.EventStore):
             "target_entity_id": target_entity_id,
             "limit": limit, "reversed": reversed,
         })
-        return [
-            Event.from_dict(json.loads(line))
-            for line in self._t.stream_lines("/storage/events/find", payload)
-        ]
+        # a read: on a mid-stream connection drop, retry the whole scan
+        last = None
+        for attempt in range(1 + self._t.retries):
+            if attempt:
+                self._t._sleep_backoff(attempt - 1)
+            try:
+                return [
+                    Event.from_dict(json.loads(line))
+                    for line in self._t.stream_lines(
+                        "/storage/events/find", payload)
+                ]
+            except S.StorageUnavailableError as e:
+                last = e
+        raise last
 
     def find_columnar(
         self,
@@ -240,15 +296,91 @@ class RestEventStore(S.EventStore):
         **find_kwargs,
     ) -> S.EventColumns:
         """Bulk training read over the wire as one binary npz of
-        dict-encoded columns — 20M rows without per-event JSON."""
+        dict-encoded columns — 20M rows without per-event JSON.
+
+        Two-phase, resumable: the server runs the scan once and spools
+        the npz to disk (POST find_columnar -> {"scan_id", "bytes"});
+        the bytes stream via GET .../scan/<id>?offset=N, so a dropped
+        connection resumes from the last received byte instead of
+        re-scanning, and an expired/restarted server triggers a
+        re-prepare. The scan is released when fully received."""
+        import tempfile
+
         payload = self._find_payload(app_id, channel_id, find_kwargs)
         payload["value_property"] = value_property
         payload["time_ordered"] = bool(time_ordered)
-        status, body = self._t.request(
-            "/storage/events/find_columnar", json.dumps(payload).encode(),
-            timeout=max(self._t.timeout, 600.0),  # bulk scans take minutes
+        body = json.dumps(payload).encode()
+        for attempt in range(1 + self._t.retries):
+            if attempt:
+                self._t._sleep_backoff(attempt - 1)
+            status, prep_body = self._t.request(
+                "/storage/events/find_columnar", body,
+                timeout=max(self._t.timeout, 600.0),  # scans take minutes
+                idempotent=True,
+            )
+            try:
+                prep = json.loads(prep_body)
+                scan_id, total = prep["scan_id"], int(prep["bytes"])
+            except (ValueError, KeyError, TypeError):
+                raise S.StorageError(
+                    f"storage server {self._t.base_url}: find_columnar did "
+                    "not answer the scan handshake (server/client version "
+                    "skew?)"
+                ) from None
+            # spool to a client-side temp file: the multi-GB blob never
+            # sits in memory next to the decoded arrays
+            with tempfile.TemporaryFile() as spool:
+                if not self._fetch_scan(scan_id, total, spool):
+                    continue  # scan expired / server restarted: re-prepare
+                try:
+                    self._t.request(f"/storage/events/scan/{scan_id}",
+                                    method="DELETE", idempotent=True)
+                except S.StorageError:
+                    pass  # best-effort release; the server TTL reaps it
+                spool.seek(0)
+                return S.npz_to_columns(spool)
+        raise S.StorageUnavailableError(
+            f"storage server {self._t.base_url}: bulk scan kept expiring "
+            f"after {1 + self._t.retries} attempts"
         )
-        return S.npz_to_columns(body)
+
+    def _fetch_scan(self, scan_id: str, total: int, spool) -> bool:
+        """Stream a spooled scan into ``spool``, resuming from the
+        received-byte offset on connection failures (each received
+        chunk resets the retry budget — only LACK OF PROGRESS counts
+        against it). False when the scan is gone server-side (caller
+        re-prepares)."""
+        received = 0
+        failures = 0
+        while received < total:
+            req = self._t._request_obj(
+                f"/storage/events/scan/{scan_id}?offset={received}",
+                None, "GET", "application/octet-stream",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=self._t.timeout) as resp:
+                    while True:
+                        chunk = resp.read(1 << 20)
+                        if not chunk:
+                            break
+                        spool.write(chunk)
+                        received += len(chunk)
+                        failures = 0
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    return False
+                raise self._t._error(f"/storage/events/scan/{scan_id}", e) from None
+            except (urllib.error.URLError, ConnectionError, TimeoutError,
+                    IncompleteRead):
+                failures += 1
+                if failures > self._t.retries:
+                    raise S.StorageUnavailableError(
+                        f"storage server {self._t.base_url}: scan fetch made "
+                        f"no progress after {failures} attempts "
+                        f"({received}/{total} bytes)"
+                    ) from None
+                self._t._sleep_backoff(failures - 1)
+        return True
 
     def insert_columnar(
         self,
@@ -291,8 +423,12 @@ class _RestRepo:
         self._t = transport
 
     def _rpc(self, method: str, args: List[Any], kind: str) -> Any:
+        # reads, full-record updates and deletes are idempotent;
+        # inserts are not (replaying one could double-create)
+        idempotent = not method.startswith("insert")
         out = self._t.json_call(
-            f"/storage/meta/{self.repo}/{method}", {"args": args}
+            f"/storage/meta/{self.repo}/{method}", {"args": args},
+            idempotent=idempotent,
         )
         result = out["result"] if out else None
         if result is None:
@@ -443,21 +579,23 @@ class RestModelsRepo(S.ModelsRepo):
         self._t = transport
 
     def insert(self, model: Model) -> None:
+        # PUT of the full blob under a fixed id: idempotent by nature
         self._t.request(
             f"/storage/models/{model.id}", bytes(model.models), method="PUT",
-            content_type="application/octet-stream",
+            content_type="application/octet-stream", idempotent=True,
         )
 
     def get(self, id: str) -> Optional[Model]:
         status, body = self._t.request(
-            f"/storage/models/{id}", method="GET"
+            f"/storage/models/{id}", method="GET", idempotent=True
         )
         if status == 404:
             return None
         return Model(id=id, models=body)
 
     def delete(self, id: str) -> None:
-        self._t.request(f"/storage/models/{id}", method="DELETE")
+        self._t.request(f"/storage/models/{id}", method="DELETE",
+                        idempotent=True)
 
 
 class RestStorageClient(S.StorageClient):
@@ -469,8 +607,10 @@ class RestStorageClient(S.StorageClient):
         port = (config.get("PORTS") or "7077").split(",")[0].strip()
         scheme = config.get("SCHEME", "http")
         timeout = float(config.get("TIMEOUT", "30"))
+        retries = int(config.get("RETRIES", "3"))
         self._transport = _Transport(
-            f"{scheme}://{host}:{port}", config.get("AUTH_KEY"), timeout
+            f"{scheme}://{host}:{port}", config.get("AUTH_KEY"), timeout,
+            retries=retries,
         )
         self._events = RestEventStore(self._transport)
         self._apps = RestAppsRepo(self._transport)

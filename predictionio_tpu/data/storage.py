@@ -48,6 +48,13 @@ class StorageError(RuntimeError):
     pass
 
 
+class StorageUnavailableError(StorageError):
+    """Connection-level failure (refused/reset/timeout): the backend
+    could not be REACHED, as opposed to an application error it
+    answered with. Idempotent network-tier operations retry on this;
+    callers can distinguish outage from bad-request."""
+
+
 @dataclasses.dataclass
 class EventColumns:
     """Dict-encoded columnar view of a filtered event scan — the bulk
@@ -94,9 +101,19 @@ def pack_vocab(vocab) -> tuple:
 
 def columns_to_npz(cols: EventColumns) -> bytes:
     """EventColumns -> one .npz blob — the wire format of the bulk
-    columnar storage routes. Vocabularies travel via pack_vocab."""
+    columnar storage routes."""
     import io
 
+    buf = io.BytesIO()
+    columns_to_npz_file(cols, buf)
+    return buf.getvalue()
+
+
+def columns_to_npz_file(cols: EventColumns, f) -> None:
+    """Write the npz wire format to an open binary file object — the
+    storage server spools bulk scan results to disk this way instead of
+    materializing a second in-memory copy of the columns. Vocabularies
+    travel via pack_vocab."""
     import numpy as np
 
     def vocab_arrays(vocab):
@@ -106,9 +123,8 @@ def columns_to_npz(cols: EventColumns) -> bytes:
     ent_b, ent_off = vocab_arrays(cols.entity_vocab)
     tgt_b, tgt_off = vocab_arrays(cols.target_vocab)
     nam_b, nam_off = vocab_arrays(cols.names)
-    buf = io.BytesIO()
     np.savez(
-        buf,
+        f,
         entity_codes=cols.entity_codes,
         target_codes=cols.target_codes,
         name_codes=cols.name_codes,
@@ -118,16 +134,16 @@ def columns_to_npz(cols: EventColumns) -> bytes:
         target_vocab=tgt_b, target_vocab_offsets=tgt_off,
         names=nam_b, names_offsets=nam_off,
     )
-    return buf.getvalue()
 
 
-def npz_to_columns(blob: bytes) -> EventColumns:
-    """Inverse of columns_to_npz."""
+def npz_to_columns(blob) -> EventColumns:
+    """Inverse of columns_to_npz; accepts bytes, a binary file object,
+    or a path (np.load's own contract)."""
     import io
 
     import numpy as np
 
-    z = np.load(io.BytesIO(blob))
+    z = np.load(io.BytesIO(blob) if isinstance(blob, bytes) else blob)
 
     def vocab(key):
         raw = z[key].tobytes()

@@ -594,6 +594,17 @@ bool write_all(int fd, const void* data, uint64_t n) {
   return true;
 }
 
+// make directory-entry operations (create/rename/unlink) durable —
+// without this, a power failure can persist them in ANY order and
+// break the compaction commit protocol's ordering assumptions
+bool fsync_dir(const std::string& dir) {
+  int dfd = open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+  if (dfd < 0) return false;
+  bool ok = fsync(dfd) == 0;
+  close(dfd);
+  return ok;
+}
+
 std::string log_path_for(const std::string& dir, uint64_t gen) {
   return gen == 0 ? dir + "/log.bin"
                   : dir + "/log." + std::to_string(gen) + ".bin";
@@ -683,14 +694,20 @@ uint64_t load_index_snapshot(Log* log) {
   if (fd < 0) return 0;
   struct stat ist;
   IndexHeader hdr{};
+  // n_recs is validated by DIVISION against the index file's own size
+  // (a multiply could wrap uint64 and let a corrupt header through to
+  // the resize below)
   bool ok = fstat(fd, &ist) == 0 &&
             read(fd, &hdr, sizeof(hdr)) == static_cast<ssize_t>(sizeof(hdr)) &&
             hdr.magic == kIndexMagic && hdr.version == kIndexVersion &&
             hdr.recmeta_size == sizeof(RecMeta) &&
             hdr.generation == log->generation &&
             hdr.covered_bytes <= log->file_size &&
-            static_cast<uint64_t>(ist.st_size) ==
-                sizeof(IndexHeader) + sizeof(RecMeta) * hdr.n_recs;
+            static_cast<uint64_t>(ist.st_size) >= sizeof(IndexHeader) &&
+            (static_cast<uint64_t>(ist.st_size) - sizeof(IndexHeader)) %
+                    sizeof(RecMeta) == 0 &&
+            (static_cast<uint64_t>(ist.st_size) - sizeof(IndexHeader)) /
+                    sizeof(RecMeta) == hdr.n_recs;
   if (ok) {
     log->recs.resize(hdr.n_recs);
     uint64_t want = sizeof(RecMeta) * hdr.n_recs;
@@ -1309,6 +1326,10 @@ int64_t el_compact(void* h, uint64_t* before_bytes, uint64_t* after_bytes) {
       close(tfd);
     }
   }
+  // the new generation's directory entries must be durable BEFORE the
+  // commit record can name them (else CURRENT=N could survive a power
+  // cut whose log.<N>.bin dirent did not)
+  if (ok) ok = fsync_dir(log->dir);
   // commit point: CURRENT now names the new generation. A crash before
   // this line leaves the old generation fully intact (the new files are
   // orphans, removed on next open); a crash after it leaves the
@@ -1318,6 +1339,10 @@ int64_t el_compact(void* h, uint64_t* before_bytes, uint64_t* after_bytes) {
     unlink(new_tomb_path.c_str());
     return -1;
   }
+  // ...and the commit itself must be durable before the OLD generation
+  // may disappear (else the old files' unlinks could persist while the
+  // CURRENT rename did not, leaving CURRENT=old pointing at nothing)
+  fsync_dir(log->dir);
 
   if (log->map) {
     munmap(log->map, log->map_size);

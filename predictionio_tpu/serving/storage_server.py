@@ -15,10 +15,18 @@ METADATA / EVENTDATA / MODELDATA — train on host A, deploy on host B.
 Routes:
   - ``GET  /``                            {"status": "alive"}
   - ``POST /storage/events/<method>``     init/remove/insert/insert_batch/
-                                          get/delete — JSON body, DB-format
-                                          event dicts
+                                          get/delete/compact — JSON body,
+                                          DB-format event dicts
   - ``POST /storage/events/find``         filter body -> NDJSON stream
                                           (one DB-format event per line)
+  - ``POST /storage/events/find_columnar``filter body -> {"scan_id", "bytes"}:
+                                          the result npz is spooled to DISK
+                                          (never a second in-memory copy) and
+                                          fetched separately — see next route
+  - ``GET  /storage/events/scan/<id>?offset=N`` stream the spooled npz from
+                                          byte N (clients resume after a
+                                          dropped connection); DELETE frees
+                                          it (a TTL reaps abandoned scans)
   - ``POST /storage/meta/<repo>/<method>``whitelisted repo RPC (args array,
                                           records as dicts)
   - ``PUT/GET/DELETE /storage/models/<id>`` raw model blobs
@@ -34,6 +42,12 @@ from __future__ import annotations
 import datetime as _dt
 import json
 import logging
+import os
+import shutil
+import tempfile
+import threading
+import time
+import uuid
 from typing import Any, Dict, List, Optional
 
 from predictionio_tpu.data.event import Event
@@ -51,7 +65,7 @@ from predictionio_tpu.data.storage import (
     UNSET,
     Storage,
     StorageError,
-    columns_to_npz,
+    columns_to_npz_file,
     get_storage,
     npz_to_columns,
 )
@@ -60,6 +74,63 @@ from predictionio_tpu.serving.http import HTTPServerBase, JSONRequestHandler
 log = logging.getLogger(__name__)
 
 DEFAULT_PORT = 7077
+
+
+class _ScanRegistry:
+    """Disk-spooled bulk-scan results, fetched (and resumed) by id.
+
+    A 20M-row columnar result is written ONCE to a spool file; N fetch
+    requests stream byte ranges of it, so concurrent bulk readers cost
+    disk, not resident memory, and a client whose connection dropped
+    mid-transfer resumes from its last received byte instead of
+    re-scanning. Abandoned scans (client crashed) are reaped after
+    ``ttl`` seconds, checked on every registry access."""
+
+    def __init__(self, ttl: float = 600.0):
+        self._dir = tempfile.mkdtemp(prefix="pio_scans_")
+        self._scans: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        self._ttl = ttl
+
+    def create(self, write_fn) -> Dict[str, Any]:
+        scan_id = uuid.uuid4().hex
+        path = os.path.join(self._dir, scan_id + ".npz")
+        with open(path, "wb") as f:
+            write_fn(f)
+        size = os.path.getsize(path)
+        with self._lock:
+            self._reap_locked()
+            self._scans[scan_id] = {"path": path, "bytes": size,
+                                    "created": time.monotonic()}
+        return {"scan_id": scan_id, "bytes": size}
+
+    def path_for(self, scan_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            self._reap_locked()
+            return self._scans.get(scan_id)
+
+    def release(self, scan_id: str) -> bool:
+        with self._lock:
+            scan = self._scans.pop(scan_id, None)
+        if scan:
+            try:
+                os.remove(scan["path"])
+            except FileNotFoundError:
+                pass
+        return scan is not None
+
+    def _reap_locked(self) -> None:
+        now = time.monotonic()
+        for sid in [s for s, v in self._scans.items()
+                    if now - v["created"] > self._ttl]:
+            scan = self._scans.pop(sid)
+            try:
+                os.remove(scan["path"])
+            except FileNotFoundError:
+                pass
+
+    def close(self) -> None:
+        shutil.rmtree(self._dir, ignore_errors=True)
 
 #: per-repo RPC whitelist: method -> (record-arg positions, result kind)
 #: result kinds: "record" | "records" | "scalar"
@@ -176,12 +247,45 @@ class StorageRequestHandler(JSONRequestHandler):
     def do_GET(self):
         if not self._authorized():
             return self._deny()
-        if self.path == "/":
+        from urllib.parse import parse_qs, urlparse
+
+        parsed = urlparse(self.path)
+        if parsed.path == "/":
             return self._send(200, {"status": "alive"})
-        if self.path.startswith("/storage/models/"):
+        if parsed.path.startswith("/storage/models/"):
             return self._guarded(self._get_model,
-                                 self.path[len("/storage/models/"):])
+                                 parsed.path[len("/storage/models/"):])
+        if parsed.path.startswith("/storage/events/scan/"):
+            scan_id = parsed.path[len("/storage/events/scan/"):]
+            q = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+            return self._guarded(self._fetch_scan, scan_id,
+                                 q.get("offset", "0"))
         return self._send(404, {"message": "not found"})
+
+    def _fetch_scan(self, scan_id: str, offset_raw: str):
+        offset = int(offset_raw)  # inside _guarded: bad input answers 400
+        scan = self.server_ref.scans.path_for(scan_id)
+        if scan is None:
+            # expired/unknown (e.g. the server restarted mid-transfer):
+            # the client re-prepares — a data-miss 404, not a bad route
+            return self._send(404, {"message": "unknown scan",
+                                    "missing": True})
+        size = scan["bytes"]
+        if not 0 <= offset <= size:
+            return self._send(400, {"message": f"bad offset {offset}"})
+        # stream the spool file in bounded chunks: no full-blob buffer
+        self._body_consumed = True  # GET: nothing to drain
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(size - offset))
+        self.end_headers()
+        with open(scan["path"], "rb") as f:
+            f.seek(offset)
+            while True:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    break
+                self.wfile.write(chunk)
 
     def _get_model(self, model_id: str):
         model = self.server_ref.storage.models().get(model_id)
@@ -214,6 +318,10 @@ class StorageRequestHandler(JSONRequestHandler):
         if self.path.startswith("/storage/models/"):
             return self._guarded(self._delete_model,
                                  self.path[len("/storage/models/"):])
+        if self.path.startswith("/storage/events/scan/"):
+            scan_id = self.path[len("/storage/events/scan/"):]
+            self.server_ref.scans.release(scan_id)
+            return self._send(200, {"ok": True})
         return self._send(404, {"message": "not found"})
 
     def _delete_model(self, model_id: str):
@@ -261,12 +369,27 @@ class StorageRequestHandler(JSONRequestHandler):
         store = self.server_ref.storage.events()
         if method == "insert_columnar":
             # binary npz body; scalar params ride in the query string
-            # (percent-encoded UTF-8 — headers are latin-1-only)
+            # (percent-encoded UTF-8 — headers are latin-1-only). The
+            # body is spooled to disk in chunks — a multi-GB bulk
+            # ingest never holds the raw blob AND the decoded arrays
+            # in memory at once.
             from urllib.parse import parse_qs, urlparse
 
             q = {k: v[0] for k, v in parse_qs(urlparse(self.path).query).items()}
+            length = int(self.headers.get("Content-Length", 0))
+            self._body_consumed = True
+            with tempfile.TemporaryFile() as spool:
+                remaining = length
+                while remaining > 0:
+                    chunk = self.rfile.read(min(1 << 20, remaining))
+                    if not chunk:
+                        raise StorageError("truncated insert_columnar body")
+                    spool.write(chunk)
+                    remaining -= len(chunk)
+                spool.seek(0)
+                cols = npz_to_columns(spool)
             n = store.insert_columnar(
-                npz_to_columns(self._read_body()),
+                cols,
                 int(q["app_id"]),
                 int(q["channel_id"]) if q.get("channel_id") else None,
                 entity_type=q["entity_type"],
@@ -306,16 +429,19 @@ class StorageRequestHandler(JSONRequestHandler):
             found = store.delete(body["event_id"], app_id, channel_id)
             return self._send(200, {"found": bool(found)})
         if method == "find_columnar":
-            # bulk training read: dict-encoded columns as one binary npz
-            # (the NDJSON find would pay per-event JSON for 20M rows)
+            # bulk training read: dict-encoded columns spooled to disk
+            # as one npz; the response hands back a scan id the client
+            # streams (and resumes) via GET /storage/events/scan/<id>
             cols = store.find_columnar(
                 app_id, channel_id=channel_id,
                 value_property=body.get("value_property"),
                 time_ordered=bool(body.get("time_ordered", True)),
                 **self._find_kwargs(body),
             )
-            return self._send(200, columns_to_npz(cols),
-                              content_type="application/octet-stream")
+            scan = self.server_ref.scans.create(
+                lambda f: columns_to_npz_file(cols, f))
+            del cols
+            return self._send(200, scan)
 
         # find: NDJSON stream so 20M-event training reads never build one
         # giant JSON document on either side
@@ -372,10 +498,16 @@ class StorageServer(HTTPServerBase):
         port: int = DEFAULT_PORT,
         auth_key: Optional[str] = None,
         bind_retries: int = 3,
+        scan_ttl: float = 600.0,
     ):
         self.storage = storage if storage is not None else get_storage()
         self.auth_key = auth_key
+        self.scans = _ScanRegistry(ttl=scan_ttl)
         super().__init__(host, port, StorageRequestHandler, bind_retries=bind_retries)
+
+    def stop(self) -> None:
+        super().stop()
+        self.scans.close()
 
 
 def main(argv: Optional[List[str]] = None) -> int:

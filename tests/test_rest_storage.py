@@ -14,6 +14,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -490,3 +491,161 @@ def test_compact_over_rest(tmp_path):
     finally:
         server.stop()
         server_storage.events().close()
+
+
+def test_scan_fetch_resumes_after_connection_drop(rest_storage, monkeypatch):
+    """A connection that dies mid-transfer of a bulk scan must resume
+    from the last received byte (offset fetch), not restart or fail —
+    VERDICT r2 item 5 (HBase client retry role)."""
+    import urllib.request as _ur
+
+    _, client = rest_storage
+    client.events().init(5)
+    client.events().insert_batch(
+        [_event(eid=f"u{i}", tid=f"i{i % 7}", props={"rating": float(i)})
+         for i in range(500)], 5)
+
+    offsets_seen = []
+    real_urlopen = _ur.urlopen
+
+    class _DroppingResp:
+        """Proxy that yields a first chunk then drops the connection."""
+
+        def __init__(self, resp):
+            self._resp = resp
+            self._served = False
+
+        def read(self, n=-1):
+            if self._served:
+                self._resp.close()
+                raise ConnectionResetError("injected drop")
+            self._served = True
+            return self._resp.read(100)  # partial: 100 bytes then die
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    state = {"first": True}
+
+    def flaky_urlopen(req, timeout=None):
+        url = req.full_url if hasattr(req, "full_url") else req
+        if "/storage/events/scan/" in url and "offset=" in url:
+            offsets_seen.append(int(url.rsplit("offset=", 1)[1]))
+            if state["first"]:
+                state["first"] = False
+                return _DroppingResp(real_urlopen(req, timeout=timeout))
+        return real_urlopen(req, timeout=timeout)
+
+    monkeypatch.setattr(_ur, "urlopen", flaky_urlopen)
+    cols = client.events().find_columnar(5, value_property="rating",
+                                         time_ordered=True)
+    assert len(cols.entity_codes) == 500
+    assert [cols.entity_vocab[c] for c in cols.entity_codes[:3]] == \
+        ["u0", "u1", "u2"]
+    # first fetch started at 0, the resume continued at the 100 received
+    # bytes — never from scratch
+    assert offsets_seen[0] == 0 and offsets_seen[1] == 100
+
+
+def test_scan_survives_server_restart_mid_scan(tmp_path):
+    """Kill the storage server after the scan was prepared but before
+    the fetch, restart it (fresh scan registry), and the client must
+    complete correctly by re-preparing — VERDICT r2 item 5 'kill the
+    server mid-scan, restarts it, client completes correctly'."""
+    from predictionio_tpu.data.backends.rest import RestEventStore
+
+    server_storage = make_memory_storage()
+    server1 = StorageServer(storage=server_storage, host="127.0.0.1", port=0).start()
+    port = server1.port
+    client = _client_storage(port)
+    client.events().init(3)
+    client.events().insert_batch(
+        [_event(eid=f"u{i}", props={"rating": 1.0}) for i in range(50)], 3)
+
+    holder = {"server": server1, "restarted": False}
+    orig_fetch = RestEventStore._fetch_scan
+
+    def fetch_with_restart(self, scan_id, total, spool):
+        if not holder["restarted"]:
+            holder["restarted"] = True
+            holder["server"].stop()
+            holder["server"] = StorageServer(
+                storage=server_storage, host="127.0.0.1", port=port).start()
+        return orig_fetch(self, scan_id, total, spool)
+
+    try:
+        RestEventStore._fetch_scan = fetch_with_restart
+        cols = client.events().find_columnar(3, value_property="rating")
+        assert len(cols.entity_codes) == 50
+        assert holder["restarted"]
+    finally:
+        RestEventStore._fetch_scan = orig_fetch
+        holder["server"].stop()
+
+
+def make_memory_storage():
+    from predictionio_tpu.data.storage import Storage
+
+    return Storage.from_env({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "meta",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "events",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "models",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+    })
+
+
+def test_idempotent_reads_retry_through_transient_outage(tmp_path):
+    """An unreachable server raises StorageUnavailableError after
+    bounded retries; a server that comes back inside the retry budget
+    is transparent to idempotent reads."""
+    import threading
+
+    from predictionio_tpu.data.storage import StorageUnavailableError
+
+    server_storage = make_memory_storage()
+    probe = StorageServer(storage=server_storage, host="127.0.0.1", port=0).start()
+    port = probe.port
+    probe.stop()  # port now free; the client will find it dead
+
+    client = _client_storage(port)
+    with pytest.raises(StorageUnavailableError):
+        client.apps().get_all()
+
+    # bring the server up concurrently with the retried call
+    started = {}
+
+    def bring_up():
+        time.sleep(0.35)  # inside the ~0.2/0.4/0.8s backoff budget
+        started["server"] = StorageServer(
+            storage=server_storage, host="127.0.0.1", port=port).start()
+
+    t = threading.Thread(target=bring_up)
+    t.start()
+    try:
+        assert client.apps().get_all() == []
+    finally:
+        t.join()
+        started["server"].stop()
+
+
+def test_insert_never_auto_retries(tmp_path):
+    """Non-idempotent writes must fail fast on connection errors (a
+    blind replay could double-write)."""
+    from predictionio_tpu.data.storage import StorageUnavailableError
+
+    probe = StorageServer(storage=make_memory_storage(),
+                          host="127.0.0.1", port=0).start()
+    port = probe.port
+    probe.stop()
+    client = _client_storage(port)
+    t0 = time.time()
+    with pytest.raises(StorageUnavailableError):
+        client.events().insert(_event(), 1)
+    # no backoff sleeps -> fails in well under the first retry delay
+    assert time.time() - t0 < 0.2
