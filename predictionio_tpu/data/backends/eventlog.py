@@ -409,14 +409,22 @@ class EventLogEventStore(S.EventStore):
         channel_id=None,
         value_property=None,
         time_ordered=True,
+        shard_index=None,
+        shard_count=None,
         **find_kwargs,
     ) -> S.EventColumns:
         """One native pass: filter + dict-encode + property extraction
         (overrides the Event-object fallback in storage.EventStore).
         ``time_ordered=False`` (bulk training reads) fuses filter and
-        encode into a single parse per record and skips the sort."""
+        encode into a single parse per record and skips the sort.
+        Entity-hash read shards (shard_index/shard_count) are applied as
+        a vectorized post-filter on the encoded columns — the native
+        scan still reads the whole log (it is local disk), but only the
+        shard's rows are materialized as Python-owned arrays (and, via
+        the storage server, only they travel the wire)."""
         import numpy as np
 
+        S.EventStore.check_shard_params(shard_index, shard_count)
         unknown = set(find_kwargs) - {
             "start_time", "until_time", "entity_type", "entity_id",
             "event_names", "target_entity_type", "target_entity_id",
@@ -494,6 +502,8 @@ class EventLogEventStore(S.EventStore):
             for p in (ent, tgt, nam, val, tim, ent_d, tgt_d, nam_d,
                       ent_o, tgt_o, nam_o):
                 self._lib.el_free(p)
+        if shard_count is not None and shard_count > 1:
+            cols = S.shard_columns(cols, shard_index, shard_count)
         return cols
 
     def insert_columnar(

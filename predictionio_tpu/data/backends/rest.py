@@ -293,10 +293,17 @@ class RestEventStore(S.EventStore):
         channel_id=None,
         value_property=None,
         time_ordered=True,
+        shard_index=None,
+        shard_count=None,
         **find_kwargs,
     ) -> S.EventColumns:
         """Bulk training read over the wire as one binary npz of
         dict-encoded columns — 20M rows without per-event JSON.
+
+        ``shard_index``/``shard_count`` travel in the request so the
+        SERVER applies the entity-hash read shard: each of N training
+        hosts receives only its ~1/N of the bytes (the per-executor
+        HBase region-scan role, hbase/HBPEvents.scala:48).
 
         Two-phase, resumable: the server runs the scan once and spools
         the npz to disk (POST find_columnar -> {"scan_id", "bytes"});
@@ -306,9 +313,13 @@ class RestEventStore(S.EventStore):
         re-prepare. The scan is released when fully received."""
         import tempfile
 
+        S.EventStore.check_shard_params(shard_index, shard_count)
         payload = self._find_payload(app_id, channel_id, find_kwargs)
         payload["value_property"] = value_property
         payload["time_ordered"] = bool(time_ordered)
+        if shard_count is not None:
+            payload["shard_index"] = int(shard_index)
+            payload["shard_count"] = int(shard_count)
         body = json.dumps(payload).encode()
         for attempt in range(1 + self._t.retries):
             if attempt:

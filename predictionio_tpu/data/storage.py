@@ -19,10 +19,11 @@ from __future__ import annotations
 import abc
 import dataclasses
 import datetime as _dt
+import hashlib
 import os
 import re
 import threading
-from typing import Any, Dict, Iterable, List, Optional, TYPE_CHECKING
+from typing import Any, Dict, Iterable, List, Optional, Sequence, TYPE_CHECKING
 
 if TYPE_CHECKING:
     import numpy as np
@@ -80,6 +81,146 @@ class EventColumns:
 
     def __len__(self) -> int:
         return len(self.entity_codes)
+
+
+def stable_hash(s: str) -> int:
+    """Process-independent 64-bit hash of a string id — THE partition
+    function of the framework. Every entity-routed split must agree on
+    it: host-sharded training reads (parallel.multihost) and
+    shard-filtered columnar scans
+    (``find_columnar(shard_index=, shard_count=)``) today, the same way
+    every HBase reader/writer agrees on the MD5 rowkey prefix
+    (hbase/HBEventsUtil.scala:96-108). Builtin ``hash`` is salted per
+    process and would break that agreement."""
+    return int.from_bytes(hashlib.md5(s.encode()).digest()[:8], "little")
+
+
+def _compact_columns(cols: EventColumns, keep: "np.ndarray") -> EventColumns:
+    """Rows where ``keep`` is True, with every vocabulary compacted to
+    the ids those rows actually reference (first-seen order preserved)."""
+    import numpy as np
+
+    def remap(codes, vocab, sentinel: bool):
+        kept = codes[keep]
+        used = np.unique(kept)
+        if sentinel:
+            used = used[used >= 0]
+        table = np.full(len(vocab), -1, np.int32)
+        table[used] = np.arange(len(used), dtype=np.int32)
+        new_vocab = [vocab[int(c)] for c in used]
+        if sentinel:
+            new_codes = np.where(
+                kept >= 0,
+                table[np.maximum(kept, 0)] if table.size else np.int32(-1),
+                np.int32(-1),
+            ).astype(np.int32)
+        else:
+            new_codes = table[kept].astype(np.int32, copy=False)
+        return new_codes, new_vocab
+
+    ent, ent_v = remap(cols.entity_codes, cols.entity_vocab, False)
+    tgt, tgt_v = remap(cols.target_codes, cols.target_vocab, True)
+    nam, nam_v = remap(cols.name_codes, cols.names, False)
+    return EventColumns(
+        entity_codes=ent, target_codes=tgt, name_codes=nam,
+        values=cols.values[keep], times_us=cols.times_us[keep],
+        entity_vocab=ent_v, target_vocab=tgt_v, names=nam_v,
+    )
+
+
+def shard_columns(cols: EventColumns, shard_index: int,
+                  shard_count: int) -> EventColumns:
+    """The rows of ``cols`` whose ENTITY id hash-routes to shard
+    ``shard_index`` of ``shard_count`` (stable_hash % count). Keeping the
+    split entity-keyed means all of one entity's events land on one
+    shard — the invariant host-local aggregation relies on, identical to
+    the reference's rowkey-prefix region split (HBEventsUtil RowKey:81).
+    Vocabularies are compacted to the surviving rows."""
+    if shard_count <= 1:
+        return cols
+    import numpy as np
+
+    vmask = np.fromiter(
+        (stable_hash(v) % shard_count == shard_index
+         for v in cols.entity_vocab),
+        np.bool_, count=len(cols.entity_vocab),
+    )
+    keep = (vmask[cols.entity_codes] if len(cols)
+            else np.zeros(0, np.bool_))
+    return _compact_columns(cols, keep)
+
+
+def merge_columns(parts: Sequence[EventColumns],
+                  time_ordered: bool = False) -> EventColumns:
+    """Concatenate columnar scan results (e.g. one per storage shard)
+    into one EventColumns with union vocabularies. Codes are remapped
+    per part; ``time_ordered=True`` stably sorts the merged rows by
+    event time (shard scans interleave times)."""
+    import numpy as np
+
+    if not parts:
+        return EventColumns(
+            entity_codes=np.empty(0, np.int32),
+            target_codes=np.empty(0, np.int32),
+            name_codes=np.empty(0, np.int32),
+            values=np.empty(0, np.float64),
+            times_us=np.empty(0, np.int64),
+            entity_vocab=[], target_vocab=[], names=[],
+        )
+    if len(parts) == 1 and not time_ordered:
+        return parts[0]
+    ent_vocab: Dict[str, int] = {}
+    tgt_vocab: Dict[str, int] = {}
+    nam_vocab: Dict[str, int] = {}
+    ents, tgts, nams, vals, tims = [], [], [], [], []
+    for cols in parts:
+        def vocab_map(vocab, union):
+            return np.fromiter(
+                (union.setdefault(v, len(union)) for v in vocab),
+                np.int32, count=len(vocab),
+            )
+
+        ent_map = vocab_map(cols.entity_vocab, ent_vocab)
+        tgt_map = vocab_map(cols.target_vocab, tgt_vocab)
+        nam_map = vocab_map(cols.names, nam_vocab)
+        ents.append(ent_map[cols.entity_codes] if len(cols)
+                    else cols.entity_codes)
+        if len(cols):
+            tgts.append(np.where(
+                cols.target_codes >= 0,
+                tgt_map[np.maximum(cols.target_codes, 0)]
+                if tgt_map.size else np.int32(-1),
+                np.int32(-1),
+            ).astype(np.int32))
+            nams.append(nam_map[cols.name_codes])
+        else:
+            tgts.append(cols.target_codes)
+            nams.append(cols.name_codes)
+        vals.append(cols.values)
+        tims.append(cols.times_us)
+    merged = EventColumns(
+        entity_codes=np.concatenate(ents).astype(np.int32, copy=False),
+        target_codes=np.concatenate(tgts).astype(np.int32, copy=False),
+        name_codes=np.concatenate(nams).astype(np.int32, copy=False),
+        values=np.concatenate(vals),
+        times_us=np.concatenate(tims),
+        entity_vocab=list(ent_vocab),
+        target_vocab=list(tgt_vocab),
+        names=list(nam_vocab),
+    )
+    if time_ordered and len(merged):
+        order = np.argsort(merged.times_us, kind="stable")
+        merged = EventColumns(
+            entity_codes=merged.entity_codes[order],
+            target_codes=merged.target_codes[order],
+            name_codes=merged.name_codes[order],
+            values=merged.values[order],
+            times_us=merged.times_us[order],
+            entity_vocab=merged.entity_vocab,
+            target_vocab=merged.target_vocab,
+            names=merged.names,
+        )
+    return merged
 
 
 def pack_vocab(vocab) -> tuple:
@@ -228,17 +369,41 @@ class EventStore(abc.ABC):
         """
 
     # -- derived ------------------------------------------------------------
+    @staticmethod
+    def check_shard_params(shard_index: Optional[int],
+                           shard_count: Optional[int]) -> None:
+        """Validate the optional entity-hash read-shard pair (both set
+        or neither; index in range). Shared by every find_columnar."""
+        if (shard_index is None) != (shard_count is None):
+            raise ValueError(
+                "shard_index and shard_count must be given together"
+            )
+        if shard_count is not None and not 0 <= shard_index < shard_count:
+            raise ValueError(
+                f"shard_index {shard_index} out of range for "
+                f"shard_count {shard_count}"
+            )
+
     def find_columnar(
         self,
         app_id: int,
         channel_id: Optional[int] = None,
         value_property: Optional[str] = None,
         time_ordered: bool = True,
+        shard_index: Optional[int] = None,
+        shard_count: Optional[int] = None,
         **find_kwargs,
     ) -> EventColumns:
         """Filtered scan as dict-encoded columns (see EventColumns).
         ``time_ordered=False`` lets backends skip result ordering (bulk
         training reads don't need it).
+
+        ``shard_index``/``shard_count`` select the entity-hash read
+        shard (stable_hash(entity_id) % count == index): each of N
+        training hosts reads only its ~1/N of the rows — the role of the
+        reference's per-executor HBase region scans
+        (hbase/HBPEvents.scala:48). All of one entity's events stay in
+        one shard.
 
         Default implementation converts ``find`` results; the native
         eventlog backend overrides with a single C++ pass that never
@@ -247,7 +412,13 @@ class EventStore(abc.ABC):
         """
         import numpy as np
 
+        self.check_shard_params(shard_index, shard_count)
         events = self.find(app_id, channel_id=channel_id, **find_kwargs)
+        if shard_count is not None and shard_count > 1:
+            events = [
+                e for e in events
+                if stable_hash(e.entity_id) % shard_count == shard_index
+            ]
         n = len(events)
         ent_codes = np.empty(n, np.int32)
         tgt_codes = np.empty(n, np.int32)

@@ -82,12 +82,16 @@ def find_columnar(
     channel_name: Optional[str] = None,
     value_property: Optional[str] = None,
     time_ordered: bool = True,
+    shard_index: Optional[int] = None,
+    shard_count: Optional[int] = None,
     storage: Optional[Storage] = None,
     **find_kwargs,
 ):
     """Bulk training read as dict-encoded columns (storage.EventColumns)
     — the fast path behind DataSources at ML-20M scale (the role of the
-    reference's region-parallel HBase scans, hbase/HBPEvents.scala:48)."""
+    reference's region-parallel HBase scans, hbase/HBPEvents.scala:48).
+    ``shard_index``/``shard_count`` select this host's entity-hash read
+    shard — N training hosts each fetch only ~1/N of the rows."""
     storage = storage or get_storage()
     app_id, channel_id = resolve_app(app_name, channel_name, storage)
     return storage.events().find_columnar(
@@ -95,6 +99,8 @@ def find_columnar(
         channel_id=channel_id,
         value_property=value_property,
         time_ordered=time_ordered,
+        shard_index=shard_index,
+        shard_count=shard_count,
         **find_kwargs,
     )
 

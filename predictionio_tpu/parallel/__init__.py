@@ -16,6 +16,7 @@ from predictionio_tpu.parallel.mesh import (
 )
 from predictionio_tpu.parallel.multihost import (
     all_hosts_sum,
+    exchange_columns,
     global_array,
     host_shard_by_entity,
     host_shard_slice,
@@ -29,6 +30,7 @@ __all__ = [
     "named_sharding",
     "replicated",
     "all_hosts_sum",
+    "exchange_columns",
     "global_array",
     "host_shard_by_entity",
     "host_shard_slice",

@@ -15,7 +15,6 @@ from a laptop CPU mesh to a pod.
 
 from __future__ import annotations
 
-import hashlib
 import logging
 import os
 from typing import Iterable, List, Optional, Sequence, TypeVar
@@ -23,6 +22,8 @@ from typing import Iterable, List, Optional, Sequence, TypeVar
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from predictionio_tpu.data.storage import stable_hash as _stable_hash
 
 logger = logging.getLogger(__name__)
 
@@ -77,11 +78,6 @@ def process_count() -> int:
     return jax.process_count()
 
 
-def _stable_hash(s: str) -> int:
-    """Process-independent hash (builtin ``hash`` is salted per process)."""
-    return int.from_bytes(hashlib.md5(s.encode()).digest()[:8], "little")
-
-
 def host_shard_by_entity(
     items: Iterable[T],
     entity_id: "callable[[T], str]",
@@ -111,6 +107,49 @@ def host_shard_slice(n_total: int, n_hosts: Optional[int] = None,
     base, extra = divmod(n_total, n)
     start = h * base + min(h, extra)
     return slice(start, start + base + (1 if h < extra else 0))
+
+
+def exchange_columns(cols, time_ordered: bool = False):
+    """All-exchange of per-host columnar read shards: every host hands
+    in the EventColumns it read (its entity-hash shard of the event
+    store, ``find_columnar(shard_index=process_index())``) and receives
+    the merged FULL columns.
+
+    This is the TPU-native split of the reference's region-scan +
+    shuffle pipeline (hbase/HBPEvents.scala:48 feeding Spark shuffles):
+    the storage tier serves each byte ONCE — N hosts each fetch ~1/N of
+    the rows — and the re-assembly rides the job's own interconnect
+    (jax allgather over DCN) instead of N full scans hammering the
+    storage server. Deterministic: shards concatenate in process order,
+    so every host assembles identical columns (required — the jitted
+    collective train steps must see the same data layout everywhere).
+    Pass ``time_ordered=True`` when downstream logic needs global time
+    order (per-shard order does NOT survive concatenation).
+
+    Single-process: identity (unless a time sort was asked for).
+    """
+    if jax.process_count() == 1:
+        from predictionio_tpu.data.storage import merge_columns
+
+        return merge_columns([cols], time_ordered=time_ordered)
+    from jax.experimental import multihost_utils
+
+    from predictionio_tpu.data.storage import (
+        columns_to_npz, merge_columns, npz_to_columns,
+    )
+
+    blob = np.frombuffer(columns_to_npz(cols), np.uint8)
+    lens = np.asarray(
+        multihost_utils.process_allgather(np.array([blob.size], np.int64))
+    ).reshape(-1)
+    padded = np.zeros(int(lens.max()), np.uint8)
+    padded[: blob.size] = blob
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    parts = [
+        npz_to_columns(gathered[h, : int(lens[h])].tobytes())
+        for h in range(jax.process_count())
+    ]
+    return merge_columns(parts, time_ordered=time_ordered)
 
 
 def global_array(

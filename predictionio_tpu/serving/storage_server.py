@@ -39,6 +39,7 @@ against accidental cross-environment writes, not adversaries).
 
 from __future__ import annotations
 
+import collections
 import datetime as _dt
 import json
 import logging
@@ -252,6 +253,12 @@ class StorageRequestHandler(JSONRequestHandler):
         parsed = urlparse(self.path)
         if parsed.path == "/":
             return self._send(200, {"status": "alive"})
+        if parsed.path == "/storage/stats":
+            # operator/test observability: per-request log of columnar
+            # scans (rows served, shard asked for) — how a 2-host
+            # sharded training read is PROVEN to fetch half the rows
+            # each (the Spark-UI per-executor input-size role)
+            return self._send(200, self.server_ref.scan_stats())
         if parsed.path.startswith("/storage/models/"):
             return self._guarded(self._get_model,
                                  parsed.path[len("/storage/models/"):])
@@ -431,12 +438,22 @@ class StorageRequestHandler(JSONRequestHandler):
         if method == "find_columnar":
             # bulk training read: dict-encoded columns spooled to disk
             # as one npz; the response hands back a scan id the client
-            # streams (and resumes) via GET /storage/events/scan/<id>
+            # streams (and resumes) via GET /storage/events/scan/<id>.
+            # shard_index/shard_count (entity-hash read shards) filter
+            # SERVER-side, so a sharded reader receives ~1/N the bytes.
+            shard_index = body.get("shard_index")
+            shard_count = body.get("shard_count")
             cols = store.find_columnar(
                 app_id, channel_id=channel_id,
                 value_property=body.get("value_property"),
                 time_ordered=bool(body.get("time_ordered", True)),
+                shard_index=int(shard_index) if shard_index is not None else None,
+                shard_count=int(shard_count) if shard_count is not None else None,
                 **self._find_kwargs(body),
+            )
+            self.server_ref.record_scan(
+                app_id=app_id, rows=len(cols),
+                shard_index=shard_index, shard_count=shard_count,
             )
             scan = self.server_ref.scans.create(
                 lambda f: columns_to_npz_file(cols, f))
@@ -503,7 +520,29 @@ class StorageServer(HTTPServerBase):
         self.storage = storage if storage is not None else get_storage()
         self.auth_key = auth_key
         self.scans = _ScanRegistry(ttl=scan_ttl)
+        # bounded scan log (most recent entries) + lifetime totals: the
+        # log is observability, not an audit trail — it must not grow
+        # with request count on a long-running server
+        self._scan_log: collections.deque = collections.deque(maxlen=1000)
+        self._scan_totals = {"scans": 0, "rows": 0}
+        self._scan_log_lock = threading.Lock()
         super().__init__(host, port, StorageRequestHandler, bind_retries=bind_retries)
+
+    def record_scan(self, **entry: Any) -> None:
+        with self._scan_log_lock:
+            self._scan_log.append(entry)
+            self._scan_totals["scans"] += 1
+            self._scan_totals["rows"] += int(entry.get("rows", 0))
+
+    def scan_stats(self) -> Dict[str, Any]:
+        with self._scan_log_lock:
+            scans = list(self._scan_log)
+            totals = dict(self._scan_totals)
+        return {
+            "columnar_scans": scans,
+            "columnar_scan_count": totals["scans"],
+            "columnar_rows_served": totals["rows"],
+        }
 
     def stop(self) -> None:
         super().stop()
