@@ -36,7 +36,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-NEG_INF = jnp.float32(-1e30)
+# plain numpy scalar, NOT jnp: a module-level jnp constant would
+# materialize a device array at import time, initializing the XLA
+# backend — which forbids a later jax.distributed.initialize() and
+# breaks every multi-host entry point that imports a template first
+# (the CLI train path does). jnp ops weakly-type-promote it the same.
+NEG_INF = np.float32(-1e30)
 
 # assumed host throughput for the routing cost model (conservative
 # single-core sgemv); only the CROSSOVER matters, not the estimate's

@@ -109,6 +109,37 @@ def host_shard_slice(n_total: int, n_hosts: Optional[int] = None,
     return slice(start, start + base + (1 if h < extra else 0))
 
 
+def broadcast_string(s: str) -> str:
+    """Process 0's string on every process (identity single-process).
+
+    The workflow layer uses this for single-writer coordination: all
+    hosts run the same training program, but exactly one EngineInstance
+    row / model blob may exist per run, so every host must agree on
+    process 0's instance id (the reference has no such problem — only
+    the Spark driver JVM touches metadata, CoreWorkflow.scala:60-81).
+    """
+    if jax.process_count() == 1:
+        return s
+    from jax.experimental import multihost_utils
+
+    raw = np.frombuffer(s.encode("utf-8"), np.uint8)
+    n = int(multihost_utils.broadcast_one_to_all(np.int64(raw.size)))
+    buf = np.zeros(n, np.uint8)
+    buf[: min(raw.size, n)] = raw[:n]
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    return out.tobytes().decode("utf-8")
+
+
+def barrier(name: str) -> None:
+    """Block until every process reaches this point (no-op single
+    process). ``name`` must match across processes."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
 def exchange_columns(cols, time_ordered: bool = False):
     """All-exchange of per-host columnar read shards: every host hands
     in the EventColumns it read (its entity-hash shard of the event

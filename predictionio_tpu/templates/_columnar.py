@@ -37,9 +37,27 @@ def read_interactions(
     event_names: Sequence[str],
     target_entity_type: str,
     value_property: Optional[str] = None,
+    host_sharded: bool = True,
 ) -> InteractionColumns:
     """Bulk dict-encoded read of interaction events; rows without a
-    target id are dropped (order unspecified — consumers sort)."""
+    target id are dropped (order unspecified — consumers sort).
+
+    ``host_sharded`` (default on; no-op single-process): under
+    jax.distributed, each host scans only ITS entity-hash shard of the
+    store (``find_columnar(shard_index=process_index())`` — the
+    per-executor HBase region-scan role, hbase/HBPEvents.scala:48) and
+    the full columns are reassembled over the job's own interconnect
+    (parallel.multihost.exchange_columns), so the storage tier serves
+    each byte once instead of N full scans."""
+    shard = {}
+    n_hosts = 1
+    if host_sharded:
+        from predictionio_tpu.parallel import multihost as mh
+
+        n_hosts = mh.process_count()
+        if n_hosts > 1:
+            shard = {"shard_index": mh.process_index(),
+                     "shard_count": n_hosts}
     cols = store.find_columnar(
         app_name,
         channel_name=channel_name,
@@ -48,7 +66,10 @@ def read_interactions(
         entity_type=entity_type,
         event_names=list(event_names),
         target_entity_type=target_entity_type,
+        **shard,
     )
+    if n_hosts > 1:
+        cols = mh.exchange_columns(cols)
     keep = cols.target_codes >= 0
     return InteractionColumns(
         entity_vocab=cols.entity_vocab,

@@ -103,21 +103,40 @@ def run_train(
     workflow_params: Optional[WorkflowParams] = None,
     storage: Optional[Storage] = None,
 ) -> EngineInstance:
-    """ref: CoreWorkflow.runTrain:42. Returns the COMPLETED instance."""
+    """ref: CoreWorkflow.runTrain:42. Returns the COMPLETED instance.
+
+    Multi-host: every process runs the same engine.train (its jitted
+    steps carry the cross-host collectives), but storage is
+    single-writer — process 0 owns the EngineInstance row and the model
+    blob; the instance id is broadcast so all hosts return the same
+    instance, and a final barrier guarantees the COMPLETED row is
+    visible to every host before any of them proceeds to deploy.
+
+    Failure semantics under multi-host: an exception on any process
+    (including a storage failure on the writer) kills THAT process;
+    peers blocked in collectives or the final barrier are then failed
+    by jax.distributed's coordination service when the dead process
+    misses its heartbeat — the job errors out rather than hanging
+    forever, but detection is timeout-based, not an immediate clean
+    broadcast (same model as a lost Spark driver failing its
+    executors).
+    """
     # multi-host opt-in: PIO_COORDINATOR_ADDRESS brings up jax.distributed
     # before any mesh is built, so ctx meshes span all hosts (§7.9)
     from predictionio_tpu.parallel.compile_cache import enable_persistent_cache
-    from predictionio_tpu.parallel.multihost import initialize_from_env
+    from predictionio_tpu.parallel import multihost as mh
 
-    initialize_from_env()
+    distributed = mh.initialize_from_env()
     enable_persistent_cache()
     storage = storage or get_storage()
     ctx = ctx or MeshContext()
     wp = workflow_params or WorkflowParams()
+    writer = not distributed or mh.process_index() == 0
+    instance_id = mh.broadcast_string(uuid.uuid4().hex)
 
     ep_json = engine_params.to_json_dict()
     instance = EngineInstance(
-        id=uuid.uuid4().hex,
+        id=instance_id,
         status="INIT",
         start_time=_now(),
         end_time=_now(),
@@ -131,12 +150,16 @@ def run_train(
         algorithms_params=json.dumps(ep_json["algorithmParamsList"]),
         serving_params=json.dumps(ep_json["servingParams"]),
     )
-    storage.engine_instances().insert(instance)
+    inserted = False
+    if writer:
+        storage.engine_instances().insert(instance)
+        inserted = True
     log.info("training instance %s (engine %s)", instance.id, engine_id)
 
     try:
         instance.status = "TRAINING"
-        storage.engine_instances().update(instance)
+        if writer:
+            storage.engine_instances().update(instance)
         with _maybe_profile(instance.id):
             result: TrainResult = engine.train(ctx, engine_params, wp)
         if result.stopped_after:
@@ -144,18 +167,30 @@ def run_train(
             instance.status = "COMPLETED"
             instance.batch = (instance.batch + f" [stopped after {result.stopped_after}]").strip()
             instance.end_time = _now()
-            storage.engine_instances().update(instance)
+            if writer:
+                storage.engine_instances().update(instance)
+            mh.barrier("pio_train_" + instance.id)
             return instance
         if wp.save_model:
+            # serialization runs on EVERY process: materializing device
+            # arrays (and any PersistentModel save hooks) may involve
+            # collectives all hosts must join; only the writer stores
             blob = serialize_models(engine, engine_params, result.models, instance.id, ctx)
-            storage.models().insert(Model(id=instance.id, models=blob))
+            if writer:
+                storage.models().insert(Model(id=instance.id, models=blob))
         instance.status = "COMPLETED"
         instance.end_time = _now()
-        storage.engine_instances().update(instance)
+        if writer:
+            storage.engine_instances().update(instance)
+        # every host sees the COMPLETED row before anyone deploys from it
+        mh.barrier("pio_train_" + instance.id)
         log.info("training completed: instance %s", instance.id)
         return instance
     except Exception:
         instance.status = "FAILED"
         instance.end_time = _now()
-        storage.engine_instances().update(instance)
+        if inserted:
+            # never update a row that was never inserted (the insert
+            # itself may be what failed)
+            storage.engine_instances().update(instance)
         raise
