@@ -425,6 +425,11 @@ class EventLogEventStore(S.EventStore):
         import numpy as np
 
         S.EventStore.check_shard_params(shard_index, shard_count)
+        sharding = shard_count is not None and shard_count > 1
+        # shard filter precedes any row limit (find's order-then-
+        # truncate semantics per shard): run the native scan unlimited,
+        # filter, then limit_columns
+        shard_limit = find_kwargs.pop("limit", None) if sharding else None
         unknown = set(find_kwargs) - {
             "start_time", "until_time", "entity_type", "entity_id",
             "event_names", "target_entity_type", "target_entity_id",
@@ -502,8 +507,11 @@ class EventLogEventStore(S.EventStore):
             for p in (ent, tgt, nam, val, tim, ent_d, tgt_d, nam_d,
                       ent_o, tgt_o, nam_o):
                 self._lib.el_free(p)
-        if shard_count is not None and shard_count > 1:
+        if sharding:
             cols = S.shard_columns(cols, shard_index, shard_count)
+            cols = S.limit_columns(
+                cols, shard_limit,
+                newest_first=bool(find_kwargs.get("reversed", False)))
         return cols
 
     def insert_columnar(

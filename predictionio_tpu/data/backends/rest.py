@@ -424,6 +424,152 @@ class RestEventStore(S.EventStore):
         return int(json.loads(body)["count"])
 
 
+class ShardedRestEventStore(S.EventStore):
+    """EVENTDATA partitioned across N storage servers by entity hash —
+    the HBase region model (rowkey = MD5(entity) prefix spreads load
+    across region servers, hbase/HBEventsUtil.scala:96-108) rebuilt on
+    the framework's own storage service.
+
+    Writes route by ``stable_hash(entity_id) % N`` (all of one entity's
+    events live on one server); reads fan out to every shard and merge.
+    A down shard fails LOUDLY: the underlying transport error names the
+    shard's endpoint, and no read silently returns a partial result.
+    """
+
+    def __init__(self, stores: List[RestEventStore]):
+        assert len(stores) > 1
+        self._stores = stores
+
+    def _shard_for(self, entity_id: str) -> RestEventStore:
+        return self._stores[S.stable_hash(entity_id) % len(self._stores)]
+
+    def shard_names(self) -> List[str]:
+        return [st._t.base_url for st in self._stores]
+
+    def _map_shards(self, fn) -> List[Any]:
+        """fn(shard_store) on every shard CONCURRENTLY, results in shard
+        order — the class exists for horizontal scale, so fan-out reads
+        must overlap the per-shard network I/O, and one slow shard must
+        not serialize the others. The first shard's error propagates
+        (loud, its message names the endpoint)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=len(self._stores)) as ex:
+            return list(ex.map(fn, self._stores))
+
+    # -- lifecycle: every shard ---------------------------------------------
+    def init(self, app_id, channel_id=None):
+        self._map_shards(lambda st: st.init(app_id, channel_id))
+
+    def remove(self, app_id, channel_id=None):
+        self._map_shards(lambda st: st.remove(app_id, channel_id))
+
+    def compact(self, app_id, channel_id=None):
+        return self._map_shards(lambda st: st.compact(app_id, channel_id))
+
+    # -- writes: routed -----------------------------------------------------
+    def insert(self, event: Event, app_id, channel_id=None) -> str:
+        return self._shard_for(event.entity_id).insert(event, app_id, channel_id)
+
+    def insert_batch(self, events, app_id, channel_id=None) -> List[str]:
+        by_shard: Dict[int, List[int]] = {}
+        for pos, e in enumerate(events):
+            s = S.stable_hash(e.entity_id) % len(self._stores)
+            by_shard.setdefault(s, []).append(pos)
+        ids: List[Optional[str]] = [None] * len(events)
+        for s, positions in by_shard.items():
+            out = self._stores[s].insert_batch(
+                [events[p] for p in positions], app_id, channel_id)
+            for p, eid in zip(positions, out):
+                ids[p] = eid
+        return ids  # type: ignore[return-value]
+
+    def insert_columnar(self, cols, app_id, channel_id=None, *,
+                        entity_type, target_entity_type=None,
+                        value_property=None) -> int:
+        n = len(self._stores)
+        total = 0
+        for s in range(n):
+            part = S.shard_columns(cols, s, n)
+            if len(part):
+                total += self._stores[s].insert_columnar(
+                    part, app_id, channel_id, entity_type=entity_type,
+                    target_entity_type=target_entity_type,
+                    value_property=value_property)
+        return total
+
+    # -- point reads: the id does not encode its shard ----------------------
+    def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
+        for e in self._map_shards(
+            lambda st: st.get(event_id, app_id, channel_id)
+        ):
+            if e is not None:
+                return e
+        return None
+
+    def delete(self, event_id, app_id, channel_id=None) -> bool:
+        return any(self._map_shards(
+            lambda st: st.delete(event_id, app_id, channel_id)))
+
+    # -- scans: fan out + merge ---------------------------------------------
+    def find(self, app_id, channel_id=None, limit=None, reversed=False,
+             **find_kwargs) -> List[Event]:
+        # per-shard results are time-ordered and individually limited;
+        # the merged sort + truncation is then the global answer
+        parts = self._map_shards(
+            lambda st: st.find(app_id, channel_id=channel_id, limit=limit,
+                               reversed=reversed, **find_kwargs))
+        merged = sorted(
+            (e for part in parts for e in part),
+            key=lambda e: e.event_time, reverse=bool(reversed),
+        )
+        if limit is not None and limit >= 0:
+            merged = merged[:limit]
+        return merged
+
+    def find_columnar(self, app_id, channel_id=None, value_property=None,
+                      time_ordered=True, shard_index=None, shard_count=None,
+                      limit=None, **find_kwargs) -> S.EventColumns:
+        S.EventStore.check_shard_params(shard_index, shard_count)
+        shard = ({"shard_index": shard_index, "shard_count": shard_count}
+                 if shard_count is not None else {})
+        newest_first = bool(find_kwargs.get("reversed", False))
+        if limit is not None:
+            # per-shard limit is a bandwidth optimization: each shard's
+            # top-`limit` by time is a superset of its contribution to
+            # the global top-`limit` (truncated again after the merge)
+            find_kwargs["limit"] = limit
+        parts = self._map_shards(
+            lambda st: st.find_columnar(
+                app_id, channel_id=channel_id, value_property=value_property,
+                time_ordered=(time_ordered or limit is not None),
+                **shard, **find_kwargs))
+        merged = S.merge_columns(
+            parts, time_ordered=(time_ordered or limit is not None))
+        if limit is not None:
+            # respects `reversed` (keep the global NEWEST rows), unlike
+            # a head-truncation of the ascending merge
+            merged = S.limit_columns(merged, limit,
+                                     newest_first=newest_first)
+        elif time_ordered and newest_first and len(merged):
+            # no limit, but reversed time order was asked for: the
+            # ascending merge must flip to newest-first (find's order)
+            import numpy as np
+
+            flip = np.arange(len(merged))[::-1]
+            merged = S.EventColumns(
+                entity_codes=merged.entity_codes[flip],
+                target_codes=merged.target_codes[flip],
+                name_codes=merged.name_codes[flip],
+                values=merged.values[flip],
+                times_us=merged.times_us[flip],
+                entity_vocab=merged.entity_vocab,
+                target_vocab=merged.target_vocab,
+                names=merged.names,
+            )
+        return merged
+
+
 class _RestRepo:
     """Generic metadata repo proxy: method calls become /storage/meta RPCs."""
 
@@ -610,20 +756,47 @@ class RestModelsRepo(S.ModelsRepo):
 
 
 class RestStorageClient(S.StorageClient):
-    """Storage source of TYPE ``rest`` (HOSTS/PORTS per the env grammar)."""
+    """Storage source of TYPE ``rest`` (HOSTS/PORTS per the env grammar).
+
+    N comma-separated endpoints shard EVENTDATA by entity hash across N
+    storage servers (ShardedRestEventStore — the HBase region-server
+    fan-out role). Metadata and model blobs are NOT hash-shardable (they
+    are keyed lookups + listings) and pin to the FIRST endpoint, the way
+    the reference keeps metadata in one Elasticsearch cluster next to N
+    HBase region servers. HOSTS/PORTS zip elementwise; a single value on
+    one side broadcasts (``HOSTS=10.0.0.5 PORTS=7077,7078`` = two
+    servers on one box; ``HOSTS=a,b PORTS=7077`` = one port on two).
+    """
 
     def __init__(self, config: Dict[str, str]):
         super().__init__(config)
-        host = (config.get("HOSTS") or "127.0.0.1").split(",")[0].strip()
-        port = (config.get("PORTS") or "7077").split(",")[0].strip()
+        hosts = [h.strip() for h in
+                 (config.get("HOSTS") or "127.0.0.1").split(",")]
+        ports = [p.strip() for p in
+                 (config.get("PORTS") or "7077").split(",")]
+        if len(hosts) == 1 and len(ports) > 1:
+            hosts = hosts * len(ports)
+        if len(ports) == 1 and len(hosts) > 1:
+            ports = ports * len(hosts)
+        if len(hosts) != len(ports):
+            raise S.StorageError(
+                f"rest source: {len(hosts)} HOSTS vs {len(ports)} PORTS "
+                "(must match, or one side must be a single value)"
+            )
         scheme = config.get("SCHEME", "http")
         timeout = float(config.get("TIMEOUT", "30"))
         retries = int(config.get("RETRIES", "3"))
-        self._transport = _Transport(
-            f"{scheme}://{host}:{port}", config.get("AUTH_KEY"), timeout,
-            retries=retries,
-        )
-        self._events = RestEventStore(self._transport)
+        self._transports = [
+            _Transport(f"{scheme}://{h}:{p}", config.get("AUTH_KEY"),
+                       timeout, retries=retries)
+            for h, p in zip(hosts, ports)
+        ]
+        self._transport = self._transports[0]  # metadata/models home
+        if len(self._transports) == 1:
+            self._events: S.EventStore = RestEventStore(self._transport)
+        else:
+            self._events = ShardedRestEventStore(
+                [RestEventStore(t) for t in self._transports])
         self._apps = RestAppsRepo(self._transport)
         self._access_keys = RestAccessKeysRepo(self._transport)
         self._channels = RestChannelsRepo(self._transport)
@@ -642,12 +815,34 @@ class RestStorageClient(S.StorageClient):
     def models(self): return self._models
 
     def health_check(self) -> bool:
-        """`pio status` probe: the server must answer GET / as alive."""
-        try:
-            status, body = self._transport.request("/", method="GET")
-        except S.StorageError:
-            return False
-        return status == 200 and json.loads(body).get("status") == "alive"
+        """`pio status` probe: EVERY shard must answer GET / as alive."""
+        return all(self.health_detail().values())
+
+    def health_detail(self) -> Dict[str, bool]:
+        """Per-endpoint liveness, keyed by shard URL — `pio status`
+        names the down shard instead of a bare FAILED. Deliberately
+        conservative for the repos pinned to the first endpoint
+        (metadata/models): ANY down shard marks the source unhealthy,
+        because a partially-down event tier makes training reads fail
+        even while metadata lookups still answer."""
+        def probe(t: _Transport) -> bool:
+            try:
+                status, body = t.request("/", method="GET")
+                return (status == 200
+                        and json.loads(body).get("status") == "alive")
+            except (S.StorageError, ValueError):
+                # ValueError: a 200 with a non-JSON body (e.g. a proxy
+                # error page) is just as dead as a refused connection —
+                # it must mark THIS shard down, not abort the probe
+                return False
+
+        # concurrent: a down shard waiting out its timeout must not
+        # stall the probes of the healthy ones
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=len(self._transports)) as ex:
+            alive = list(ex.map(probe, self._transports))
+        return {t.base_url: a for t, a in zip(self._transports, alive)}
 
 
 S.register_backend("rest", RestStorageClient)

@@ -150,6 +150,33 @@ def shard_columns(cols: EventColumns, shard_index: int,
     return _compact_columns(cols, keep)
 
 
+def limit_columns(cols: EventColumns, limit: Optional[int],
+                  newest_first: bool = False) -> EventColumns:
+    """The ``limit`` rows of ``cols`` by event time (newest when
+    ``newest_first``), vocabularies compacted — how every shard-composed
+    path applies a row limit AFTER its shard filter, matching find's
+    order-then-truncate contract."""
+    if limit is None or limit < 0 or len(cols) <= limit:
+        return cols
+    import numpy as np
+
+    order = np.argsort(cols.times_us, kind="stable")
+    if newest_first:
+        order = order[::-1]
+    take = order[:limit]
+    sub = EventColumns(
+        entity_codes=cols.entity_codes[take],
+        target_codes=cols.target_codes[take],
+        name_codes=cols.name_codes[take],
+        values=cols.values[take],
+        times_us=cols.times_us[take],
+        entity_vocab=cols.entity_vocab,
+        target_vocab=cols.target_vocab,
+        names=cols.names,
+    )
+    return _compact_columns(sub, np.ones(limit, np.bool_))
+
+
 def merge_columns(parts: Sequence[EventColumns],
                   time_ordered: bool = False) -> EventColumns:
     """Concatenate columnar scan results (e.g. one per storage shard)
@@ -413,12 +440,19 @@ class EventStore(abc.ABC):
         import numpy as np
 
         self.check_shard_params(shard_index, shard_count)
+        sharding = shard_count is not None and shard_count > 1
+        # a row limit applies AFTER the shard filter (find's
+        # order-then-truncate semantics per shard), so the limited scan
+        # must run unlimited first when a shard filter is active
+        limit = find_kwargs.pop("limit", None) if sharding else None
         events = self.find(app_id, channel_id=channel_id, **find_kwargs)
-        if shard_count is not None and shard_count > 1:
+        if sharding:
             events = [
                 e for e in events
                 if stable_hash(e.entity_id) % shard_count == shard_index
             ]
+            if limit is not None and limit >= 0:
+                events = events[:limit]
         n = len(events)
         ent_codes = np.empty(n, np.int32)
         tgt_codes = np.empty(n, np.int32)
@@ -747,6 +781,28 @@ class Storage:
             except Exception:
                 results[repo] = False
         return results
+
+    def health_details(self) -> Dict[str, Dict[str, bool]]:
+        """Per-repo, per-shard health for backends that expose it (the
+        sharded rest source) — `pio status` names a down shard instead
+        of a bare repo-level FAILED. Single-shard backends report one
+        empty-named entry."""
+        out: Dict[str, Dict[str, bool]] = {}
+        probed: Dict[int, Dict[str, bool]] = {}  # one probe per client,
+        # not per repo — three repos on one source ping its shards once
+        for repo in REPOSITORIES:
+            try:
+                client = self.client_for(repo)
+                cached = probed.get(id(client))
+                if cached is None:
+                    detail = getattr(client, "health_detail", None)
+                    cached = (dict(detail()) if detail is not None
+                              else {"": client.health_check()})
+                    probed[id(client)] = cached
+                out[repo] = dict(cached)
+            except Exception:
+                out[repo] = {"": False}
+        return out
 
     # -- construction -------------------------------------------------------
     @staticmethod

@@ -415,10 +415,19 @@ def cmd_run(args) -> int:
 
 
 def cmd_status(args) -> int:
-    results = commands.status()
-    ok = all(results.values())
-    for repo, good in sorted(results.items()):
+    from predictionio_tpu.data.storage import get_storage
+
+    details = get_storage().health_details()
+    ok = all(all(shards.values()) for shards in details.values())
+    for repo, shards in sorted(details.items()):
+        good = all(shards.values())
         _p(f"{repo}: {'OK' if good else 'FAILED'}")
+        if len(shards) > 1 or not good:
+            # sharded source (or a failure): name each shard so a down
+            # one is identified, not just counted
+            for shard, alive in sorted(shards.items()):
+                if shard:
+                    _p(f"  shard {shard}: {'OK' if alive else 'DOWN'}")
     _p("(sleeping)" if ok else "Unable to connect to all storage backends.")
     return 0 if ok else 1
 

@@ -165,6 +165,27 @@ def test_find_columnar_shards_union_to_full_scan(sharded_store):
             assert stable_hash(ent) % 2 == i
 
 
+def test_find_columnar_shard_filter_precedes_limit(sharded_store):
+    """A row limit applies AFTER the entity-hash shard filter — the
+    shard's first `limit` rows, not the shard subset of the first
+    `limit` rows overall (code-review regression)."""
+    store = sharded_store
+    _seed_events(store)
+    full = store.find_columnar(1, time_ordered=True,
+                               shard_index=0, shard_count=2)
+    limited = store.find_columnar(1, time_ordered=True, limit=5,
+                                  shard_index=0, shard_count=2)
+    assert len(limited) == 5
+    assert list(limited.times_us) == list(full.times_us[:5])
+    for ent in limited.entity_vocab:
+        assert stable_hash(ent) % 2 == 0
+
+    newest = store.find_columnar(1, time_ordered=True, limit=5,
+                                 reversed=True,
+                                 shard_index=0, shard_count=2)
+    assert list(newest.times_us) == list(full.times_us[-5:][::-1])
+
+
 def test_find_columnar_shard_param_validation(sharded_store):
     store = sharded_store
     store.init(1)
