@@ -20,6 +20,7 @@ Source config (reference env grammar, conf/pio-env.sh.template):
 from __future__ import annotations
 
 import json
+import logging
 import random
 import time
 import urllib.error
@@ -39,6 +40,8 @@ from predictionio_tpu.data.metadata import (
     Model,
 )
 from predictionio_tpu.data import storage as S
+
+log = logging.getLogger(__name__)
 
 
 class _Transport:
@@ -434,28 +437,104 @@ class ShardedRestEventStore(S.EventStore):
     events live on one server); reads fan out to every shard and merge.
     A down shard fails LOUDLY: the underlying transport error names the
     shard's endpoint, and no read silently returns a partial result.
+
+    ``replicas=R`` adds successor replication (the HDFS-under-HBase
+    role): shard k's rows are written synchronously to servers
+    k..k+R-1 (mod N), and reads pick the first LIVE server of each
+    shard's replica set, asking it for shard k's rows only (the
+    server-side shard filter keeps replica-held foreign shards out), so
+    any R-1 servers can be down and every read still completes with the
+    full data. Write availability intentionally requires a shard's
+    whole replica set up: a failed replica write fails loudly, rolls
+    back the copies already written (row path, by client-stamped id;
+    best-effort), and writes land successors-first/owner-last so any
+    un-rolled-back partial sits where owner-preferring reads don't
+    look. Row-path inserts stamp event ids CLIENT-side so all copies
+    share one id (get/delete/rollback stay consistent); bulk columnar
+    ingest replicates rows but each copy gets its own server-assigned
+    id — fine for the immutable interaction logs it exists for, not for
+    rows that will be point-deleted, and a mid-ingest failure is
+    recovered by re-running the ingest.
     """
 
-    def __init__(self, stores: List[RestEventStore]):
+    def __init__(self, stores: List[RestEventStore], replicas: int = 1):
         assert len(stores) > 1
+        if not 1 <= replicas <= len(stores):
+            raise S.StorageError(
+                f"REPLICAS={replicas} needs between 1 and {len(stores)} "
+                "(the endpoint count) storage servers"
+            )
         self._stores = stores
+        self._replicas = replicas
+
+    def _shard_of(self, entity_id: str) -> int:
+        return S.stable_hash(entity_id) % len(self._stores)
 
     def _shard_for(self, entity_id: str) -> RestEventStore:
-        return self._stores[S.stable_hash(entity_id) % len(self._stores)]
+        return self._stores[self._shard_of(entity_id)]
+
+    def _owners(self, shard: int) -> List[int]:
+        """Server indexes holding shard ``shard``, owner first."""
+        n = len(self._stores)
+        return [(shard + r) % n for r in range(self._replicas)]
 
     def shard_names(self) -> List[str]:
         return [st._t.base_url for st in self._stores]
 
-    def _map_shards(self, fn) -> List[Any]:
-        """fn(shard_store) on every shard CONCURRENTLY, results in shard
-        order — the class exists for horizontal scale, so fan-out reads
-        must overlap the per-shard network I/O, and one slow shard must
-        not serialize the others. The first shard's error propagates
-        (loud, its message names the endpoint)."""
+    def _pmap(self, items, fn) -> List[Any]:
+        """fn(item) concurrently, results in order — fan-out reads must
+        overlap the per-shard network I/O, and one slow shard must not
+        serialize the others. The first error propagates (loud, the
+        transport message names the endpoint)."""
         from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(max_workers=len(self._stores)) as ex:
-            return list(ex.map(fn, self._stores))
+        items = list(items)
+        with ThreadPoolExecutor(max_workers=max(1, len(items))) as ex:
+            return list(ex.map(fn, items))
+
+    def _map_shards(self, fn) -> List[Any]:
+        return self._pmap(self._stores, fn)
+
+    def _assign_live_servers(self) -> Dict[int, List[int]]:
+        """server index -> shards it should answer for, choosing each
+        shard's first LIVE replica (one cheap concurrent liveness probe,
+        then each distinct server is scanned once). Raises when some
+        shard's whole replica set is down, naming the shard."""
+        def probe(st: RestEventStore) -> bool:
+            try:
+                st._t.request("/", method="GET")
+                return True
+            except S.StorageError:
+                return False
+
+        alive = self._pmap(self._stores, probe)
+        assignment: Dict[int, List[int]] = {}
+        for k in range(len(self._stores)):
+            srv = next((o for o in self._owners(k) if alive[o]), None)
+            if srv is None:
+                raise S.StorageUnavailableError(
+                    f"event shard {k}: every replica is down "
+                    f"({', '.join(self._stores[o]._t.base_url for o in self._owners(k))})"
+                )
+            if srv != k:
+                log.warning("shard %d: owner down, reading from replica %s",
+                            k, self._stores[srv]._t.base_url)
+            assignment.setdefault(srv, []).append(k)
+        return assignment
+
+    def _first_live(self, shard: int, fn):
+        """fn(store) against the first live server of the shard's
+        replica set — read failover. Only connection-level failures
+        advance to the next replica; application errors propagate."""
+        last: Optional[Exception] = None
+        for s in self._owners(shard):
+            try:
+                return fn(self._stores[s])
+            except S.StorageUnavailableError as e:
+                log.warning("shard %d: %s down, trying next replica: %s",
+                            shard, self._stores[s]._t.base_url, e)
+                last = e
+        raise last  # every replica of this shard is down
 
     # -- lifecycle: every shard ---------------------------------------------
     def init(self, app_id, channel_id=None):
@@ -467,19 +546,65 @@ class ShardedRestEventStore(S.EventStore):
     def compact(self, app_id, channel_id=None):
         return self._map_shards(lambda st: st.compact(app_id, channel_id))
 
-    # -- writes: routed -----------------------------------------------------
+    # -- writes: routed (to every replica when replicas > 1) ----------------
+    #
+    # Replica-write consistency: copies are written SUCCESSORS-FIRST,
+    # owner last — reads prefer the owner, so a partial failure leaves
+    # phantom rows only on replicas no healthy read consults — and a
+    # row-path failure additionally ROLLS BACK the already-written
+    # copies by their client-stamped ids (best-effort; a rollback
+    # failure is logged and the original error still raised). Bulk
+    # columnar ingest has no ids to roll back by: a failed replica
+    # write there means re-running the ingest (documented).
+
+    def _rollback(self, written: List[int], event_ids: List[str],
+                  app_id, channel_id) -> None:
+        for s in written:
+            for eid in event_ids:
+                try:
+                    self._stores[s].delete(eid, app_id, channel_id)
+                except S.StorageError:
+                    log.warning(
+                        "replica write rollback failed on %s for %s — "
+                        "copies diverged until the delete is replayed",
+                        self._stores[s]._t.base_url, eid)
+
     def insert(self, event: Event, app_id, channel_id=None) -> str:
-        return self._shard_for(event.entity_id).insert(event, app_id, channel_id)
+        shard = self._shard_of(event.entity_id)
+        if self._replicas == 1:
+            return self._stores[shard].insert(event, app_id, channel_id)
+        # one CLIENT-assigned id shared by every copy, so point reads,
+        # deletes and rollbacks address all replicas consistently
+        event = event if event.event_id else event.with_id()
+        written: List[int] = []
+        try:
+            for s in reversed(self._owners(shard)):
+                self._stores[s].insert(event, app_id, channel_id)
+                written.append(s)
+        except S.StorageError:
+            self._rollback(written, [event.event_id], app_id, channel_id)
+            raise
+        return event.event_id
 
     def insert_batch(self, events, app_id, channel_id=None) -> List[str]:
+        if self._replicas > 1:
+            events = [e if e.event_id else e.with_id() for e in events]
         by_shard: Dict[int, List[int]] = {}
         for pos, e in enumerate(events):
-            s = S.stable_hash(e.entity_id) % len(self._stores)
-            by_shard.setdefault(s, []).append(pos)
+            by_shard.setdefault(self._shard_of(e.entity_id), []).append(pos)
         ids: List[Optional[str]] = [None] * len(events)
-        for s, positions in by_shard.items():
-            out = self._stores[s].insert_batch(
-                [events[p] for p in positions], app_id, channel_id)
+        for shard, positions in by_shard.items():
+            batch = [events[p] for p in positions]
+            written: List[int] = []
+            try:
+                for s in reversed(self._owners(shard)):
+                    out = self._stores[s].insert_batch(batch, app_id, channel_id)
+                    written.append(s)
+            except S.StorageError:
+                if self._replicas > 1:
+                    self._rollback(written, [e.event_id for e in batch],
+                                   app_id, channel_id)
+                raise
             for p, eid in zip(positions, out):
                 ids[p] = eid
         return ids  # type: ignore[return-value]
@@ -489,36 +614,87 @@ class ShardedRestEventStore(S.EventStore):
                         value_property=None) -> int:
         n = len(self._stores)
         total = 0
-        for s in range(n):
-            part = S.shard_columns(cols, s, n)
+        for shard in range(n):
+            part = S.shard_columns(cols, shard, n)
             if len(part):
-                total += self._stores[s].insert_columnar(
-                    part, app_id, channel_id, entity_type=entity_type,
-                    target_entity_type=target_entity_type,
-                    value_property=value_property)
+                # successors first, owner last: a partial failure's
+                # phantom copies sit where owner-preferring reads don't
+                # look; rows carry no client ids, so recovery from a
+                # mid-ingest failure is re-running the ingest
+                for s in reversed(self._owners(shard)):
+                    count = self._stores[s].insert_columnar(
+                        part, app_id, channel_id, entity_type=entity_type,
+                        target_entity_type=target_entity_type,
+                        value_property=value_property)
+                total += count
         return total
 
     # -- point reads: the id does not encode its shard ----------------------
     def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
-        for e in self._map_shards(
-            lambda st: st.get(event_id, app_id, channel_id)
-        ):
-            if e is not None:
+        if self._replicas == 1:
+            results = self._map_shards(
+                lambda st: st.get(event_id, app_id, channel_id))
+            return next((e for e in results if e is not None), None)
+
+        # replicated read: a down server is tolerated as long as every
+        # shard still has a live replica — then a miss is a REAL miss
+        def probe(i):
+            try:
+                return self._stores[i].get(event_id, app_id, channel_id)
+            except S.StorageUnavailableError as e:
                 return e
+
+        results = self._pmap(range(len(self._stores)), probe)
+        for r in results:
+            if isinstance(r, Event):
+                return r
+        down = {i for i, r in enumerate(results)
+                if isinstance(r, S.StorageUnavailableError)}
+        for k in range(len(self._stores)):
+            if all(o in down for o in self._owners(k)):
+                raise next(r for r in results
+                           if isinstance(r, S.StorageUnavailableError))
         return None
 
     def delete(self, event_id, app_id, channel_id=None) -> bool:
+        # a delete is a WRITE: it must reach every replica (a copy left
+        # on a down server would resurrect on recovery), so server
+        # unavailability propagates — same strictness as inserts
         return any(self._map_shards(
             lambda st: st.delete(event_id, app_id, channel_id)))
 
-    # -- scans: fan out + merge ---------------------------------------------
+    # -- scans: fan out (one live replica per shard) + merge ----------------
     def find(self, app_id, channel_id=None, limit=None, reversed=False,
              **find_kwargs) -> List[Event]:
-        # per-shard results are time-ordered and individually limited;
-        # the merged sort + truncation is then the global answer
-        parts = self._map_shards(
-            lambda st: st.find(app_id, channel_id=channel_id, limit=limit,
-                               reversed=reversed, **find_kwargs))
+        n = len(self._stores)
+        if self._replicas == 1:
+            # per-shard results are time-ordered and individually
+            # limited; the merged sort + truncation is the global answer
+            parts = self._map_shards(
+                lambda st: st.find(app_id, channel_id=channel_id,
+                                   limit=limit, reversed=reversed,
+                                   **find_kwargs))
+        else:
+            # replicated: the row-path wire has no shard filter, so a
+            # chosen replica returns its FULL event set. Resolve one
+            # live server per shard first and scan each distinct server
+            # ONCE (splitting its rows among the shards assigned to
+            # it) — otherwise two shards failing over to one server
+            # would scan it twice, exactly when the cluster is
+            # degraded. Per-shard limit doesn't apply here (a server's
+            # first `limit` rows overall are not shard k's first).
+            assignment = self._assign_live_servers()
+
+            def scan(item):
+                srv, shards = item
+                part = self._stores[srv].find(
+                    app_id, channel_id=channel_id, reversed=reversed,
+                    **find_kwargs)
+                mine = set(shards)
+                return [e for e in part
+                        if S.stable_hash(e.entity_id) % n in mine]
+
+            parts = self._pmap(assignment.items(), scan)
         merged = sorted(
             (e for part in parts for e in part),
             key=lambda e: e.event_time, reverse=bool(reversed),
@@ -531,19 +707,45 @@ class ShardedRestEventStore(S.EventStore):
                       time_ordered=True, shard_index=None, shard_count=None,
                       limit=None, **find_kwargs) -> S.EventColumns:
         S.EventStore.check_shard_params(shard_index, shard_count)
-        shard = ({"shard_index": shard_index, "shard_count": shard_count}
-                 if shard_count is not None else {})
+        host_shard = ({"shard_index": shard_index, "shard_count": shard_count}
+                      if shard_count is not None else {})
         newest_first = bool(find_kwargs.get("reversed", False))
         if limit is not None:
             # per-shard limit is a bandwidth optimization: each shard's
             # top-`limit` by time is a superset of its contribution to
             # the global top-`limit` (truncated again after the merge)
             find_kwargs["limit"] = limit
-        parts = self._map_shards(
-            lambda st: st.find_columnar(
-                app_id, channel_id=channel_id, value_property=value_property,
-                time_ordered=(time_ordered or limit is not None),
-                **shard, **find_kwargs))
+        n = len(self._stores)
+        if self._replicas == 1:
+            parts = self._map_shards(
+                lambda st: st.find_columnar(
+                    app_id, channel_id=channel_id,
+                    value_property=value_property,
+                    time_ordered=(time_ordered or limit is not None),
+                    **host_shard, **find_kwargs))
+        else:
+            # replicated: the ONE server-side shard-filter pair carries
+            # the PLACEMENT filter (keeps the replica's foreign shards
+            # out); a requested host read shard is applied client-side
+            # on each part instead
+            kw = dict(find_kwargs)
+            if host_shard:
+                # the client-side host filter must precede any limit, so
+                # the per-shard limit optimization is off in this combo
+                kw.pop("limit", None)
+
+            def one_shard(k):
+                part = self._first_live(
+                    k, lambda st: st.find_columnar(
+                        app_id, channel_id=channel_id,
+                        value_property=value_property,
+                        time_ordered=(time_ordered or limit is not None),
+                        shard_index=k, shard_count=n, **kw))
+                if host_shard:
+                    part = S.shard_columns(part, shard_index, shard_count)
+                return part
+
+            parts = self._pmap(range(n), one_shard)
         merged = S.merge_columns(
             parts, time_ordered=(time_ordered or limit is not None))
         if limit is not None:
@@ -766,6 +968,9 @@ class RestStorageClient(S.StorageClient):
     HBase region servers. HOSTS/PORTS zip elementwise; a single value on
     one side broadcasts (``HOSTS=10.0.0.5 PORTS=7077,7078`` = two
     servers on one box; ``HOSTS=a,b PORTS=7077`` = one port on two).
+    ``REPLICAS=R`` (default 1) adds successor replication of the event
+    shards — any R-1 servers down, reads still complete (the
+    HDFS-replication-under-HBase role; see ShardedRestEventStore).
     """
 
     def __init__(self, config: Dict[str, str]):
@@ -792,11 +997,18 @@ class RestStorageClient(S.StorageClient):
             for h, p in zip(hosts, ports)
         ]
         self._transport = self._transports[0]  # metadata/models home
+        replicas = int(config.get("REPLICAS", "1"))
         if len(self._transports) == 1:
+            if replicas > 1:
+                raise S.StorageError(
+                    f"REPLICAS={replicas} needs multiple endpoints "
+                    "(comma-separated HOSTS/PORTS)"
+                )
             self._events: S.EventStore = RestEventStore(self._transport)
         else:
             self._events = ShardedRestEventStore(
-                [RestEventStore(t) for t in self._transports])
+                [RestEventStore(t) for t in self._transports],
+                replicas=replicas)
         self._apps = RestAppsRepo(self._transport)
         self._access_keys = RestAccessKeysRepo(self._transport)
         self._channels = RestChannelsRepo(self._transport)

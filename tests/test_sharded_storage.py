@@ -9,6 +9,7 @@ down shard fails loudly naming its endpoint; `pio status` reports
 per-shard health.
 """
 
+import dataclasses
 import datetime as _dt
 
 import numpy as np
@@ -39,8 +40,8 @@ def _memory_storage() -> Storage:
     })
 
 
-def _client(ports) -> Storage:
-    return Storage.from_env({
+def _client(ports, replicas=None) -> Storage:
+    env = {
         "PIO_STORAGE_SOURCES_SH_TYPE": "rest",
         "PIO_STORAGE_SOURCES_SH_HOSTS": "127.0.0.1",
         "PIO_STORAGE_SOURCES_SH_PORTS": ",".join(str(p) for p in ports),
@@ -52,7 +53,10 @@ def _client(ports) -> Storage:
         "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SH",
         "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "models",
         "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SH",
-    })
+    }
+    if replicas is not None:
+        env["PIO_STORAGE_SOURCES_SH_REPLICAS"] = str(replicas)
+    return Storage.from_env(env)
 
 
 @pytest.fixture()
@@ -221,6 +225,148 @@ def test_down_shard_fails_loudly_naming_it(two_servers):
     assert ev[f"http://127.0.0.1:{servers[0].port}"] is True
     assert ev[dead_url] is False
     assert client.verify_all_data_objects()["EVENTDATA"] is False
+
+
+@pytest.fixture()
+def three_servers_r2():
+    """Three storage servers, REPLICAS=2: shard k lives on servers k and
+    k+1 (mod 3) — any ONE server can die and reads stay complete."""
+    backends = [_memory_storage() for _ in range(3)]
+    servers = [
+        StorageServer(storage=b, host="127.0.0.1", port=0).start()
+        for b in backends
+    ]
+    try:
+        yield backends, servers, _client([s.port for s in servers],
+                                         replicas=2)
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_replicated_writes_land_on_every_replica(three_servers_r2):
+    backends, _, client = three_servers_r2
+    store = client.events()
+    store.init(1)
+    events = _events(n=60)
+    ids = store.insert_batch(events, 1)
+    assert len(set(ids)) == len(events)
+
+    # every row exists on exactly 2 of the 3 servers, same id on both
+    per_server = [
+        {e.event_id for e in b.events().find(1)} for b in backends
+    ]
+    assert sum(len(p) for p in per_server) == 2 * len(events)
+    for eid, ev in zip(ids, events):
+        holders = [s for s, p in enumerate(per_server) if eid in p]
+        shard = stable_hash(ev.entity_id) % 3
+        assert holders == sorted({shard, (shard + 1) % 3})
+
+    # reads with all servers up: no duplicates
+    assert len(store.find(1)) == len(events)
+    cols = store.find_columnar(1, time_ordered=False)
+    assert len(cols) == len(events)
+
+    # delete removes every copy
+    assert store.delete(ids[0], 1) is True
+    assert all(ids[0] not in {e.event_id for e in b.events().find(1)}
+               for b in backends)
+
+
+def test_replicated_reads_survive_one_server_down(three_servers_r2):
+    backends, servers, client = three_servers_r2
+    store = client.events()
+    store.init(1)
+    events = _events(n=60)
+    store.insert_batch(events, 1)
+    oracle_rows = sorted(
+        (e.entity_id, e.target_entity_id, e.event_time) for e in events)
+
+    servers[1].stop()  # kill one replica; every shard still has a copy
+
+    merged = store.find(1)
+    assert sorted((e.entity_id, e.target_entity_id, e.event_time)
+                  for e in merged) == oracle_rows
+    cols = store.find_columnar(1, value_property="rating",
+                               time_ordered=False)
+    assert len(cols) == len(events)
+
+    # limit + reversed still the global newest
+    newest = store.find_columnar(1, time_ordered=True, limit=5,
+                                 reversed=True)
+    exp = sorted((e.event_time for e in events), reverse=True)[:5]
+    assert [int(t.timestamp() * 1e6) for t in exp] == list(newest.times_us)
+
+    # host read shards compose (client-side under replication)
+    host_shards = [
+        store.find_columnar(1, time_ordered=False, shard_index=h,
+                            shard_count=2)
+        for h in range(2)
+    ]
+    assert sum(len(s) for s in host_shards) == len(events)
+
+    # point reads still answer from the surviving copy
+    eid = merged[0].event_id
+    assert store.get(eid, 1) is not None
+
+
+def test_partial_replica_write_rolls_back():
+    """A replica write that fails midway must not leave a copy that
+    reads would serve: the already-written copies are deleted by their
+    client-stamped ids (code-review regression)."""
+    backends = [_memory_storage(), _memory_storage()]
+    servers = [
+        StorageServer(storage=b, host="127.0.0.1", port=0).start()
+        for b in backends
+    ]
+    try:
+        client = _client([s.port for s in servers], replicas=2)
+        store = client.events()
+        store.init(1)
+
+        def uid_for_shard(s):
+            i = 0
+            while stable_hash(f"user_{i}") % 2 != s:
+                i += 1
+            return f"user_{i}"
+
+        servers[0].stop()
+        ev = _events(n=1)[0]
+
+        # owner = dead server 0: the successor (server 1) is written
+        # first, the owner write fails, and the rollback removes the
+        # successor's copy — the live server serves nothing
+        ev_owner_dead = dataclasses.replace(ev, entity_id=uid_for_shard(0))
+        with pytest.raises(StorageUnavailableError):
+            store.insert(ev_owner_dead, 1)
+        assert backends[1].events().find(1) == []
+
+        # owner = live server 1: its successor (server 0) is written
+        # FIRST and is dead, so nothing lands anywhere
+        ev_successor_dead = dataclasses.replace(
+            ev, entity_id=uid_for_shard(1))
+        with pytest.raises(StorageUnavailableError):
+            store.insert(ev_successor_dead, 1)
+        assert backends[1].events().find(1) == []
+
+        # batch path rolls back too
+        batch = [dataclasses.replace(e, entity_id=uid_for_shard(0))
+                 for e in _events(n=5)]
+        with pytest.raises(StorageUnavailableError):
+            store.insert_batch(batch, 1)
+        assert backends[1].events().find(1) == []
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_replicas_exceeding_servers_rejected():
+    from predictionio_tpu.data.storage import StorageError
+
+    with pytest.raises(StorageError):
+        _client([7001, 7002], replicas=3)
+    with pytest.raises(StorageError):
+        _client([7001], replicas=2)
 
 
 def test_metadata_and_models_pin_to_first_shard(two_servers):
