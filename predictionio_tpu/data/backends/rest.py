@@ -266,7 +266,14 @@ class RestEventStore(S.EventStore):
         target_entity_id=S.UNSET,
         limit=None,
         reversed=False,
+        placement_shards=None,
+        placement_count=None,
     ) -> List[Event]:
+        """``placement_shards``/``placement_count`` (beyond the abstract
+        contract; used by ShardedRestEventStore under replication) ask
+        the SERVER to return only rows whose entity hash-routes to one
+        of those shards — a replica holding R shards' copies then sends
+        one shard's bytes, not its whole event set."""
         payload = self._find_payload(app_id, channel_id, {
             "start_time": start_time, "until_time": until_time,
             "entity_type": entity_type, "entity_id": entity_id,
@@ -275,6 +282,9 @@ class RestEventStore(S.EventStore):
             "target_entity_id": target_entity_id,
             "limit": limit, "reversed": reversed,
         })
+        if placement_count is not None:
+            payload["placement_shards"] = [int(x) for x in placement_shards]
+            payload["placement_count"] = int(placement_count)
         # a read: on a mid-stream connection drop, retry the whole scan
         last = None
         for attempt in range(1 + self._t.retries):
@@ -453,8 +463,9 @@ class ShardedRestEventStore(S.EventStore):
     share one id (get/delete/rollback stay consistent); bulk columnar
     ingest replicates rows but each copy gets its own server-assigned
     id — fine for the immutable interaction logs it exists for, not for
-    rows that will be point-deleted, and a mid-ingest failure is
-    recovered by re-running the ingest.
+    rows that will be point-deleted; a mid-ingest failure is recovered
+    by ``remove()`` + re-init + re-ingest, NOT a blind re-run (which
+    would duplicate rows on replicas that already took the part).
     """
 
     def __init__(self, stores: List[RestEventStore], replicas: int = 1):
@@ -485,11 +496,12 @@ class ShardedRestEventStore(S.EventStore):
         """fn(item) concurrently, results in order — fan-out reads must
         overlap the per-shard network I/O, and one slow shard must not
         serialize the others. The first error propagates (loud, the
-        transport message names the endpoint)."""
+        transport message names the endpoint). Worker count is bounded:
+        rollbacks can fan over thousands of (server, id) pairs."""
         from concurrent.futures import ThreadPoolExecutor
 
         items = list(items)
-        with ThreadPoolExecutor(max_workers=max(1, len(items))) as ex:
+        with ThreadPoolExecutor(max_workers=min(16, max(1, len(items)))) as ex:
             return list(ex.map(fn, items))
 
     def _map_shards(self, fn) -> List[Any]:
@@ -557,54 +569,69 @@ class ShardedRestEventStore(S.EventStore):
     # columnar ingest has no ids to roll back by: a failed replica
     # write there means re-running the ingest (documented).
 
-    def _rollback(self, written: List[int], event_ids: List[str],
-                  app_id, channel_id) -> None:
-        for s in written:
-            for eid in event_ids:
-                try:
-                    self._stores[s].delete(eid, app_id, channel_id)
-                except S.StorageError:
-                    log.warning(
-                        "replica write rollback failed on %s for %s — "
-                        "copies diverged until the delete is replayed",
-                        self._stores[s]._t.base_url, eid)
+    def _rollback(self, written: List[tuple], app_id, channel_id) -> None:
+        """Best-effort delete of already-written copies: ``written`` is
+        (server index, [event ids]) pairs, fanned out concurrently (a
+        1000-row rollback must not serialize 1000 round-trips on the
+        failure path)."""
+        pairs = [(s, eid) for s, eids in written for eid in eids]
+
+        def drop(pair):
+            s, eid = pair
+            try:
+                self._stores[s].delete(eid, app_id, channel_id)
+            except S.StorageError:
+                log.warning(
+                    "replica write rollback failed on %s for %s — "
+                    "copies diverged until the delete is replayed",
+                    self._stores[s]._t.base_url, eid)
+
+        if pairs:
+            self._pmap(pairs, drop)
 
     def insert(self, event: Event, app_id, channel_id=None) -> str:
-        shard = self._shard_of(event.entity_id)
-        if self._replicas == 1:
-            return self._stores[shard].insert(event, app_id, channel_id)
         # one CLIENT-assigned id shared by every copy, so point reads,
         # deletes and rollbacks address all replicas consistently
         event = event if event.event_id else event.with_id()
-        written: List[int] = []
-        try:
-            for s in reversed(self._owners(shard)):
+        written: List[tuple] = []
+        for s in reversed(self._owners(self._shard_of(event.entity_id))):
+            try:
                 self._stores[s].insert(event, app_id, channel_id)
-                written.append(s)
-        except S.StorageError:
-            self._rollback(written, [event.event_id], app_id, channel_id)
-            raise
+            except S.StorageError:
+                # roll back the committed copies AND the failing server:
+                # a connection drop AFTER the server committed raises
+                # here too, and the idempotent delete covers both
+                # outcomes (the client-stamped id names every copy)
+                self._rollback(written + [(s, [event.event_id])],
+                               app_id, channel_id)
+                raise
+            written.append((s, [event.event_id]))
         return event.event_id
 
     def insert_batch(self, events, app_id, channel_id=None) -> List[str]:
-        if self._replicas > 1:
-            events = [e if e.event_id else e.with_id() for e in events]
+        # ids are client-stamped at ANY replica count so a failure can
+        # roll back every copy — including a commit-then-drop on the
+        # very server that raised
+        events = [e if e.event_id else e.with_id() for e in events]
         by_shard: Dict[int, List[int]] = {}
         for pos, e in enumerate(events):
             by_shard.setdefault(self._shard_of(e.entity_id), []).append(pos)
         ids: List[Optional[str]] = [None] * len(events)
+        # rollback scope is the WHOLE batch, across shard groups: a
+        # caller retrying a "failed" batch gets fresh ids, so any
+        # committed group left behind would duplicate its rows
+        all_written: List[tuple] = []
         for shard, positions in by_shard.items():
             batch = [events[p] for p in positions]
-            written: List[int] = []
-            try:
-                for s in reversed(self._owners(shard)):
+            batch_ids = [e.event_id for e in batch]
+            for s in reversed(self._owners(shard)):
+                try:
                     out = self._stores[s].insert_batch(batch, app_id, channel_id)
-                    written.append(s)
-            except S.StorageError:
-                if self._replicas > 1:
-                    self._rollback(written, [e.event_id for e in batch],
+                except S.StorageError:
+                    self._rollback(all_written + [(s, batch_ids)],
                                    app_id, channel_id)
-                raise
+                    raise
+                all_written.append((s, batch_ids))
             for p, eid in zip(positions, out):
                 ids[p] = eid
         return ids  # type: ignore[return-value]
@@ -619,8 +646,10 @@ class ShardedRestEventStore(S.EventStore):
             if len(part):
                 # successors first, owner last: a partial failure's
                 # phantom copies sit where owner-preferring reads don't
-                # look; rows carry no client ids, so recovery from a
-                # mid-ingest failure is re-running the ingest
+                # look. Rows carry no client ids, so there is no
+                # rollback here — recovery from a mid-ingest failure is
+                # remove() + re-init + re-ingest (a blind re-run would
+                # DUPLICATE rows on replicas that already took the part)
                 for s in reversed(self._owners(shard)):
                     count = self._stores[s].insert_columnar(
                         part, app_id, channel_id, entity_type=entity_type,
@@ -675,21 +704,38 @@ class ShardedRestEventStore(S.EventStore):
                                    limit=limit, reversed=reversed,
                                    **find_kwargs))
         else:
-            # replicated: the row-path wire has no shard filter, so a
-            # chosen replica returns its FULL event set. Resolve one
-            # live server per shard first and scan each distinct server
-            # ONCE (splitting its rows among the shards assigned to
-            # it) — otherwise two shards failing over to one server
-            # would scan it twice, exactly when the cluster is
-            # degraded. Per-shard limit doesn't apply here (a server's
-            # first `limit` rows overall are not shard k's first).
+            # replicated: resolve one live server per shard and scan
+            # each distinct server ONCE for all its assigned shards —
+            # the server's placement filter (applied BEFORE any row
+            # limit) keeps a replica's foreign-shard copies off the
+            # wire, so the per-shard limit optimization applies here
+            # too. The client-side re-filter is a cheap guard against
+            # an older server ignoring the placement keys (such a
+            # server must not be mixed with limited scans).
             assignment = self._assign_live_servers()
+
+            def fetch(srv, shards):
+                return self._stores[srv].find(
+                    app_id, channel_id=channel_id, limit=limit,
+                    reversed=reversed, placement_shards=shards,
+                    placement_count=n, **find_kwargs)
 
             def scan(item):
                 srv, shards = item
-                part = self._stores[srv].find(
-                    app_id, channel_id=channel_id, reversed=reversed,
-                    **find_kwargs)
+                try:
+                    part = fetch(srv, shards)
+                except S.StorageUnavailableError:
+                    # the server died between the liveness probe and
+                    # the scan: fail over per shard through the rest
+                    # of each replica set instead of failing the read
+                    part = []
+                    for k in shards:
+                        part.extend(self._first_live(
+                            k, lambda st: st.find(
+                                app_id, channel_id=channel_id,
+                                limit=limit, reversed=reversed,
+                                placement_shards=[k], placement_count=n,
+                                **find_kwargs)))
                 mine = set(shards)
                 return [e for e in part
                         if S.stable_hash(e.entity_id) % n in mine]

@@ -306,12 +306,18 @@ class ALSTrainer:
         self.n_users, self.n_items = n_users, n_items
         n_shards = mesh.shape["data"] if mesh is not None else 1
 
+        # build one side, START its (async) device transfer, then build
+        # the other: on a tunneled chip the bulk transfer is the
+        # dominant one-time cost, and this hides the second side's host
+        # binning underneath the first side's bytes in flight
         by_user = _build_side(
             u_idx, i_idx, vals, n_users, cfg, n_shards, max_ratings_per_user
         )
+        self._ud = self._to_device(by_user)
         by_item = _build_side(
             i_idx, u_idx, vals, n_items, cfg, n_shards, max_ratings_per_item
         )
+        self._it = self._to_device(by_item)
         self._g_users = by_user.groups_per_shard * n_shards
         self._g_items = by_item.groups_per_shard * n_shards
         # entries actually processed per half-step (all of them unless an
@@ -333,12 +339,16 @@ class ALSTrainer:
             mesh, cfg, by_item.row_block, by_item.group_block,
             by_item.groups_per_shard,
         )
-        self._ud = self._to_device(by_user)
-        self._it = self._to_device(by_item)
         self._run_cache = {}
 
     def _to_device(self, sg: SegmentedGroups):
-        arrs = (jnp.asarray(sg.idx), jnp.asarray(sg.val), jnp.asarray(sg.mask),
+        # mask travels as uint8 (it is 0/1): the float32 host layout
+        # would be a third full-size stream over the tunnel; device
+        # consumers already .astype() it into the compute dtype, and
+        # uint8*f32 promotes to f32 — this is the "4 + 4 + 1" byte
+        # model work_model() documents
+        arrs = (jnp.asarray(sg.idx), jnp.asarray(sg.val),
+                jnp.asarray(sg.mask.astype(np.uint8)),
                 jnp.asarray(sg.seg), jnp.asarray(sg.counts))
         if self.mesh is not None:
             shardings = [

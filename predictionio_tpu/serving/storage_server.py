@@ -461,10 +461,26 @@ class StorageRequestHandler(JSONRequestHandler):
             return self._send(200, scan)
 
         # find: NDJSON stream so 20M-event training reads never build one
-        # giant JSON document on either side
-        events = store.find(
-            app_id, channel_id=channel_id, **self._find_kwargs(body)
-        )
+        # giant JSON document on either side. Optional placement filter
+        # (replicated sharded clients): only rows whose entity
+        # hash-routes to the requested shards travel, with any row
+        # limit applied AFTER the filter
+        kwargs = self._find_kwargs(body)
+        pshards = body.get("placement_shards")
+        pcount = body.get("placement_count")
+        if pshards is not None and pcount:
+            from predictionio_tpu.data.storage import stable_hash
+
+            limit = kwargs.pop("limit", None)
+            keep = {int(x) for x in pshards}
+            events = [
+                e for e in store.find(app_id, channel_id=channel_id, **kwargs)
+                if stable_hash(e.entity_id) % int(pcount) in keep
+            ]
+            if limit is not None and limit >= 0:
+                events = events[:limit]
+        else:
+            events = store.find(app_id, channel_id=channel_id, **kwargs)
         # genuinely chunked NDJSON: a 20M-event training read never
         # joins into one multi-GB buffer on the server side
         self.send_response(200)

@@ -115,35 +115,38 @@ def _seed(storage):
     return len(events)
 
 
-def test_two_process_train_and_deploy_via_shared_storage(memory_storage):
-    n_events = _seed(memory_storage)
-    server = StorageServer(storage=memory_storage, host="127.0.0.1",
-                           port=0).start()
-    coord_port = _free_port()
+def _worker_env(coord_port, pid, ports, replicas=None):
+    env = dict(os.environ)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    env.update({
+        "PYTHONPATH": REPO_ROOT,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "PIO_COORDINATOR_ADDRESS": f"127.0.0.1:{coord_port}",
+        "PIO_NUM_PROCESSES": "2",
+        "PIO_PROCESS_ID": str(pid),
+        "PIO_STORAGE_SOURCES_CENTRAL_TYPE": "rest",
+        "PIO_STORAGE_SOURCES_CENTRAL_HOSTS": "127.0.0.1",
+        "PIO_STORAGE_SOURCES_CENTRAL_PORTS": ",".join(str(p) for p in ports),
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "meta",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "CENTRAL",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "events",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "CENTRAL",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "models",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "CENTRAL",
+    })
+    if replicas is not None:
+        env["PIO_STORAGE_SOURCES_CENTRAL_REPLICAS"] = str(replicas)
+    return env
+
+
+def _run_workers(coord_port, ports, replicas=None):
     procs, outs = [], []
     try:
         for pid in range(2):
-            env = dict(os.environ)
-            env.pop("PYTEST_CURRENT_TEST", None)
-            env.update({
-                "PYTHONPATH": REPO_ROOT,
-                "JAX_PLATFORMS": "cpu",
-                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-                "PIO_COORDINATOR_ADDRESS": f"127.0.0.1:{coord_port}",
-                "PIO_NUM_PROCESSES": "2",
-                "PIO_PROCESS_ID": str(pid),
-                "PIO_STORAGE_SOURCES_CENTRAL_TYPE": "rest",
-                "PIO_STORAGE_SOURCES_CENTRAL_HOSTS": "127.0.0.1",
-                "PIO_STORAGE_SOURCES_CENTRAL_PORTS": str(server.port),
-                "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "meta",
-                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "CENTRAL",
-                "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "events",
-                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "CENTRAL",
-                "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "models",
-                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "CENTRAL",
-            })
             procs.append(subprocess.Popen(
-                [sys.executable, "-c", _WORKER], cwd=REPO_ROOT, env=env,
+                [sys.executable, "-c", _WORKER], cwd=REPO_ROOT,
+                env=_worker_env(coord_port, pid, ports, replicas),
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             ))
         for p in procs:
@@ -153,6 +156,16 @@ def test_two_process_train_and_deploy_via_shared_storage(memory_storage):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    return procs, outs
+
+
+def test_two_process_train_and_deploy_via_shared_storage(memory_storage):
+    n_events = _seed(memory_storage)
+    server = StorageServer(storage=memory_storage, host="127.0.0.1",
+                           port=0).start()
+    try:
+        procs, outs = _run_workers(_free_port(), [server.port])
+    finally:
         server.stop()
 
     for pid, (p, out) in enumerate(zip(procs, outs)):
@@ -184,3 +197,73 @@ def test_two_process_train_and_deploy_via_shared_storage(memory_storage):
     assert sum(by_shard.values()) == n_events
     for rows in by_shard.values():
         assert 0.25 * n_events < rows < 0.75 * n_events, by_shard
+
+
+def test_multihost_train_survives_dead_storage_replica():
+    """The capstone composition: 2 jax.distributed processes run the
+    real train→deploy workflow against a 2-server REPLICATED (R=2)
+    storage tier with one server KILLED before training — reads fail
+    over to the surviving replica, metadata/models live on the (first,
+    surviving) endpoint, and the whole product path completes. The
+    reference's analogue is HBase riding out a dead region server on
+    HDFS replicas."""
+    backends = []
+    servers = []
+    for _ in range(2):
+        from predictionio_tpu.data.storage import Storage
+
+        b = Storage.from_env({
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "meta",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "events",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_NAME": "models",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        })
+        backends.append(b)
+        servers.append(StorageServer(storage=b, host="127.0.0.1",
+                                     port=0).start())
+    ports = [s.port for s in servers]
+    try:
+        # seed THROUGH the replicated client: copies land on both
+        from tests.test_sharded_storage import _client
+
+        seeder = _client(ports, replicas=2)
+        seeder.apps().insert("mhapp")
+        n_events = None
+        import numpy as np
+
+        rng = np.random.default_rng(7)
+        events, m = [], 0
+        seeder.events().init(1)
+        for u in range(N_USERS):
+            for i in rng.choice(N_ITEMS, size=EVENTS_PER_USER,
+                                replace=False):
+                events.append(Event(
+                    event="rate", entity_type="user",
+                    entity_id=f"user_{u}",
+                    target_entity_type="item",
+                    target_entity_id=f"item_{i}",
+                    properties={"rating": float(1 + (u * int(i)) % 5)},
+                    event_time=_dt.datetime(2026, 1, 1, tzinfo=UTC)
+                    + _dt.timedelta(minutes=m),
+                ))
+                m += 1
+        seeder.events().insert_batch(events, 1)
+        n_events = len(events)
+        assert len(backends[1].events().find(1)) == n_events  # replicated
+
+        servers[1].stop()  # kill the non-metadata server
+
+        procs, outs = _run_workers(_free_port(), ports, replicas=2)
+        for pid, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"process {pid} failed:\n{out}"
+            assert f"MHWF OK p{pid}" in out
+        assert "DEPLOY OK" in outs[1]
+        instances = backends[0].engine_instances().get_all()
+        assert len(instances) == 1 and instances[0].status == "COMPLETED"
+        assert backends[0].models().get(instances[0].id) is not None
+    finally:
+        for s in servers:
+            s.stop()

@@ -310,6 +310,55 @@ def test_replicated_reads_survive_one_server_down(three_servers_r2):
     assert store.get(eid, 1) is not None
 
 
+def test_find_placement_filter_on_wire(two_servers):
+    """The row find wire's placement filter: a server holding several
+    shards' copies sends only the requested shards' rows, limit applied
+    after the filter (code-review regression)."""
+    backends, servers, _ = two_servers
+    backends[0].events().init(1)
+    backends[0].events().insert_batch(_events(n=40), 1)
+
+    from predictionio_tpu.data.backends.rest import RestEventStore, _Transport
+
+    st = RestEventStore(
+        _Transport(f"http://127.0.0.1:{servers[0].port}", None, 10))
+    full = st.find(1)
+    only0 = st.find(1, placement_shards=[0], placement_count=2)
+    assert 0 < len(only0) < len(full)
+    assert all(stable_hash(e.entity_id) % 2 == 0 for e in only0)
+    # limit applies AFTER the placement filter
+    lim = st.find(1, placement_shards=[0], placement_count=2, limit=3)
+    assert [e.event_id for e in lim] == [e.event_id for e in only0[:3]]
+
+
+def test_multi_shard_batch_rolls_back_all_groups():
+    """A failed multi-shard replicated batch must roll back EVERY shard
+    group it committed, not just the failing one — a retry with fresh
+    ids would otherwise duplicate the committed group's rows
+    (code-review regression)."""
+    backends = [_memory_storage(), _memory_storage()]
+    servers = [
+        StorageServer(storage=b, host="127.0.0.1", port=0).start()
+        for b in backends
+    ]
+    try:
+        client = _client([s.port for s in servers], replicas=2)
+        store = client.events()
+        store.init(1)
+        # events spanning BOTH shards
+        batch = _events(n=20)
+        assert len({stable_hash(e.entity_id) % 2 for e in batch}) == 2
+        servers[0].stop()
+        with pytest.raises(StorageUnavailableError):
+            store.insert_batch(batch, 1)
+        # whichever shard group committed to the live server first was
+        # rolled back when the dead server failed the other group
+        assert backends[1].events().find(1) == []
+    finally:
+        for s in servers:
+            s.stop()
+
+
 def test_partial_replica_write_rolls_back():
     """A replica write that fails midway must not leave a copy that
     reads would serve: the already-written copies are deleted by their
