@@ -48,20 +48,30 @@ def run_evaluation(
     evaluator: Optional[MetricEvaluator] = None,
     use_fast_eval: bool = True,
 ) -> MetricEvaluatorResult:
-    """ref: CoreWorkflow.runEvaluation:96. Returns the evaluator result."""
-    from predictionio_tpu.parallel.compile_cache import enable_persistent_cache
+    """ref: CoreWorkflow.runEvaluation:96. Returns the evaluator result.
 
+    Multi-host: same single-writer discipline as run_train — every
+    process runs the evaluation (its jitted steps may carry cross-host
+    collectives), process 0 alone owns the EvaluationInstance row, the
+    id is broadcast, and a final barrier publishes EVALCOMPLETED before
+    any process reads it.
+    """
+    from predictionio_tpu.parallel.compile_cache import enable_persistent_cache
+    from predictionio_tpu.parallel import multihost as mh
+
+    distributed = mh.initialize_from_env()
     enable_persistent_cache()
     storage = storage or get_storage()
     ctx = ctx or MeshContext()
     evaluator = evaluator or MetricEvaluator()
+    writer = not distributed or mh.process_index() == 0
     if engine_params_list is None:
         if generator is None:
             raise ValueError("provide engine_params_list or generator")
         engine_params_list = generator.engine_params_list
 
     instance = EvaluationInstance(
-        id=uuid.uuid4().hex,
+        id=mh.broadcast_string(uuid.uuid4().hex),
         status="INIT",
         start_time=_now(),
         end_time=_now(),
@@ -69,10 +79,14 @@ def run_evaluation(
         engine_params_generator_class=generator_class,
         batch=batch,
     )
-    storage.evaluation_instances().insert(instance)
+    inserted = False
+    if writer:
+        storage.evaluation_instances().insert(instance)
+        inserted = True
     try:
         instance.status = "EVALUATING"
-        storage.evaluation_instances().update(instance)
+        if writer:
+            storage.evaluation_instances().update(instance)
 
         eval_fn = None
         if use_fast_eval:
@@ -91,14 +105,17 @@ def run_evaluation(
         # a result carrying no_save (FakeEvalResult, workflow/fake.py)
         # keeps its renderings out of the metadata store
         # (ref: CoreWorkflow checking evaluatorResult.noSave)
-        if not getattr(result, "no_save", False):
-            instance.evaluator_results = result.to_one_liner()
-            instance.evaluator_results_json = result.to_json()
-            instance.evaluator_results_html = result.to_html()
-        storage.evaluation_instances().update(instance)
+        if writer:
+            if not getattr(result, "no_save", False):
+                instance.evaluator_results = result.to_one_liner()
+                instance.evaluator_results_json = result.to_json()
+                instance.evaluator_results_html = result.to_html()
+            storage.evaluation_instances().update(instance)
+        mh.barrier("pio_eval_" + instance.id)
         return result
     except Exception:
         instance.status = "FAILED"
         instance.end_time = _now()
-        storage.evaluation_instances().update(instance)
+        if inserted:
+            storage.evaluation_instances().update(instance)
         raise
