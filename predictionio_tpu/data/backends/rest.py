@@ -658,6 +658,91 @@ class ShardedRestEventStore(S.EventStore):
                 total += count
         return total
 
+    # -- anti-entropy -------------------------------------------------------
+    @staticmethod
+    def _content_key(e: Event) -> tuple:
+        """Identity of an event MINUS its id — columnar-ingested copies
+        carry per-server ids, so content equality is what says two
+        differently-id'd rows are the same event."""
+        return (e.event, e.entity_type, e.entity_id,
+                e.target_entity_type, e.target_entity_id,
+                e.event_time,
+                json.dumps(e.properties.to_dict()
+                           if hasattr(e.properties, "to_dict")
+                           else dict(e.properties), sort_keys=True))
+
+    def repair(self, app_id, channel_id=None) -> Dict[str, int]:
+        """Owner-authoritative replica reconciliation — the anti-entropy
+        role HBase inherits from HDFS block repair. The write protocol's
+        commit point is the OWNER copy (written last), so for every
+        shard the owner's rows are truth: each replica gains the owner
+        rows it is missing and drops rows the owner does not have
+        (rollback leftovers, re-ingested duplicates). Rows are matched
+        by id first, then by CONTENT multiset, so columnar-ingested
+        copies (same rows, per-server ids) are recognized as consistent
+        instead of rewritten.
+
+        Operational preconditions: the full replica set of every shard
+        must be up (repairing against a down owner would erase
+        committed data), and writes must be QUIESCED for the repaired
+        app — an insert in flight (replica written, owner not yet) is
+        indistinguishable from an orphan and would be deleted, like an
+        HBase major compaction this runs in a maintenance window.
+        Returns {"copied": n, "deleted": n}."""
+        if self._replicas == 1:
+            return {"copied": 0, "deleted": 0}
+        import collections as _c
+
+        n = len(self._stores)
+        copied = 0
+        to_delete: List[tuple] = []   # (server, event_id)
+        for shard in range(n):
+            owners = self._owners(shard)
+            truth_rows = self._stores[owners[0]].find(
+                app_id, channel_id=channel_id,
+                placement_shards=[shard], placement_count=n)
+            truth_by_id = {e.event_id: e for e in truth_rows}
+            for r in owners[1:]:
+                have = self._stores[r].find(
+                    app_id, channel_id=channel_id,
+                    placement_shards=[shard], placement_count=n)
+                have_ids = {e.event_id for e in have}
+                # unmatched-by-id remainders pair up by content
+                owner_rest = [truth_by_id[i]
+                              for i in truth_by_id.keys() - have_ids]
+                replica_rest = [e for e in have
+                                if e.event_id not in truth_by_id]
+                owner_content = _c.Counter(
+                    self._content_key(e) for e in owner_rest)
+                missing, extras = [], []
+                matched = _c.Counter()
+                for e in replica_rest:
+                    k = self._content_key(e)
+                    if matched[k] < owner_content[k]:
+                        matched[k] += 1   # same event, different id
+                    else:
+                        extras.append(e)
+                seen = _c.Counter()
+                for e in owner_rest:
+                    k = self._content_key(e)
+                    seen[k] += 1
+                    if seen[k] > matched[k]:
+                        missing.append(e)
+                if missing:
+                    self._stores[r].insert_batch(missing, app_id, channel_id)
+                    copied += len(missing)
+                to_delete.extend((r, e.event_id) for e in extras)
+
+        def drop(pair):
+            r, eid = pair
+            self._stores[r].delete(eid, app_id, channel_id)
+
+        if to_delete:
+            # fanned out, same reasoning as _rollback: a large orphan
+            # set must not serialize one round-trip per id
+            self._pmap(to_delete, drop)
+        return {"copied": copied, "deleted": len(to_delete)}
+
     # -- point reads: the id does not encode its shard ----------------------
     def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
         if self._replicas == 1:

@@ -300,6 +300,13 @@ def cmd_storageserver(args) -> int:
     return 0
 
 
+def cmd_storagerepair(args) -> int:
+    stats = commands.repair_events(args.appname, args.channel)
+    _p(f"Replica repair for app {args.appname}: "
+       f"{stats['copied']} rows copied, {stats['deleted']} rows deleted")
+    return 0
+
+
 # -- data / misc ---------------------------------------------------------------
 
 def cmd_import(args) -> int:
@@ -586,6 +593,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--auth-key", default=None,
                    help="require X-PIO-Storage-Key on every request")
     p.set_defaults(func=cmd_storageserver)
+
+    p = sub.add_parser(
+        "storagerepair",
+        help="reconcile event replicas on a replicated sharded source "
+             "(owner-authoritative anti-entropy; run in a maintenance "
+             "window — writes to the app must be quiesced)",
+    )
+    p.add_argument("--appname", required=True)
+    p.add_argument("--channel", default=None)
+    p.set_defaults(func=cmd_storagerepair)
 
     p = sub.add_parser("import", help="import events from a JSONL/parquet file")
     p.add_argument("--appname", required=True)

@@ -202,3 +202,30 @@ def accesskey_delete(key: str, storage: Optional[Storage] = None) -> None:
 def status(storage: Optional[Storage] = None) -> Dict[str, bool]:
     """ref: `pio status` -> Storage.verifyAllDataObjects (Storage.scala:237)."""
     return _storage(storage).verify_all_data_objects()
+
+
+def repair_events(app_name: str, channel_name: Optional[str] = None,
+                  storage: Optional[Storage] = None) -> Dict[str, int]:
+    """Owner-authoritative replica reconciliation of an app's events on
+    a replicated sharded EVENTDATA source (`pio storagerepair`) — the
+    anti-entropy role HBase inherits from HDFS. Raises CommandError on
+    a backend with no replicas to check (a silent zeros result would be
+    indistinguishable from "checked and consistent"). Run only while
+    writes to the app are quiesced (see ShardedRestEventStore.repair)."""
+    from predictionio_tpu.data.store import resolve_app
+
+    st = _storage(storage)
+    app_id, channel_id = resolve_app(app_name, channel_name, st)
+    events = st.events()
+    repair = getattr(events, "repair", None)
+    if repair is None:
+        raise CommandError(
+            "EVENTDATA is not a sharded rest source — nothing to repair "
+            "(configure comma-separated HOSTS/PORTS with REPLICAS>1)"
+        )
+    if getattr(events, "_replicas", 1) == 1:
+        raise CommandError(
+            "EVENTDATA is sharded but not replicated (REPLICAS=1) — "
+            "nothing to repair"
+        )
+    return repair(app_id, channel_id)

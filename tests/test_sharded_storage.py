@@ -409,6 +409,84 @@ def test_partial_replica_write_rolls_back():
             s.stop()
 
 
+def test_repair_reconciles_diverged_replicas(three_servers_r2):
+    """Owner-authoritative anti-entropy: after repair, every replica
+    holds exactly its shards' owner rows — rollback leftovers and
+    divergent copies are reconciled (the HDFS block-repair role)."""
+    backends, _, client = three_servers_r2
+    store = client.events()
+    store.init(1)
+    events = _events(n=45)
+    store.insert_batch(events, 1)
+
+    # diverge by hand: drop one REPLICA copy (server 1 replicates shard
+    # 0 — deleting an owner copy would be authoritative, not
+    # divergence), plant an orphan on another replica (the states
+    # partial failures leave behind)
+    victim = next(e for e in backends[1].events().find(1)
+                  if stable_hash(e.entity_id) % 3 == 0)
+    backends[1].events().delete(victim.event_id, 1)
+    orphan_shard = next(s for s in range(3)
+                        if stable_hash("orphan_u") % 3 == s)
+    replica_of_orphan = (orphan_shard + 1) % 3
+    backends[replica_of_orphan].events().insert(
+        dataclasses.replace(events[0], entity_id="orphan_u"), 1)
+
+    stats = store.repair(1)
+    assert stats["copied"] >= 1 and stats["deleted"] >= 1
+
+    # post-repair invariant: each server holds exactly the owner rows
+    # of the shards it replicates
+    for srv, b in enumerate(backends):
+        rows = b.events().find(1)
+        my_shards = {srv, (srv - 1) % 3}
+        expected = {
+            e.event_id for e in store.find(1)
+            if stable_hash(e.entity_id) % 3 in my_shards
+        }
+        assert {e.event_id for e in rows} == expected
+    # merged reads are clean and complete (no orphan, nothing missing)
+    merged = store.find(1)
+    assert len(merged) == len(events)
+    assert all(e.entity_id != "orphan_u" for e in merged)
+
+
+def test_repair_recognizes_columnar_ingested_copies(three_servers_r2):
+    """Columnar-ingested replicas carry per-server ids; repair must
+    match them by CONTENT and leave them alone, not rewrite every
+    replica (code-review regression)."""
+    _, _, client = three_servers_r2
+    store = client.events()
+    store.init(1)
+    oracle = _memory_storage()
+    oracle.events().init(1)
+    oracle.events().insert_batch(_events(n=45), 1)
+    cols = oracle.events().find_columnar(1, value_property="rating",
+                                         time_ordered=False)
+    store.insert_columnar(cols, 1, entity_type="user",
+                          target_entity_type="item",
+                          value_property="rating")
+    stats = store.repair(1)
+    assert stats == {"copied": 0, "deleted": 0}, stats
+    assert len(store.find(1)) == 45
+
+
+def test_repair_cli_refuses_unreplicated_backend(two_servers, memory_storage):
+    """`pio storagerepair` must fail loudly when there is nothing to
+    check — a zeros result would read as "consistent"."""
+    from predictionio_tpu.tools.commands import CommandError, repair_events
+
+    # sharded but unreplicated
+    _, _, client = two_servers
+    client.apps().insert("shapp2")
+    with pytest.raises(CommandError):
+        repair_events("shapp2", storage=client)
+    # plain unsharded backend
+    memory_storage.apps().insert("plain")
+    with pytest.raises(CommandError):
+        repair_events("plain", storage=memory_storage)
+
+
 def test_replicas_exceeding_servers_rejected():
     from predictionio_tpu.data.storage import StorageError
 
