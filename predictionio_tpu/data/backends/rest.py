@@ -688,9 +688,17 @@ class ShardedRestEventStore(S.EventStore):
         app — an insert in flight (replica written, owner not yet) is
         indistinguishable from an orphan and would be deleted, like an
         HBase major compaction this runs in a maintenance window.
+        Memory is proportional to the largest shard's row count (owner
+        and replica rows are materialized per shard for the diff); for
+        huge bulk-ingested immutable logs prefer remove() + re-ingest.
+        Raises on an unreplicated store — a zeros result must always
+        mean "checked and consistent", never "nothing to check".
         Returns {"copied": n, "deleted": n}."""
         if self._replicas == 1:
-            return {"copied": 0, "deleted": 0}
+            raise S.StorageError(
+                "EVENTDATA is sharded but not replicated (REPLICAS=1) — "
+                "nothing to repair"
+            )
         import collections as _c
 
         n = len(self._stores)

@@ -52,6 +52,7 @@
 #include <shared_mutex>
 #include <string>
 #include <string_view>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -513,6 +514,32 @@ double extract_prop(const uint8_t* extra, uint32_t len, const char* key) {
       if (!s) return nan;
     }
   }
+}
+
+// the value_property of one parsed record (NaN when absent/non-numeric)
+double header_value(const Header& hd, const char* value_prop) {
+  if (!hd.len_extra) return std::numeric_limits<double>::quiet_NaN();
+  const uint8_t* extra = hd.tid   ? hd.tid + hd.len_tid
+                       : hd.ttype ? hd.ttype + hd.len_ttype
+                                  : hd.eid + hd.len_eid;
+  return extract_prop(extra, hd.len_extra, value_prop);
+}
+
+// worker count for the parallel fused columnar scan: opt-out/override
+// via PIO_EVENTLOG_SCAN_THREADS; single-threaded below 2M records
+// (thread spin-up + merge overhead beats the win on small scans)
+unsigned scan_thread_count(uint64_t nrec) {
+  const char* env = getenv("PIO_EVENTLOG_SCAN_THREADS");
+  if (env && *env) {
+    long v = strtol(env, nullptr, 10);
+    // <=0 (incl. "0", the natural opt-out spelling, and garbage) means
+    // single-threaded — never "ignore the override and auto-scale"
+    if (v < 1) return 1;
+    return static_cast<unsigned>(std::min<long>(v, 64));
+  }
+  if (nrec < 2000000) return 1;
+  unsigned hw = std::thread::hardware_concurrency();
+  return hw ? std::min(hw, 8u) : 1;
 }
 
 // dict encoder for string columns: string -> code in first-seen order,
@@ -1058,14 +1085,7 @@ int64_t el_find_columnar(
     tgt_v.push_back(hd.tid ? tgts.encode(hd.tid, hd.len_tid) : -1);
     name_v.push_back(names.encode(hd.event, hd.len_event));
     time_v.push_back(hd.time_us);
-    if (value_prop && hd.len_extra) {
-      const uint8_t* extra = hd.tid ? hd.tid + hd.len_tid
-                           : hd.ttype ? hd.ttype + hd.len_ttype
-                           : hd.eid + hd.len_eid;
-      val_v.push_back(extract_prop(extra, hd.len_extra, value_prop));
-    } else {
-      val_v.push_back(nan);
-    }
+    val_v.push_back(value_prop ? header_value(hd, value_prop) : nan);
   };
 
   if (time_ordered || req->limit >= 0) {
@@ -1082,9 +1102,75 @@ int64_t el_find_columnar(
     // pass, records in log order, no sort — a 20M-row scan parses each
     // record exactly once
     FilterCtx ctx = make_filter_ctx(req);
-    Header hd;
-    for (uint64_t i = 0; i < log->recs.size(); ++i) {
-      if (match_rec(log, req, ctx, i, &hd)) emit(hd);
+    const uint64_t nrec = log->recs.size();
+    const unsigned nt = scan_thread_count(nrec);
+    if (nt <= 1) {
+      Header hd;
+      for (uint64_t i = 0; i < nrec; ++i) {
+        if (match_rec(log, req, ctx, i, &hd)) emit(hd);
+      }
+    } else {
+      // parallel fused scan: workers filter+encode contiguous record
+      // ranges with LOCAL dictionaries (mmap/recs/by_id are read-only
+      // under the shared lock), then ranges merge in order. Every
+      // range-r global-first-seen id precedes every range-(r+1) one,
+      // and within a range local first-seen order IS record order, so
+      // the merged code assignment is byte-identical to the
+      // sequential scan's.
+      struct ColPart {
+        DictEncoder ents, tgts, names;
+        std::vector<int32_t> ent, tgt, name;
+        std::vector<double> val;
+        std::vector<int64_t> time;
+      };
+      std::vector<ColPart> parts(nt);
+      std::vector<std::thread> workers;
+      workers.reserve(nt);
+      for (unsigned t = 0; t < nt; ++t) {
+        const uint64_t lo = nrec * t / nt, hi = nrec * (t + 1) / nt;
+        workers.emplace_back([&, t, lo, hi]() {
+          ColPart& p = parts[t];
+          Header hd;
+          for (uint64_t i = lo; i < hi; ++i) {
+            if (!match_rec(log, req, ctx, i, &hd)) continue;
+            p.ent.push_back(p.ents.encode(hd.eid, hd.len_eid));
+            p.tgt.push_back(hd.tid ? p.tgts.encode(hd.tid, hd.len_tid) : -1);
+            p.name.push_back(p.names.encode(hd.event, hd.len_event));
+            p.time.push_back(hd.time_us);
+            p.val.push_back(value_prop ? header_value(hd, value_prop) : nan);
+          }
+        });
+      }
+      for (auto& w : workers) w.join();
+      uint64_t total = 0;
+      for (const auto& p : parts) total += p.ent.size();
+      ent_v.reserve(total);
+      tgt_v.reserve(total);
+      name_v.reserve(total);
+      val_v.reserve(total);
+      time_v.reserve(total);
+      auto remap = [](DictEncoder& global, const DictEncoder& local) {
+        std::vector<int32_t> table(local.order.size());
+        for (size_t i = 0; i < local.order.size(); ++i) {
+          const std::string_view& sv = local.order[i];
+          table[i] = global.encode(
+              reinterpret_cast<const uint8_t*>(sv.data()),
+              static_cast<uint32_t>(sv.size()));
+        }
+        return table;
+      };
+      for (const auto& p : parts) {
+        const std::vector<int32_t> ent_map = remap(ents, p.ents);
+        const std::vector<int32_t> tgt_map = remap(tgts, p.tgts);
+        const std::vector<int32_t> name_map = remap(names, p.names);
+        for (size_t i = 0; i < p.ent.size(); ++i) {
+          ent_v.push_back(ent_map[p.ent[i]]);
+          tgt_v.push_back(p.tgt[i] >= 0 ? tgt_map[p.tgt[i]] : -1);
+          name_v.push_back(name_map[p.name[i]]);
+        }
+        val_v.insert(val_v.end(), p.val.begin(), p.val.end());
+        time_v.insert(time_v.end(), p.time.begin(), p.time.end());
+      }
     }
   }
 

@@ -223,9 +223,6 @@ def repair_events(app_name: str, channel_name: Optional[str] = None,
             "EVENTDATA is not a sharded rest source — nothing to repair "
             "(configure comma-separated HOSTS/PORTS with REPLICAS>1)"
         )
-    if getattr(events, "_replicas", 1) == 1:
-        raise CommandError(
-            "EVENTDATA is sharded but not replicated (REPLICAS=1) — "
-            "nothing to repair"
-        )
+    # an unreplicated sharded store raises StorageError from repair()
+    # itself (the loud-failure guard lives with the operation)
     return repair(app_id, channel_id)

@@ -474,14 +474,15 @@ def test_repair_recognizes_columnar_ingested_copies(three_servers_r2):
 def test_repair_cli_refuses_unreplicated_backend(two_servers, memory_storage):
     """`pio storagerepair` must fail loudly when there is nothing to
     check — a zeros result would read as "consistent"."""
+    from predictionio_tpu.data.storage import StorageError
     from predictionio_tpu.tools.commands import CommandError, repair_events
 
-    # sharded but unreplicated
+    # sharded but unreplicated: repair() itself owns the guard
     _, _, client = two_servers
     client.apps().insert("shapp2")
-    with pytest.raises(CommandError):
+    with pytest.raises(StorageError):
         repair_events("shapp2", storage=client)
-    # plain unsharded backend
+    # plain unsharded backend: no repair surface at all
     memory_storage.apps().insert("plain")
     with pytest.raises(CommandError):
         repair_events("plain", storage=memory_storage)
