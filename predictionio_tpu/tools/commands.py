@@ -208,10 +208,12 @@ def repair_events(app_name: str, channel_name: Optional[str] = None,
                   storage: Optional[Storage] = None) -> Dict[str, int]:
     """Owner-authoritative replica reconciliation of an app's events on
     a replicated sharded EVENTDATA source (`pio storagerepair`) — the
-    anti-entropy role HBase inherits from HDFS. Raises CommandError on
-    a backend with no replicas to check (a silent zeros result would be
-    indistinguishable from "checked and consistent"). Run only while
-    writes to the app are quiesced (see ShardedRestEventStore.repair)."""
+    anti-entropy role HBase inherits from HDFS. A backend with no
+    replicas to check fails loudly (a silent zeros result would be
+    indistinguishable from "checked and consistent"): CommandError when
+    the source is not sharded rest at all, StorageError from repair()
+    itself when it is sharded but unreplicated. Run only while writes
+    to the app are quiesced (see ShardedRestEventStore.repair)."""
     from predictionio_tpu.data.store import resolve_app
 
     st = _storage(storage)

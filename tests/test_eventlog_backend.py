@@ -485,3 +485,4 @@ def test_parallel_columnar_scan_is_byte_identical(tmp_path, monkeypatch):
     assert len(par_f) == len(seq_f) > 0
     np.testing.assert_array_equal(par_f.entity_codes, seq_f.entity_codes)
     assert par_f.entity_vocab == seq_f.entity_vocab
+    store.close()
