@@ -334,6 +334,10 @@ class RestEventStore(S.EventStore):
             payload["shard_index"] = int(shard_index)
             payload["shard_count"] = int(shard_count)
         body = json.dumps(payload).encode()
+        # outer loop retries SCAN EXPIRY only (the `continue` below);
+        # connection failures raise out of request() after its own
+        # idempotent retries — the budgets are for different failure
+        # modes and do not multiply
         for attempt in range(1 + self._t.retries):
             if attempt:
                 self._t._sleep_backoff(attempt - 1)

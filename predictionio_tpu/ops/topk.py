@@ -217,7 +217,10 @@ class TopKScorer:
             excl = excl[:, -self.max_exclude:]
             rows = np.repeat(np.arange(uv.shape[0]), excl.shape[1])
             cols = excl.reshape(-1)
-            keep = cols >= 0
+            # drop out-of-range ids too (stale blacklist after a catalog
+            # shrink) — the device path's scatter silently drops them,
+            # and the two routes must behave identically
+            keep = (cols >= 0) & (cols < scores.shape[1])
             scores[rows[keep], cols[keep]] = float(NEG_INF)
         return self._host_topk(scores, k)
 

@@ -38,6 +38,15 @@ class JSONRequestHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         log.debug("%s: " + fmt, self.server_version, *args)
 
+    def handle_one_request(self):
+        # per-request state: the handler object lives for a whole
+        # keep-alive connection, and routes that stream their response
+        # without _send (NDJSON finds, scan fetches) would otherwise
+        # leave a stale True that makes the NEXT request's drain guard
+        # skip an unread body and desynchronize the connection
+        self._body_consumed = False
+        super().handle_one_request()
+
     def _send(self, status: int, body: Any,
               content_type: str = "application/json; charset=UTF-8",
               extra_headers: Optional[dict] = None) -> None:
@@ -66,7 +75,7 @@ class JSONRequestHandler(BaseHTTPRequestHandler):
                 self.close_connection = True
             elif unread:
                 self.rfile.read(unread)
-        self._body_consumed = False  # reset for the next keep-alive request
+        self._body_consumed = True  # this request's body is settled
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))

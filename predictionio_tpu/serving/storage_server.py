@@ -108,7 +108,13 @@ class _ScanRegistry:
     def path_for(self, scan_id: str) -> Optional[Dict[str, Any]]:
         with self._lock:
             self._reap_locked()
-            return self._scans.get(scan_id)
+            scan = self._scans.get(scan_id)
+            if scan is not None:
+                # sliding TTL: a transfer making progress (resumed
+                # range fetches) must never expire mid-download just
+                # because the WHOLE transfer outlives the ttl
+                scan["created"] = time.monotonic()
+            return scan
 
     def release(self, scan_id: str) -> bool:
         with self._lock:
@@ -280,14 +286,22 @@ class StorageRequestHandler(JSONRequestHandler):
         size = scan["bytes"]
         if not 0 <= offset <= size:
             return self._send(400, {"message": f"bad offset {offset}"})
+        # open BEFORE the status line goes out: a concurrent release or
+        # TTL reap unlinking the spool must answer a clean retryable
+        # 404, never a second response corrupting the declared body
+        try:
+            f = open(scan["path"], "rb")
+        except FileNotFoundError:
+            return self._send(404, {"message": "unknown scan",
+                                    "missing": True})
         # stream the spool file in bounded chunks: no full-blob buffer
         self._body_consumed = True  # GET: nothing to drain
-        self.send_response(200)
-        self.send_header("Content-Type", "application/octet-stream")
-        self.send_header("Content-Length", str(size - offset))
-        self.end_headers()
-        with open(scan["path"], "rb") as f:
+        with f:
             f.seek(offset)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(size - offset))
+            self.end_headers()
             while True:
                 chunk = f.read(1 << 20)
                 if not chunk:

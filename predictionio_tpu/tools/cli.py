@@ -102,11 +102,18 @@ def cmd_app(args) -> int:
         _p(f"App data deleted: {args.name}")
     elif args.app_command == "compact":
         stats = commands.app_compact(args.name, args.channel, st)
-        if stats is None:
+        # a sharded rest source returns one stats dict (or None) per shard
+        shard_stats = stats if isinstance(stats, list) else [stats]
+        if all(s is None for s in shard_stats):
             _p("Backend stores events in place; nothing to compact.")
         else:
-            _p(f"Compacted: dropped {stats['dropped']} records, "
-               f"{stats['before_bytes']} -> {stats['after_bytes']} bytes")
+            for i, s in enumerate(shard_stats):
+                prefix = f"shard {i}: " if len(shard_stats) > 1 else ""
+                if s is None:
+                    _p(f"{prefix}stores events in place; nothing to compact.")
+                else:
+                    _p(f"{prefix}Compacted: dropped {s['dropped']} records, "
+                       f"{s['before_bytes']} -> {s['after_bytes']} bytes")
     elif args.app_command == "channel-new":
         ch = commands.channel_new(args.name, args.channel, st)
         _p(f"Channel created: {ch.name} (id {ch.id})")

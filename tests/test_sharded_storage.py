@@ -549,6 +549,105 @@ def test_event_server_ingests_to_sharded_tier(two_servers):
         es.stop()
 
 
+def test_cli_compact_handles_per_shard_stats(two_servers, capsys):
+    """`pio app compact` on a sharded source gets a LIST of per-shard
+    stats and must print them instead of crashing (code-review
+    regression)."""
+    from predictionio_tpu.data.storage import set_storage
+    from predictionio_tpu.tools.cli import main as cli_main
+
+    _, _, client = two_servers
+    try:
+        set_storage(client)
+        assert cli_main(["app", "new", "compactapp"]) == 0
+        capsys.readouterr()
+        # the regression was a TypeError on the list-of-stats return;
+        # memory shards compact in place -> the collapsed no-op line
+        assert cli_main(["app", "compact", "compactapp"]) == 0
+        out = capsys.readouterr().out
+        assert "nothing to compact" in out
+
+        # a stats-returning sharded store prints one line per shard
+        from predictionio_tpu.tools import cli as cli_mod
+
+        class FakeShardedStore:
+            def compact(self, app_id, channel_id=None):
+                return [{"dropped": 1, "before_bytes": 10, "after_bytes": 5},
+                        None]
+
+        class FakeStorage:
+            def events(self):
+                return FakeShardedStore()
+
+            def __getattr__(self, name):
+                return getattr(client, name)
+
+        set_storage(FakeStorage())  # type: ignore[arg-type]
+        assert cli_main(["app", "compact", "compactapp"]) == 0
+        out = capsys.readouterr().out
+        assert "shard 0: Compacted: dropped 1" in out
+        assert "shard 1: stores events in place" in out
+    finally:
+        set_storage(None)
+
+
+def test_scan_ttl_slides_with_fetch_progress(memory_storage):
+    """A resumed transfer must never die to the absolute scan TTL while
+    it is making progress (code-review regression)."""
+    import time as _time
+
+    from predictionio_tpu.serving.storage_server import _ScanRegistry
+
+    reg = _ScanRegistry(ttl=0.4)
+    scan = reg.create(lambda f: f.write(b"x" * 64))
+    _time.sleep(0.25)
+    assert reg.path_for(scan["scan_id"]) is not None  # refreshes the TTL
+    _time.sleep(0.25)
+    # absolute age > ttl, but the access above slid the window
+    assert reg.path_for(scan["scan_id"]) is not None
+    _time.sleep(0.5)  # idle past the ttl: reaped
+    assert reg.path_for(scan["scan_id"]) is None
+    reg.close()
+
+
+def test_keepalive_connection_survives_streaming_then_bad_route(two_servers):
+    """After a streamed NDJSON find on a keep-alive connection, the
+    NEXT request's body must still be drained before answering — a
+    stale body would desynchronize the connection (code-review
+    regression)."""
+    import http.client as _hc
+    import json as _json
+
+    _, servers, client = two_servers
+    store = client.events()
+    store.init(1)
+    store.insert_batch(_events(n=6), 1)
+
+    conn = _hc.HTTPConnection("127.0.0.1", servers[0].port, timeout=10)
+    try:
+        # 1. streamed NDJSON response (bypasses _send)
+        conn.request("POST", "/storage/events/find",
+                     _json.dumps({"app_id": 1}).encode(),
+                     {"Content-Type": "application/json"})
+        r1 = conn.getresponse()
+        lines = [l for l in r1.read().split(b"\n") if l]
+        assert len(lines) > 0
+        # 2. unknown events method WITH a body -> short-circuit 404
+        conn.request("POST", "/storage/events/bogus",
+                     _json.dumps({"app_id": 1, "junk": "x" * 200}).encode(),
+                     {"Content-Type": "application/json"})
+        r2 = conn.getresponse()
+        assert r2.status == 404
+        r2.read()
+        # 3. the SAME connection must still parse a clean request
+        conn.request("GET", "/storage/stats")
+        r3 = conn.getresponse()
+        assert r3.status == 200
+        assert "columnar_scan_count" in _json.loads(r3.read())
+    finally:
+        conn.close()
+
+
 def test_metadata_and_models_pin_to_first_shard(two_servers):
     backends, _, client = two_servers
     app = client.apps().insert("shapp")
