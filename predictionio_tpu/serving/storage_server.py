@@ -302,11 +302,20 @@ class StorageRequestHandler(JSONRequestHandler):
             self.send_header("Content-Type", "application/octet-stream")
             self.send_header("Content-Length", str(size - offset))
             self.end_headers()
-            while True:
-                chunk = f.read(1 << 20)
-                if not chunk:
-                    break
-                self.wfile.write(chunk)
+            try:
+                while True:
+                    chunk = f.read(1 << 20)
+                    if not chunk:
+                        break
+                    self.wfile.write(chunk)
+            except Exception:  # noqa: BLE001 — status line already sent
+                # a mid-stream failure (disk error, dead socket) must
+                # NOT bubble to _guarded: its 500 would land inside the
+                # declared body as corrupted scan bytes. Drop the
+                # connection — the client sees a short read and resumes
+                # from its received offset.
+                log.exception("scan stream aborted mid-transfer")
+                self.close_connection = True
 
     def _get_model(self, model_id: str):
         model = self.server_ref.storage.models().get(model_id)

@@ -38,9 +38,12 @@ def read_interactions(
     target_entity_type: str,
     value_property: Optional[str] = None,
     host_sharded: bool = True,
+    time_ordered: bool = False,
 ) -> InteractionColumns:
     """Bulk dict-encoded read of interaction events; rows without a
-    target id are dropped (order unspecified — consumers sort).
+    target id are dropped. Default order is unspecified (consumers that
+    care sort, or pass ``time_ordered=True`` — required by
+    latest-event-wins consumers like the ecommerce/like dedupers).
 
     ``host_sharded`` (default on; no-op single-process): under
     jax.distributed, each host scans only ITS entity-hash shard of the
@@ -62,14 +65,14 @@ def read_interactions(
         app_name,
         channel_name=channel_name,
         value_property=value_property,
-        time_ordered=False,
+        time_ordered=time_ordered,
         entity_type=entity_type,
         event_names=list(event_names),
         target_entity_type=target_entity_type,
         **shard,
     )
     if n_hosts > 1:
-        cols = mh.exchange_columns(cols)
+        cols = mh.exchange_columns(cols, time_ordered=time_ordered)
     keep = cols.target_codes >= 0
     return InteractionColumns(
         entity_vocab=cols.entity_vocab,

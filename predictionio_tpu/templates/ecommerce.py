@@ -32,6 +32,9 @@ class ECommDSParams(Params):
     app_name: str = ""
     channel_name: Optional[str] = None
     rate_event: str = "rate"
+    columnar: bool = True     # bulk dict-encoded interaction read (and,
+                              # under jax.distributed, host-sharded
+                              # scans); False forces the per-event rows
 
 
 class ECommDataSource(DataSource):
@@ -53,22 +56,42 @@ class ECommDataSource(DataSource):
             for item, props in item_props.items()
             if props.get_opt("categories") is not None
         }
-        rate_events = store.find(
-            p.app_name,
-            channel_name=p.channel_name,
-            entity_type="user",
-            event_names=[p.rate_event],
-            target_entity_type="item",
-        )
+        if p.columnar:
+            # one dict-encoded scan (templates/_columnar.py) — no
+            # per-event objects, and host-sharded under jax.distributed
+            from predictionio_tpu.templates._columnar import read_interactions
+
+            # time order required: the algorithm dedupes (user, item)
+            # keeping the LATEST rating (models/ecommerce.py:195)
+            c = read_interactions(p.app_name, p.channel_name, "user",
+                                  [p.rate_event], "item",
+                                  value_property="rating",
+                                  time_ordered=True)
+            import numpy as np
+
+            vals = np.nan_to_num(c.values, nan=0.0)
+            triples = [
+                (c.entity_vocab[u], c.target_vocab[i], float(v))
+                for u, i, v in zip(c.entity_idx, c.target_idx, vals)
+            ]
+        else:
+            rate_events = store.find(
+                p.app_name,
+                channel_name=p.channel_name,
+                entity_type="user",
+                event_names=[p.rate_event],
+                target_entity_type="item",
+            )
+            triples = [
+                (e.entity_id, e.target_entity_id,
+                 float(e.properties.get("rating", 0.0)))
+                for e in rate_events
+            ]
         return ECommTrainingData(
             users=users,
             items=sorted(item_props),
             item_categories=item_categories,
-            rate_events=[
-                (e.entity_id, e.target_entity_id,
-                 float(e.properties.get("rating", 0.0)))
-                for e in rate_events
-            ],
+            rate_events=triples,
         )
 
 

@@ -36,6 +36,9 @@ from predictionio_tpu.parallel.mesh import MeshContext
 class SimilarProductDSParams(Params):
     app_name: str = ""
     channel_name: Optional[str] = None
+    columnar: bool = True     # bulk dict-encoded interaction reads (and,
+                              # under jax.distributed, host-sharded
+                              # scans); False forces the per-event rows
 
 
 class SimilarProductDataSource(DataSource):
@@ -43,6 +46,46 @@ class SimilarProductDataSource(DataSource):
 
     def __init__(self, params: SimilarProductDSParams):
         super().__init__(params)
+
+    def _interactions(self):
+        """(view pairs, like triples) — columnar path: one dict-encoded
+        scan per family (templates/_columnar.py; rides the host-sharded
+        multi-host data plane), decoded through the vocabularies
+        without per-event objects."""
+        p: SimilarProductDSParams = self.params
+        if not p.columnar:
+            views = store.find(
+                p.app_name, channel_name=p.channel_name, entity_type="user",
+                event_names=["view"], target_entity_type="item",
+            )
+            likes = store.find(
+                p.app_name, channel_name=p.channel_name, entity_type="user",
+                event_names=["like", "dislike"], target_entity_type="item",
+            )
+            return (
+                [(e.entity_id, e.target_entity_id) for e in views],
+                [(e.entity_id, e.target_entity_id, e.event == "like")
+                 for e in likes],
+            )
+        from predictionio_tpu.templates._columnar import read_interactions
+
+        vc = read_interactions(p.app_name, p.channel_name, "user",
+                               ["view"], "item")
+        view_events = [
+            (vc.entity_vocab[u], vc.target_vocab[i])
+            for u, i in zip(vc.entity_idx, vc.target_idx)
+        ]
+        # likes need time order: the model keeps the LATEST like/dislike
+        # per (user, item) (models/similarproduct.py:246)
+        lc = read_interactions(p.app_name, p.channel_name, "user",
+                               ["like", "dislike"], "item",
+                               time_ordered=True)
+        like_code = lc.names.index("like") if "like" in lc.names else -1
+        like_events = [
+            (lc.entity_vocab[u], lc.target_vocab[i], int(n) == like_code)
+            for u, i, n in zip(lc.entity_idx, lc.target_idx, lc.name_codes)
+        ]
+        return view_events, like_events
 
     def read_training(self, ctx: MeshContext) -> SimilarProductData:
         p: SimilarProductDSParams = self.params
@@ -57,28 +100,13 @@ class SimilarProductDataSource(DataSource):
             for item, props in item_props.items()
             if props.get_opt("categories") is not None
         }
-        views = store.find(
-            p.app_name,
-            channel_name=p.channel_name,
-            entity_type="user",
-            event_names=["view"],
-            target_entity_type="item",
-        )
-        likes = store.find(
-            p.app_name,
-            channel_name=p.channel_name,
-            entity_type="user",
-            event_names=["like", "dislike"],
-            target_entity_type="item",
-        )
+        view_events, like_events = self._interactions()
         return SimilarProductData(
             users=users,
             items=sorted(item_props),
             item_categories=item_categories,
-            view_events=[(e.entity_id, e.target_entity_id) for e in views],
-            like_events=[
-                (e.entity_id, e.target_entity_id, e.event == "like") for e in likes
-            ],
+            view_events=view_events,
+            like_events=like_events,
         )
 
 

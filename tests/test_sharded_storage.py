@@ -598,14 +598,16 @@ def test_scan_ttl_slides_with_fetch_progress(memory_storage):
 
     from predictionio_tpu.serving.storage_server import _ScanRegistry
 
-    reg = _ScanRegistry(ttl=0.4)
+    # generous margins: the sleeps stay well under the ttl so ordinary
+    # CI scheduling delay cannot reap between a sleep and the assert
+    reg = _ScanRegistry(ttl=2.0)
     scan = reg.create(lambda f: f.write(b"x" * 64))
-    _time.sleep(0.25)
+    _time.sleep(1.2)
     assert reg.path_for(scan["scan_id"]) is not None  # refreshes the TTL
-    _time.sleep(0.25)
-    # absolute age > ttl, but the access above slid the window
+    _time.sleep(1.2)
+    # absolute age (2.4s) > ttl, but the access above slid the window
     assert reg.path_for(scan["scan_id"]) is not None
-    _time.sleep(0.5)  # idle past the ttl: reaped
+    _time.sleep(2.5)  # idle past the ttl: reaped
     assert reg.path_for(scan["scan_id"]) is None
     reg.close()
 

@@ -397,3 +397,55 @@ class TestECommerceLookupCache:
             out = algo.predict(model, {"user": "u2", "num": 4})
             items = [s["item"] for s in out["itemScores"]]
             assert not {"i1", "i2", "i3"} & set(items)
+
+
+class TestColumnarRowEquivalence:
+    """The bulk dict-encoded read path of the similarproduct and
+    ecommerce templates must produce the SAME training data as the
+    per-event row path — including the time order the latest-event-wins
+    dedupers depend on (models/ecommerce.py:195,
+    models/similarproduct.py:246)."""
+
+    def test_similarproduct(self, memory_storage, simprod_app):
+        row = simprod_t.SimilarProductDataSource(
+            simprod_t.SimilarProductDSParams(app_name="simprod",
+                                             columnar=False)
+        ).read_training(ctx)
+        col = simprod_t.SimilarProductDataSource(
+            simprod_t.SimilarProductDSParams(app_name="simprod",
+                                             columnar=True)
+        ).read_training(ctx)
+        assert col.users == row.users
+        assert col.items == row.items
+        assert col.item_categories == row.item_categories
+        assert sorted(col.view_events) == sorted(row.view_events)
+        # likes are time-ordered on both paths: latest-wins dedupe agrees
+        latest_row = {(u, i): l for u, i, l in row.like_events}
+        latest_col = {(u, i): l for u, i, l in col.like_events}
+        assert latest_col == latest_row
+        assert sorted(col.like_events) == sorted(row.like_events)
+
+    def test_ecommerce(self, memory_storage, ecom_app):
+        row = ecom_t.ECommDataSource(
+            ecom_t.ECommDSParams(app_name="ecom", columnar=False)
+        ).read_training(ctx)
+        col = ecom_t.ECommDataSource(
+            ecom_t.ECommDSParams(app_name="ecom", columnar=True)
+        ).read_training(ctx)
+        assert col.users == row.users and col.items == row.items
+        assert sorted(col.rate_events) == sorted(row.rate_events)
+        latest_row = {(u, i): r for u, i, r in row.rate_events}
+        latest_col = {(u, i): r for u, i, r in col.rate_events}
+        assert latest_col == latest_row
+
+    def test_ecommerce_trains_identically(self, memory_storage, ecom_app):
+        engine = ecom_t.ecommerce_engine()
+        out = {}
+        for flag in (False, True):
+            ep = ecom_t.default_engine_params("ecom")
+            ep.data_source_params[1].columnar = flag
+            result = engine.train(ctx, ep)
+            algo = engine.make_algorithms(ep)[0]
+            out[flag] = algo.predict(result.models[0],
+                                     {"user": "u1", "num": 3})
+        assert out[True] == out[False]
