@@ -486,3 +486,81 @@ def test_parallel_columnar_scan_is_byte_identical(tmp_path, monkeypatch):
     np.testing.assert_array_equal(par_f.entity_codes, seq_f.entity_codes)
     assert par_f.entity_vocab == seq_f.entity_vocab
     store.close()
+
+
+def test_concurrent_appends_scans_and_compact(tmp_path, monkeypatch):
+    """Thread-safety stress of the native store: writers appending row
+    batches while readers run (multi-threaded) columnar scans and a
+    compaction runs mid-stream. The C++ layer must serialize correctly
+    (shared scan locks vs exclusive append/compact locks) — no crashes,
+    no torn reads, and the final state exact. The reference leans on
+    JVM memory safety here (SURVEY.md §5.2); this is the native
+    equivalent's proof."""
+    import threading
+
+    import numpy as np
+
+    monkeypatch.setenv("PIO_EVENTLOG_SCAN_THREADS", "2")
+    store = _mk(tmp_path).events()
+    store.init(1)
+    base = dt.datetime(2026, 4, 1, tzinfo=dt.timezone.utc)
+
+    def batch(writer, start, n):
+        return [Event(
+            event="rate", entity_type="user",
+            entity_id=f"w{writer}_u{(start + i) % 50}",
+            target_entity_type="item", target_entity_id=f"i{(start + i) % 20}",
+            properties={"rating": float(1 + i % 5)},
+            event_time=base + dt.timedelta(seconds=start + i),
+        ) for i in range(n)]
+
+    errors = []
+    scan_counts = [[], []]  # per scanner thread: order is meaningful
+    stop = threading.Event()
+
+    def writer(w):
+        try:
+            for r in range(20):
+                store.insert_batch(batch(w, r * 50, 50), 1)
+        except Exception as e:  # noqa: BLE001
+            errors.append(("writer", w, e))
+
+    def scanner(slot):
+        try:
+            while not stop.is_set():
+                cols = store.find_columnar(1, value_property="rating",
+                                           time_ordered=False)
+                n = len(cols)
+                # torn-read guards: every code decodes, values sane
+                if n:
+                    assert int(cols.entity_codes.max()) < len(cols.entity_vocab)
+                    vals = cols.values[~np.isnan(cols.values)]
+                    assert vals.size == 0 or (vals.min() >= 1.0 and vals.max() <= 5.0)
+                scan_counts[slot].append(n)
+        except Exception as e:  # noqa: BLE001
+            errors.append(("scanner", slot, e))
+
+    writers = [threading.Thread(target=writer, args=(w,)) for w in range(3)]
+    scans = [threading.Thread(target=scanner, args=(s,)) for s in range(2)]
+    for t in scans:
+        t.start()
+    for t in writers:
+        t.start()
+    writers[0].join()
+    store.compact(1)       # exclusive pass mid-stream
+    for t in writers[1:]:
+        t.join()
+    stop.set()
+    for t in scans:
+        t.join()
+
+    assert not errors, errors
+    final = store.find_columnar(1, time_ordered=False)
+    assert len(final) == 3 * 20 * 50
+    # EACH scanner observed monotonically non-decreasing counts (no
+    # deletes here, and compaction drops nothing) and never phantom rows
+    assert any(scan_counts)
+    for counts in scan_counts:
+        assert counts == sorted(counts), counts
+        assert not counts or counts[-1] <= len(final)
+    store.close()
