@@ -182,6 +182,22 @@ class LocalFSModelsRepo(S.ModelsRepo):
         except FileNotFoundError:
             pass
 
+    def list(self):
+        import hashlib
+
+        out = []
+        for name in sorted(os.listdir(self._dir)):
+            if not name.startswith("pio_"):
+                continue
+            try:
+                with open(os.path.join(self._dir, name), "rb") as f:
+                    blob = f.read()
+            except FileNotFoundError:
+                continue  # concurrently deleted between listdir and open
+            out.append({"id": name[len("pio_"):], "bytes": len(blob),
+                        "sha256": hashlib.sha256(blob).hexdigest()})
+        return out
+
 
 _META_RECORDS = {
     "apps": (App, lambda r: r.id),

@@ -290,6 +290,13 @@ class MemoryChannelsRepo(_RecordRepo, S.ChannelsRepo):
             self._pre()
             self._drop(int(channel_id))
 
+    def put(self, channel):
+        # replication write: the record arrives pre-validated with its
+        # id already assigned by the owner endpoint (S.ChannelsRepo.put)
+        with self._lock:
+            self._pre()
+            self._put(int(channel.id), channel)
+
 
 class MemoryEngineManifestsRepo(_RecordRepo, S.EngineManifestsRepo):
     def insert(self, manifest):
@@ -411,6 +418,16 @@ class MemoryModelsRepo(S.ModelsRepo):
     def delete(self, id):
         with self._lock:
             self._models.pop(id, None)
+
+    def list(self):
+        import hashlib
+
+        with self._lock:
+            return [
+                {"id": m.id, "bytes": len(m.models),
+                 "sha256": hashlib.sha256(m.models).hexdigest()}
+                for m in self._models.values()
+            ]
 
 
 class MemoryStorageClient(S.StorageClient):

@@ -948,6 +948,9 @@ class RestAppsRepo(_RestRepo, S.AppsRepo):
     def insert(self, name, description=None):
         return self._rpc("insert", [name, description], "record")
 
+    def put(self, app):
+        self._rpc("put", [MD.record_to_dict(app)], "scalar")
+
     def get(self, app_id):
         return self._rpc("get", [int(app_id)], "record")
 
@@ -969,6 +972,9 @@ class RestAccessKeysRepo(_RestRepo, S.AccessKeysRepo):
 
     def insert(self, access_key):
         return self._rpc("insert", [MD.record_to_dict(access_key)], "scalar")
+
+    def put(self, access_key):
+        self._rpc("put", [MD.record_to_dict(access_key)], "scalar")
 
     def get(self, key):
         return self._rpc("get", [key], "record")
@@ -992,6 +998,9 @@ class RestChannelsRepo(_RestRepo, S.ChannelsRepo):
     def insert(self, name, app_id):
         return self._rpc("insert", [name, int(app_id)], "record")
 
+    def put(self, channel):
+        self._rpc("put", [MD.record_to_dict(channel)], "scalar")
+
     def get(self, channel_id):
         return self._rpc("get", [int(channel_id)], "record")
 
@@ -1007,6 +1016,9 @@ class RestEngineManifestsRepo(_RestRepo, S.EngineManifestsRepo):
 
     def insert(self, manifest):
         self._rpc("insert", [MD.record_to_dict(manifest)], "scalar")
+
+    def put(self, manifest):
+        self._rpc("put", [MD.record_to_dict(manifest)], "scalar")
 
     def get(self, id, version):
         return self._rpc("get", [id, version], "record")
@@ -1026,6 +1038,9 @@ class RestEngineInstancesRepo(_RestRepo, S.EngineInstancesRepo):
 
     def insert(self, instance):
         return self._rpc("insert", [MD.record_to_dict(instance)], "scalar")
+
+    def put(self, instance):
+        self._rpc("put", [MD.record_to_dict(instance)], "scalar")
 
     def get(self, id):
         return self._rpc("get", [id], "record")
@@ -1057,6 +1072,9 @@ class RestEvaluationInstancesRepo(_RestRepo, S.EvaluationInstancesRepo):
 
     def insert(self, instance):
         return self._rpc("insert", [MD.record_to_dict(instance)], "scalar")
+
+    def put(self, instance):
+        self._rpc("put", [MD.record_to_dict(instance)], "scalar")
 
     def get(self, id):
         return self._rpc("get", [id], "record")
@@ -1099,6 +1117,310 @@ class RestModelsRepo(S.ModelsRepo):
         self._t.request(f"/storage/models/{id}", method="DELETE",
                         idempotent=True)
 
+    def list(self) -> List[Dict[str, Any]]:
+        status, body = self._t.request("/storage/models", method="GET",
+                                       idempotent=True)
+        return json.loads(body)["models"]
+
+
+# ---------------------------------------------------------------------------
+# Replicated METADATA / MODELDATA (VERDICT r3 item 1)
+# ---------------------------------------------------------------------------
+#
+# The reference's metadata tier is highly available because
+# Elasticsearch replicates every index across its cluster
+# (elasticsearch/StorageClient.scala:42 — the transport client talks
+# to a CLUSTER), and model blobs survive machine loss because HDFS
+# keeps 3 copies of every block (hdfs/HDFSModels.scala:28). Here the
+# same availability is built from the framework's own storage servers:
+# with ``REPLICAS=R``, apps / access keys / channels / manifests /
+# instances / model blobs live on the FIRST R endpoints — every write
+# lands synchronously on all R, reads prefer the owner (endpoint 0)
+# and fail over through its successors, and `pio storagerepair`
+# reconciles divergence owner-authoritatively.
+#
+# Write-order invariant (same as the event tier): copies are written
+# SUCCESSORS-FIRST, owner LAST. Reads prefer the owner, so a partial
+# failure leaves phantom copies only where healthy reads don't look,
+# and a failed write reads back as "never happened". The exception is
+# the id-ASSIGNING inserts (apps, channels): their id comes from the
+# owner's sequence, so the owner must be written first — a failed
+# successor write then ROLLS BACK every copy by the now-known id.
+# Write availability intentionally requires the full replica set up
+# (a write that skipped a down replica would silently un-replicate);
+# the error names the dead endpoint.
+
+
+class _ReplicatedRepoBase:
+    """R per-endpoint proxies; index 0 is the owner."""
+
+    def __init__(self, proxies: List[Any]):
+        assert len(proxies) > 1
+        self._proxies = proxies
+
+    @staticmethod
+    def _url(proxy) -> str:
+        return proxy._t.base_url
+
+    def _read(self, fn):
+        """fn against the first live replica, owner-preferred. Only
+        connection-level failures advance; application errors (a 400,
+        a validation failure) propagate from the owner."""
+        last: Optional[Exception] = None
+        for p in self._proxies:
+            try:
+                return fn(p)
+            except S.StorageUnavailableError as e:
+                log.warning("metadata replica %s down, failing over: %s",
+                            self._url(p), e)
+                last = e
+        raise last
+
+    def _write_all(self, fn, rollback=None) -> None:
+        """fn on every replica, successors-first owner-last. On failure:
+        best-effort ``rollback(proxy)`` on the already-written copies
+        AND the failing endpoint (a commit-then-connection-drop raises
+        here too, and an idempotent rollback covers both outcomes),
+        then the original error propagates, naming the endpoint."""
+        written: List[Any] = []
+        for p in reversed(self._proxies):
+            try:
+                fn(p)
+            except S.StorageError:
+                if rollback is not None:
+                    for q in written + [p]:
+                        try:
+                            rollback(q)
+                        except S.StorageError:
+                            log.warning(
+                                "metadata write rollback failed on %s — "
+                                "copies diverged until `pio storagerepair`",
+                                self._url(q))
+                raise
+            written.append(p)
+
+    def _insert_owner_first(self, insert_fn, record_of, rollback):
+        """The id-assigning insert protocol: owner insert assigns the
+        id, successors take the full record via put, failure rolls back
+        every copy by id."""
+        record = insert_fn(self._proxies[0])
+        written = [self._proxies[0]]
+        for p in self._proxies[1:]:
+            try:
+                p.put(record_of(record))
+            except S.StorageError:
+                for q in written + [p]:
+                    try:
+                        rollback(q, record)
+                    except S.StorageError:
+                        log.warning(
+                            "metadata insert rollback failed on %s — "
+                            "copies diverged until `pio storagerepair`",
+                            self._url(q))
+                raise
+            written.append(p)
+        return record
+
+
+class ReplicatedAppsRepo(_ReplicatedRepoBase, S.AppsRepo):
+    def insert(self, name, description=None):
+        return self._insert_owner_first(
+            lambda p: p.insert(name, description),
+            lambda app: app,
+            lambda q, app: q.delete(app.id))
+
+    def get(self, app_id):
+        return self._read(lambda p: p.get(app_id))
+
+    def get_by_name(self, name):
+        return self._read(lambda p: p.get_by_name(name))
+
+    def get_all(self):
+        return self._read(lambda p: p.get_all())
+
+    def update(self, app):
+        # put (an upsert) instead of update on every copy: it also
+        # self-heals a replica that missed the record entirely
+        self._write_all(lambda p: p.put(app))
+
+    def put(self, app):
+        self._write_all(lambda p: p.put(app))
+
+    def delete(self, app_id):
+        self._write_all(lambda p: p.delete(app_id))
+
+
+class ReplicatedAccessKeysRepo(_ReplicatedRepoBase, S.AccessKeysRepo):
+    def insert(self, access_key):
+        # the key is generated CLIENT-side so every copy shares it (the
+        # event tier's client-stamped-id move); server-side generation
+        # would mint a different key per replica
+        if not access_key.key:
+            access_key = AccessKey.generate(access_key.appid,
+                                            access_key.events)
+        self._write_all(lambda p: p.put(access_key),
+                        rollback=lambda q: q.delete(access_key.key))
+        return access_key.key
+
+    def get(self, key):
+        return self._read(lambda p: p.get(key))
+
+    def get_all(self):
+        return self._read(lambda p: p.get_all())
+
+    def get_by_app_id(self, app_id):
+        return self._read(lambda p: p.get_by_app_id(app_id))
+
+    def update(self, access_key):
+        self._write_all(lambda p: p.put(access_key))
+
+    def put(self, access_key):
+        self._write_all(lambda p: p.put(access_key))
+
+    def delete(self, key):
+        self._write_all(lambda p: p.delete(key))
+
+
+class ReplicatedChannelsRepo(_ReplicatedRepoBase, S.ChannelsRepo):
+    def insert(self, name, app_id):
+        return self._insert_owner_first(
+            lambda p: p.insert(name, app_id),
+            lambda ch: ch,
+            lambda q, ch: q.delete(ch.id))
+
+    def get(self, channel_id):
+        return self._read(lambda p: p.get(channel_id))
+
+    def get_by_app_id(self, app_id):
+        return self._read(lambda p: p.get_by_app_id(app_id))
+
+    def put(self, channel):
+        self._write_all(lambda p: p.put(channel))
+
+    def delete(self, channel_id):
+        self._write_all(lambda p: p.delete(channel_id))
+
+
+class ReplicatedEngineManifestsRepo(_ReplicatedRepoBase, S.EngineManifestsRepo):
+    def insert(self, manifest):
+        # manifests upsert by natural key (`pio build` re-registers), so
+        # a rollback could erase a PRE-EXISTING registration — rely on
+        # owner-last ordering + repair instead
+        self._write_all(lambda p: p.put(manifest))
+
+    def get(self, id, version):
+        return self._read(lambda p: p.get(id, version))
+
+    def get_all(self):
+        return self._read(lambda p: p.get_all())
+
+    def update(self, manifest):
+        self._write_all(lambda p: p.put(manifest))
+
+    def put(self, manifest):
+        self._write_all(lambda p: p.put(manifest))
+
+    def delete(self, id, version):
+        self._write_all(lambda p: p.delete(id, version))
+
+
+class ReplicatedEngineInstancesRepo(_ReplicatedRepoBase, S.EngineInstancesRepo):
+    def insert(self, instance):
+        # id client-stamped (the server would mint one per replica)
+        if not instance.id:
+            import uuid as _uuid
+
+            instance.id = _uuid.uuid4().hex
+        self._write_all(lambda p: p.put(instance),
+                        rollback=lambda q: q.delete(instance.id))
+        return instance.id
+
+    def get(self, id):
+        return self._read(lambda p: p.get(id))
+
+    def get_all(self):
+        return self._read(lambda p: p.get_all())
+
+    def get_latest_completed(self, engine_id, engine_version, engine_variant):
+        return self._read(lambda p: p.get_latest_completed(
+            engine_id, engine_version, engine_variant))
+
+    def get_completed(self, engine_id, engine_version, engine_variant):
+        return self._read(lambda p: p.get_completed(
+            engine_id, engine_version, engine_variant))
+
+    def update(self, instance):
+        self._write_all(lambda p: p.put(instance))
+
+    def put(self, instance):
+        self._write_all(lambda p: p.put(instance))
+
+    def delete(self, id):
+        self._write_all(lambda p: p.delete(id))
+
+
+class ReplicatedEvaluationInstancesRepo(_ReplicatedRepoBase,
+                                        S.EvaluationInstancesRepo):
+    def insert(self, instance):
+        if not instance.id:
+            import uuid as _uuid
+
+            instance.id = _uuid.uuid4().hex
+        self._write_all(lambda p: p.put(instance),
+                        rollback=lambda q: q.delete(instance.id))
+        return instance.id
+
+    def get(self, id):
+        return self._read(lambda p: p.get(id))
+
+    def get_all(self):
+        return self._read(lambda p: p.get_all())
+
+    def get_completed(self):
+        return self._read(lambda p: p.get_completed())
+
+    def update(self, instance):
+        self._write_all(lambda p: p.put(instance))
+
+    def put(self, instance):
+        self._write_all(lambda p: p.put(instance))
+
+    def delete(self, id):
+        self._write_all(lambda p: p.delete(id))
+
+
+class ReplicatedModelsRepo(_ReplicatedRepoBase, S.ModelsRepo):
+    """Model blobs on R endpoints — the HDFS-3x-copies role
+    (hdfs/HDFSModels.scala:28) so a serving host can /reload from a
+    surviving replica after the blob's home dies."""
+
+    def insert(self, model):
+        self._write_all(lambda p: p.insert(model),
+                        rollback=lambda q: q.delete(model.id))
+
+    def get(self, id):
+        return self._read(lambda p: p.get(id))
+
+    def delete(self, id):
+        self._write_all(lambda p: p.delete(id))
+
+    def list(self):
+        return self._read(lambda p: p.list())
+
+
+#: (repo accessor, record key, enumerate(client) -> records) per
+#: metadata repo — drives owner-authoritative reconciliation. Channels
+#: have no get_all: they are enumerated through the endpoint's OWN apps
+#: listing (apps are repaired first, so the listings agree by then).
+_META_REPAIR_SPECS = [
+    ("apps", lambda r: r.id, lambda c: c.get_all()),
+    ("access_keys", lambda r: r.key, lambda c: c.get_all()),
+    ("channels", lambda r: r.id, None),  # via apps; see _enumerate_channels
+    ("engine_manifests", lambda r: (r.id, r.version), lambda c: c.get_all()),
+    ("engine_instances", lambda r: r.id, lambda c: c.get_all()),
+    ("evaluation_instances", lambda r: r.id, lambda c: c.get_all()),
+]
+
 
 class RestStorageClient(S.StorageClient):
     """Storage source of TYPE ``rest`` (HOSTS/PORTS per the env grammar).
@@ -1106,9 +1428,12 @@ class RestStorageClient(S.StorageClient):
     N comma-separated endpoints shard EVENTDATA by entity hash across N
     storage servers (ShardedRestEventStore — the HBase region-server
     fan-out role). Metadata and model blobs are NOT hash-shardable (they
-    are keyed lookups + listings) and pin to the FIRST endpoint, the way
-    the reference keeps metadata in one Elasticsearch cluster next to N
-    HBase region servers. HOSTS/PORTS zip elementwise; a single value on
+    are keyed lookups + listings): with ``REPLICAS=1`` they pin to the
+    FIRST endpoint; with ``REPLICAS=R>1`` they are REPLICATED across the
+    first R endpoints (Replicated*Repo — the ES-index-replication /
+    HDFS-3x-blobs roles), so the death of the metadata home no longer
+    takes out apps, access keys, engine instances, or trained models.
+    HOSTS/PORTS zip elementwise; a single value on
     one side broadcasts (``HOSTS=10.0.0.5 PORTS=7077,7078`` = two
     servers on one box; ``HOSTS=a,b PORTS=7077`` = one port on two).
     ``REPLICAS=R`` (default 1) adds successor replication of the event
@@ -1152,13 +1477,32 @@ class RestStorageClient(S.StorageClient):
             self._events = ShardedRestEventStore(
                 [RestEventStore(t) for t in self._transports],
                 replicas=replicas)
-        self._apps = RestAppsRepo(self._transport)
-        self._access_keys = RestAccessKeysRepo(self._transport)
-        self._channels = RestChannelsRepo(self._transport)
-        self._engine_manifests = RestEngineManifestsRepo(self._transport)
-        self._engine_instances = RestEngineInstancesRepo(self._transport)
-        self._evaluation_instances = RestEvaluationInstancesRepo(self._transport)
-        self._models = RestModelsRepo(self._transport)
+        self._meta_replicas = replicas if len(self._transports) > 1 else 1
+        if self._meta_replicas > 1:
+            # metadata + models on the first R endpoints: synchronous
+            # replication, owner-preferring read failover
+            metas = self._transports[:self._meta_replicas]
+            self._apps = ReplicatedAppsRepo([RestAppsRepo(t) for t in metas])
+            self._access_keys = ReplicatedAccessKeysRepo(
+                [RestAccessKeysRepo(t) for t in metas])
+            self._channels = ReplicatedChannelsRepo(
+                [RestChannelsRepo(t) for t in metas])
+            self._engine_manifests = ReplicatedEngineManifestsRepo(
+                [RestEngineManifestsRepo(t) for t in metas])
+            self._engine_instances = ReplicatedEngineInstancesRepo(
+                [RestEngineInstancesRepo(t) for t in metas])
+            self._evaluation_instances = ReplicatedEvaluationInstancesRepo(
+                [RestEvaluationInstancesRepo(t) for t in metas])
+            self._models = ReplicatedModelsRepo(
+                [RestModelsRepo(t) for t in metas])
+        else:
+            self._apps = RestAppsRepo(self._transport)
+            self._access_keys = RestAccessKeysRepo(self._transport)
+            self._channels = RestChannelsRepo(self._transport)
+            self._engine_manifests = RestEngineManifestsRepo(self._transport)
+            self._engine_instances = RestEngineInstancesRepo(self._transport)
+            self._evaluation_instances = RestEvaluationInstancesRepo(self._transport)
+            self._models = RestModelsRepo(self._transport)
 
     def events(self): return self._events
     def apps(self): return self._apps
@@ -1198,6 +1542,125 @@ class RestStorageClient(S.StorageClient):
         with ThreadPoolExecutor(max_workers=len(self._transports)) as ex:
             alive = list(ex.map(probe, self._transports))
         return {t.base_url: a for t, a in zip(self._transports, alive)}
+
+    @property
+    def meta_replicated(self) -> bool:
+        """Whether METADATA/MODELDATA on this source is replicated —
+        the capability probe `pio storagerepair` uses to SKIP an
+        unreplicated source (vs repair_meta's loud StorageError, which
+        must stay loud for direct callers)."""
+        return self._meta_replicas > 1
+
+    def health_tiers(self) -> Dict[str, Any]:
+        """Tier-resolved health (VERDICT r3 item 9): beyond the
+        conservative per-endpoint map, report whether each TIER can
+        still ANSWER — metadata/models serve while ANY of their first R
+        replicas lives; the event tier serves while EVERY shard has a
+        live replica. `pio status` turns this into distinct exit codes
+        so operators can page on "down" vs "degraded-but-serving"."""
+        detail = self.health_detail()
+        alive = [detail[t.base_url] for t in self._transports]
+        n = len(self._transports)
+        meta_serving = any(alive[:self._meta_replicas])
+        if isinstance(self._events, ShardedRestEventStore):
+            ev = self._events
+            events_serving = all(
+                any(alive[o] for o in ev._owners(k)) for k in range(n))
+        else:
+            events_serving = alive[0]
+        return {
+            "endpoints": detail,
+            "metadata_serving": meta_serving,
+            "events_serving": events_serving,
+            "all_up": all(alive),
+        }
+
+    # -- metadata/model anti-entropy ----------------------------------------
+    def _enumerate_channels(self, proxies_by_repo, endpoint) -> List[Channel]:
+        """All channels an endpoint holds, via its OWN apps listing
+        (ChannelsRepo has no get_all; apps are repaired first so the
+        listings agree by the time channels reconcile)."""
+        apps = proxies_by_repo["apps"][endpoint].get_all()
+        chan_repo = proxies_by_repo["channels"][endpoint]
+        out: List[Channel] = []
+        for app in apps:
+            out.extend(chan_repo.get_by_app_id(app.id))
+        return out
+
+    def repair_meta(self) -> Dict[str, int]:
+        """Owner-authoritative reconciliation of the replicated
+        METADATA + MODELDATA tier (`pio storagerepair`) — the
+        anti-entropy role ES performs when a recovered node re-syncs
+        its replica shards. For every repo the owner endpoint's records
+        are truth: each replica gains the owner records it is missing
+        or holds stale (compared as full dicts), and drops records the
+        owner does not have (rollback leftovers). Model blobs compare
+        by sha256 from the inventory route.
+
+        Preconditions mirror ShardedRestEventStore.repair: every
+        metadata replica must be up (the failover read would otherwise
+        treat a stale successor as truth), and writes should be
+        quiesced. Raises on an unreplicated source — zeros must mean
+        "checked and consistent". Returns {"copied": n, "deleted": n}.
+        """
+        if self._meta_replicas <= 1:
+            raise S.StorageError(
+                "METADATA/MODELDATA is not replicated (REPLICAS=1) — "
+                "nothing to repair"
+            )
+        metas = self._transports[:self._meta_replicas]
+        proxies_by_repo = {
+            "apps": [RestAppsRepo(t) for t in metas],
+            "access_keys": [RestAccessKeysRepo(t) for t in metas],
+            "channels": [RestChannelsRepo(t) for t in metas],
+            "engine_manifests": [RestEngineManifestsRepo(t) for t in metas],
+            "engine_instances": [RestEngineInstancesRepo(t) for t in metas],
+            "evaluation_instances": [RestEvaluationInstancesRepo(t)
+                                     for t in metas],
+        }
+        copied = deleted = 0
+        for repo_name, key_of, enumerate_fn in _META_REPAIR_SPECS:
+            proxies = proxies_by_repo[repo_name]
+
+            def records_of(endpoint: int):
+                if enumerate_fn is None:
+                    return self._enumerate_channels(proxies_by_repo, endpoint)
+                return enumerate_fn(proxies[endpoint])
+
+            truth = {key_of(r): r for r in records_of(0)}
+            truth_dicts = {k: MD.record_to_dict(r) for k, r in truth.items()}
+            for endpoint in range(1, len(metas)):
+                have = {key_of(r): r for r in records_of(endpoint)}
+                for k, rec in truth.items():
+                    mine = have.get(k)
+                    if mine is None or MD.record_to_dict(mine) != truth_dicts[k]:
+                        proxies[endpoint].put(rec)
+                        copied += 1
+                for k, rec in have.items():
+                    if k not in truth:
+                        # delete signatures vary by repo; the key IS the
+                        # delete argument except manifests' (id, version)
+                        if repo_name == "engine_manifests":
+                            proxies[endpoint].delete(*k)
+                        else:
+                            proxies[endpoint].delete(k)
+                        deleted += 1
+        # model blobs: sha256 inventory diff, owner-authoritative
+        model_proxies = [RestModelsRepo(t) for t in metas]
+        truth_inv = {m["id"]: m for m in model_proxies[0].list()}
+        for endpoint in range(1, len(metas)):
+            have_inv = {m["id"]: m for m in model_proxies[endpoint].list()}
+            for mid, info in truth_inv.items():
+                mine = have_inv.get(mid)
+                if mine is None or mine["sha256"] != info["sha256"]:
+                    blob = model_proxies[0].get(mid)
+                    if blob is not None:  # deleted between list and get
+                        model_proxies[endpoint].insert(blob)
+                        copied += 1
+            for mid in have_inv.keys() - truth_inv.keys():
+                model_proxies[endpoint].delete(mid)
+                deleted += 1
+        return {"copied": copied, "deleted": deleted}
 
 
 S.register_backend("rest", RestStorageClient)

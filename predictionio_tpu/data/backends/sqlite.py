@@ -353,6 +353,15 @@ class SqliteAppsRepo(S.AppsRepo):
             (app.name, json.dumps(record_to_dict(app)), app.id),
         )
 
+    def put(self, app):
+        # replication upsert with the owner-assigned id (update above is
+        # UPDATE-only and would silently no-op on a replica missing the
+        # row — S.AppsRepo.put contract)
+        self._db.execute(
+            "INSERT OR REPLACE INTO apps (id, name, payload) VALUES (?, ?, ?)",
+            (int(app.id), app.name, json.dumps(record_to_dict(app))),
+        )
+
     def delete(self, app_id):
         self._db.execute("DELETE FROM apps WHERE id=?", (int(app_id),))
 
@@ -435,6 +444,15 @@ class SqliteChannelsRepo(S.ChannelsRepo):
 
     def delete(self, channel_id):
         self._db.execute("DELETE FROM channels WHERE id=?", (int(channel_id),))
+
+    def put(self, channel):
+        # replication upsert with the owner-assigned id (S.ChannelsRepo.put)
+        self._db.execute(
+            "INSERT OR REPLACE INTO channels (id, appid, name, payload)"
+            " VALUES (?, ?, ?, ?)",
+            (int(channel.id), int(channel.appid), channel.name,
+             json.dumps(record_to_dict(channel))),
+        )
 
 
 class SqliteEngineManifestsRepo(S.EngineManifestsRepo):
@@ -577,6 +595,15 @@ class SqliteModelsRepo(S.ModelsRepo):
 
     def delete(self, id):
         self._db.execute("DELETE FROM models WHERE id=?", (id,))
+
+    def list(self):
+        import hashlib
+
+        return [
+            {"id": r["id"], "bytes": len(r["blob"]),
+             "sha256": hashlib.sha256(r["blob"]).hexdigest()}
+            for r in self._db.query("SELECT id, blob FROM models ORDER BY id")
+        ]
 
 
 class SqliteStorageClient(S.StorageClient):

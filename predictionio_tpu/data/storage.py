@@ -579,6 +579,15 @@ class AppsRepo(abc.ABC):
     @abc.abstractmethod
     def delete(self, app_id: int) -> None: ...
 
+    def put(self, app: App) -> None:
+        """Upsert the FULL record under its existing id — the
+        replication / anti-entropy write (the metadata-tier role of
+        ES's replica shards, elasticsearch/StorageClient.scala:42).
+        Never assigns ids and never re-validates uniqueness: the
+        owner's ``insert`` already did both. Backends whose ``update``
+        is not an upsert override this."""
+        self.update(app)
+
 
 class AccessKeysRepo(abc.ABC):
     """ref: AccessKeys.scala"""
@@ -596,6 +605,10 @@ class AccessKeysRepo(abc.ABC):
     @abc.abstractmethod
     def delete(self, key: str) -> None: ...
 
+    def put(self, access_key: AccessKey) -> None:
+        """Replication/anti-entropy upsert (see AppsRepo.put)."""
+        self.update(access_key)
+
 
 class ChannelsRepo(abc.ABC):
     """ref: Channels.scala"""
@@ -608,6 +621,11 @@ class ChannelsRepo(abc.ABC):
     def get_by_app_id(self, app_id: int) -> List[Channel]: ...
     @abc.abstractmethod
     def delete(self, channel_id: int) -> None: ...
+    @abc.abstractmethod
+    def put(self, channel: Channel) -> None:
+        """Replication/anti-entropy upsert under the record's existing
+        id (see AppsRepo.put). Abstract because ChannelsRepo has no
+        ``update`` to default to."""
 
 
 class EngineManifestsRepo(abc.ABC):
@@ -623,6 +641,10 @@ class EngineManifestsRepo(abc.ABC):
     def update(self, manifest: EngineManifest) -> None: ...
     @abc.abstractmethod
     def delete(self, id: str, version: str) -> None: ...
+
+    def put(self, manifest: EngineManifest) -> None:
+        """Replication/anti-entropy upsert (see AppsRepo.put)."""
+        self.update(manifest)
 
 
 class EngineInstancesRepo(abc.ABC):
@@ -647,6 +669,10 @@ class EngineInstancesRepo(abc.ABC):
     @abc.abstractmethod
     def delete(self, id: str) -> None: ...
 
+    def put(self, instance: EngineInstance) -> None:
+        """Replication/anti-entropy upsert (see AppsRepo.put)."""
+        self.update(instance)
+
 
 class EvaluationInstancesRepo(abc.ABC):
     """ref: EvaluationInstances.scala"""
@@ -664,6 +690,10 @@ class EvaluationInstancesRepo(abc.ABC):
     @abc.abstractmethod
     def delete(self, id: str) -> None: ...
 
+    def put(self, instance: EvaluationInstance) -> None:
+        """Replication/anti-entropy upsert (see AppsRepo.put)."""
+        self.update(instance)
+
 
 class ModelsRepo(abc.ABC):
     """ref: Models.scala — model blobs keyed by engine-instance id."""
@@ -674,6 +704,13 @@ class ModelsRepo(abc.ABC):
     def get(self, id: str) -> Optional[Model]: ...
     @abc.abstractmethod
     def delete(self, id: str) -> None: ...
+    @abc.abstractmethod
+    def list(self) -> List[Dict[str, Any]]:
+        """Inventory for replica reconciliation: one
+        ``{"id", "bytes", "sha256"}`` per stored blob (the role of
+        HDFS's block reports under 3x replication,
+        hdfs/HDFSModels.scala:28). A maintenance-path call — the
+        hash walk is priced accordingly."""
 
 
 class StorageClient(abc.ABC):
@@ -802,6 +839,41 @@ class Storage:
                 out[repo] = dict(cached)
             except Exception:
                 out[repo] = {"": False}
+        return out
+
+    def serving_status(self) -> Dict[str, Dict[str, Any]]:
+        """Tier-resolved health for `pio status` exit codes: for each
+        repository, whether its tier can still ANSWER (a replicated
+        source serves through surviving replicas) and whether it is
+        degraded (serving, but some endpoint down). Complements the
+        deliberately conservative verify_all_data_objects, which fails
+        a source on ANY down endpoint."""
+        out: Dict[str, Dict[str, Any]] = {}
+        probed: Dict[int, Dict[str, Any]] = {}  # one probe per client
+        for repo in REPOSITORIES:
+            try:
+                client = self.client_for(repo)
+                tiers = probed.get(id(client))
+                if tiers is None:
+                    fn = getattr(client, "health_tiers", None)
+                    if fn is not None:
+                        tiers = dict(fn())
+                    else:
+                        up = bool(client.health_check())
+                        tiers = {"endpoints": {"": up},
+                                 "metadata_serving": up,
+                                 "events_serving": up, "all_up": up}
+                    probed[id(client)] = tiers
+                serving = (tiers["events_serving"] if repo == "EVENTDATA"
+                           else tiers["metadata_serving"])
+                out[repo] = {
+                    "serving": bool(serving),
+                    "degraded": bool(serving) and not tiers["all_up"],
+                    "endpoints": dict(tiers["endpoints"]),
+                }
+            except Exception:
+                out[repo] = {"serving": False, "degraded": False,
+                             "endpoints": {"": False}}
         return out
 
     # -- construction -------------------------------------------------------

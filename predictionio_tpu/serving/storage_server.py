@@ -146,6 +146,7 @@ _REPO_SPECS: Dict[str, Dict[str, Any]] = {
         "record_cls": App,
         "methods": {
             "insert": ((), "record"),
+            "put": ((0,), "scalar"),
             "get": ((), "record"),
             "get_by_name": ((), "record"),
             "get_all": ((), "records"),
@@ -157,6 +158,7 @@ _REPO_SPECS: Dict[str, Dict[str, Any]] = {
         "record_cls": AccessKey,
         "methods": {
             "insert": ((0,), "scalar"),
+            "put": ((0,), "scalar"),
             "get": ((), "record"),
             "get_all": ((), "records"),
             "get_by_app_id": ((), "records"),
@@ -168,6 +170,7 @@ _REPO_SPECS: Dict[str, Dict[str, Any]] = {
         "record_cls": Channel,
         "methods": {
             "insert": ((), "record"),
+            "put": ((0,), "scalar"),
             "get": ((), "record"),
             "get_by_app_id": ((), "records"),
             "delete": ((), "scalar"),
@@ -177,6 +180,7 @@ _REPO_SPECS: Dict[str, Dict[str, Any]] = {
         "record_cls": EngineManifest,
         "methods": {
             "insert": ((0,), "scalar"),
+            "put": ((0,), "scalar"),
             "get": ((), "record"),
             "get_all": ((), "records"),
             "update": ((0,), "scalar"),
@@ -187,6 +191,7 @@ _REPO_SPECS: Dict[str, Dict[str, Any]] = {
         "record_cls": EngineInstance,
         "methods": {
             "insert": ((0,), "scalar"),
+            "put": ((0,), "scalar"),
             "get": ((), "record"),
             "get_all": ((), "records"),
             "get_latest_completed": ((), "record"),
@@ -199,6 +204,7 @@ _REPO_SPECS: Dict[str, Dict[str, Any]] = {
         "record_cls": EvaluationInstance,
         "methods": {
             "insert": ((0,), "scalar"),
+            "put": ((0,), "scalar"),
             "get": ((), "record"),
             "get_all": ((), "records"),
             "get_completed": ((), "records"),
@@ -265,6 +271,12 @@ class StorageRequestHandler(JSONRequestHandler):
             # sharded training read is PROVEN to fetch half the rows
             # each (the Spark-UI per-executor input-size role)
             return self._send(200, self.server_ref.scan_stats())
+        if parsed.path in ("/storage/models", "/storage/models/"):
+            # replica-reconciliation inventory (id/bytes/sha256 per
+            # blob) — the HDFS block-report role for `pio storagerepair`
+            return self._guarded(
+                lambda: self._send(
+                    200, {"models": self.server_ref.storage.models().list()}))
         if parsed.path.startswith("/storage/models/"):
             return self._guarded(self._get_model,
                                  parsed.path[len("/storage/models/"):])

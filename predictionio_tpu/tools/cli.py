@@ -308,9 +308,30 @@ def cmd_storageserver(args) -> int:
 
 
 def cmd_storagerepair(args) -> int:
-    stats = commands.repair_events(args.appname, args.channel)
-    _p(f"Replica repair for app {args.appname}: "
-       f"{stats['copied']} rows copied, {stats['deleted']} rows deleted")
+    """Anti-entropy over every replicated tier: the app's events, then
+    the metadata/model replica set. A tier that is not replicated is
+    reported as skipped; if NEITHER tier is repairable the command
+    fails loudly (nothing was checked)."""
+    from predictionio_tpu.data.storage import StorageError
+
+    repaired = 0
+    try:
+        stats = commands.repair_events(args.appname, args.channel)
+        _p(f"Event replica repair for app {args.appname}: "
+           f"{stats['copied']} rows copied, {stats['deleted']} rows deleted")
+        repaired += 1
+    except (commands.CommandError, StorageError) as e:
+        _p(f"Events: skipped ({e})")
+        events_error = e
+    try:
+        stats = commands.repair_metadata()
+        _p(f"Metadata/model replica repair: {stats['copied']} records "
+           f"copied, {stats['deleted']} records deleted")
+        repaired += 1
+    except commands.CommandError as e:
+        _p(f"Metadata/models: skipped ({e})")
+    if not repaired:
+        raise events_error
     return 0
 
 
@@ -428,22 +449,38 @@ def cmd_run(args) -> int:
     return 0
 
 
+#: `pio status` exit code when every tier still ANSWERS but some
+#: endpoint is down (replicas absorbing the failure) — distinct from 1
+#: (a tier cannot serve) so operators page on the right thing
+#: (ref: Storage.verifyAllDataObjects role, Storage.scala:237).
+STATUS_DEGRADED = 2
+
+
 def cmd_status(args) -> int:
     from predictionio_tpu.data.storage import get_storage
 
-    details = get_storage().health_details()
-    ok = all(all(shards.values()) for shards in details.values())
-    for repo, shards in sorted(details.items()):
-        good = all(shards.values())
-        _p(f"{repo}: {'OK' if good else 'FAILED'}")
-        if len(shards) > 1 or not good:
-            # sharded source (or a failure): name each shard so a down
-            # one is identified, not just counted
-            for shard, alive in sorted(shards.items()):
+    details = get_storage().serving_status()
+    all_up = all(d["serving"] and not d["degraded"] for d in details.values())
+    serving = all(d["serving"] for d in details.values())
+    for repo, d in sorted(details.items()):
+        state = ("OK" if d["serving"] and not d["degraded"]
+                 else "DEGRADED" if d["serving"] else "FAILED")
+        _p(f"{repo}: {state}")
+        if len(d["endpoints"]) > 1 or not d["serving"] or d["degraded"]:
+            # sharded source (or a failure): name each endpoint so a
+            # down one is identified, not just counted
+            for shard, alive in sorted(d["endpoints"].items()):
                 if shard:
                     _p(f"  shard {shard}: {'OK' if alive else 'DOWN'}")
-    _p("(sleeping)" if ok else "Unable to connect to all storage backends.")
-    return 0 if ok else 1
+    if all_up:
+        _p("(sleeping)")
+        return 0
+    if serving:
+        _p("Storage degraded: every tier still serving through replicas, "
+           "but some endpoint is down.")
+        return STATUS_DEGRADED
+    _p("Unable to connect to all storage backends.")
+    return 1
 
 
 def cmd_template(args) -> int:

@@ -26,7 +26,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from predictionio_tpu.data.metadata import AccessKey, App, Channel
-from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.data.storage import Storage, StorageError, get_storage
 
 
 class CommandError(RuntimeError):
@@ -228,3 +228,43 @@ def repair_events(app_name: str, channel_name: Optional[str] = None,
     # an unreplicated sharded store raises StorageError from repair()
     # itself (the loud-failure guard lives with the operation)
     return repair(app_id, channel_id)
+
+
+def repair_metadata(storage: Optional[Storage] = None) -> Dict[str, int]:
+    """Owner-authoritative reconciliation of replicated METADATA and
+    MODELDATA (`pio storagerepair`) — the tier-availability counterpart
+    of repair_events (ES replica re-sync / HDFS block-repair roles).
+    Each distinct replicated client repairs once even when both
+    repositories share a source. Fails loudly when no repository is on
+    a replicated rest source — zeros must mean "checked and
+    consistent", never "nothing to check"."""
+    st = _storage(storage)
+    clients: list = []
+    for repo in ("METADATA", "MODELDATA"):
+        try:
+            c = st.client_for(repo)
+        except StorageError:
+            continue
+        if not any(c is seen for seen in clients):
+            clients.append(c)
+    totals = {"copied": 0, "deleted": 0}
+    found = False
+    for c in clients:
+        fn = getattr(c, "repair_meta", None)
+        # an unreplicated rest source (REPLICAS=1) is "nothing to
+        # check" — the same CommandError as no rest source at all —
+        # while an exception from a replicated repair stays LOUD (it
+        # means divergence was left behind, not that there was nothing
+        # to do)
+        if fn is None or not getattr(c, "meta_replicated", False):
+            continue
+        found = True
+        stats = fn()
+        totals["copied"] += stats["copied"]
+        totals["deleted"] += stats["deleted"]
+    if not found:
+        raise CommandError(
+            "METADATA/MODELDATA is not a replicated rest source — nothing "
+            "to repair (configure REPLICAS>1 on its source)"
+        )
+    return totals

@@ -200,16 +200,20 @@ def test_two_process_train_and_deploy_via_shared_storage(memory_storage):
 
 
 def test_multihost_train_survives_dead_storage_replica():
-    """The capstone composition: 2 jax.distributed processes run the
-    real train→deploy workflow against a 2-server REPLICATED (R=2)
-    storage tier with one server KILLED before training — reads fail
-    over to the surviving replica, metadata/models live on the (first,
-    surviving) endpoint, and the whole product path completes. The
-    reference's analogue is HBase riding out a dead region server on
-    HDFS replicas."""
+    """The capstone composition (extended per VERDICT r3 item 1): 2
+    jax.distributed processes run the real train→deploy workflow
+    against a 3-server REPLICATED (R=2) storage tier with one event
+    replica KILLED before training — reads fail over to surviving
+    replicas and the whole product path completes. THEN the METADATA
+    HOME (server 0) is killed too: get_latest_completed, the model
+    blob fetch and a fresh deploy+query all still answer from the
+    surviving metadata replica, while metadata writes fail loudly
+    naming the dead endpoint. The reference's analogue is HBase riding
+    out a dead region server on HDFS replicas while Elasticsearch
+    serves metadata from its replica shards."""
     backends = []
     servers = []
-    for _ in range(2):
+    for _ in range(3):
         from predictionio_tpu.data.storage import Storage
 
         b = Storage.from_env({
@@ -226,12 +230,12 @@ def test_multihost_train_survives_dead_storage_replica():
                                      port=0).start())
     ports = [s.port for s in servers]
     try:
-        # seed THROUGH the replicated client: copies land on both
+        # seed THROUGH the replicated client: event copies land on each
+        # shard's successor pair, metadata/models on servers 0 AND 1
         from tests.test_sharded_storage import _client
 
         seeder = _client(ports, replicas=2)
         seeder.apps().insert("mhapp")
-        n_events = None
         import numpy as np
 
         rng = np.random.default_rng(7)
@@ -251,19 +255,44 @@ def test_multihost_train_survives_dead_storage_replica():
                 ))
                 m += 1
         seeder.events().insert_batch(events, 1)
-        n_events = len(events)
-        assert len(backends[1].events().find(1)) == n_events  # replicated
+        assert backends[1].apps().get_by_name("mhapp") is not None  # meta
+        # replicated onto the successor
 
-        servers[1].stop()  # kill the non-metadata server
+        servers[2].stop()  # kill a pure event replica before training
 
         procs, outs = _run_workers(_free_port(), ports, replicas=2)
         for pid, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"process {pid} failed:\n{out}"
             assert f"MHWF OK p{pid}" in out
         assert "DEPLOY OK" in outs[1]
-        instances = backends[0].engine_instances().get_all()
-        assert len(instances) == 1 and instances[0].status == "COMPLETED"
-        assert backends[0].models().get(instances[0].id) is not None
+        # single-writer metadata landed on BOTH replicas
+        for b in backends[:2]:
+            instances = b.engine_instances().get_all()
+            assert len(instances) == 1 and instances[0].status == "COMPLETED"
+            assert b.models().get(instances[0].id) is not None
+
+        # -- now kill the METADATA HOME ---------------------------------
+        servers[0].stop()
+        from predictionio_tpu.data.storage import StorageUnavailableError
+        from predictionio_tpu.workflow.deploy import prepare_deploy
+        from predictionio_tpu.core.params import EngineParams  # noqa: F401
+        from predictionio_tpu.templates import recommendation as reco_t
+
+        survivor = _client(ports, replicas=2)
+        stored = survivor.engine_instances().get_latest_completed(
+            "mh-reco", "0", "default")
+        assert stored is not None, "metadata failover read failed"
+        assert survivor.models().get(stored.id) is not None
+        dep = prepare_deploy(reco_t.recommendation_engine(), stored,
+                             storage=survivor)
+        res = dep.query({"user": "user_1", "num": 3})
+        assert res["itemScores"], res
+        # writes fail loudly, naming the dead home
+        import pytest as _pytest
+
+        with _pytest.raises(StorageUnavailableError) as ei:
+            survivor.apps().insert("postmortem")
+        assert f"http://127.0.0.1:{ports[0]}" in str(ei.value)
     finally:
         for s in servers:
             s.stop()
