@@ -13,8 +13,14 @@ cache, so both halves of the compile story are measured:
     synth   - structured ratings (latent-factor signal + noise, so the
               RMSE gates below measure real generalization, not luck)
     ingest  - 20M events into the native eventlog via the storage write
-              API (columnar bulk path = PEvents.write role; the row
-              path insert_batch is sampled separately)
+              API (columnar bulk path = PEvents.write role). The live
+              row lane — raw API-format JSON array bytes through the
+              native encoder (insert_json_batch, the POST
+              /batch/events.json path) — is sampled separately with a
+              hard gate: row_lane_events_per_sec >= 50k or the
+              headline is zeroed. The FSYNC=1 (SYNC_WAL durability)
+              lane and the legacy Event-object fallback are reported
+              alongside.
     read    - RecoDataSource.read_training: native columnar scan
     prepare - RecoPreparator: BiMap id indexing
     bin     - ragged->segmented static blocks + device placement
@@ -52,8 +58,9 @@ of 1e6 ratings*iters/sec for a Spark-MLlib-ALS CPU node — the reference
 publishes no benchmark numbers at all (BASELINE.json "published": {});
 the proxy is our own stated assumption, recorded in the detail block,
 and the >=5x north-star (BASELINE.md) reads as vs_baseline >= 5.
-If ANY gate fails (relative RMSE, absolute RMSE band, serving p50),
-value is reported as 0.0 with the gate flags telling which.
+If ANY gate fails (relative RMSE, absolute RMSE band, serving p50,
+row-lane >= 50k ev/s), value is reported as 0.0 with the gate flags
+telling which.
 
 Scale knobs via env: PIO_BENCH_USERS/ITEMS/RATINGS/RANK/ITERS (the
 absolute RMSE band only applies at the default knobs).
@@ -119,10 +126,56 @@ def _storage(base_dir):
     return st
 
 
+def _bench_cfg():
+    from predictionio_tpu.ops.als import ALSConfig
+
+    _, _, _, rank, iterations = knobs()
+    return ALSConfig(rank=rank, iterations=iterations, reg=0.05,
+                     block_size=4096)
+
+
+#: bench derivation tag for the binned-layout cache: the 5% holdout
+#: split below reshapes the COO, so the key must differ from the
+#: template's full-data key
+_HOLD_TAG = "|hold5pct"
+
+
+def _transfer_and_compile(detail, trainer, iterations, n_read):
+    """Shared tail of both stages: device transfer barrier (honest
+    bytes + bandwidth so tunnel VARIANCE reads as bandwidth, not as a
+    pipeline regression — VERDICT r3 weak #2), compile, timed train."""
+    t0 = time.perf_counter()
+    trainer.wait_device()
+    transfer_sec = time.perf_counter() - t0
+    detail["transfer_sec"] = round(transfer_sec, 2)
+    detail["transfer_bytes"] = int(trainer.transfer_bytes)
+    detail["transfer_mb_per_sec"] = round(
+        trainer.transfer_bytes / max(transfer_sec, 1e-9) / 1e6, 1)
+    t0 = time.perf_counter()
+    trainer.compile()
+    detail["compile_sec"] = round(time.perf_counter() - t0, 2)
+    # continuity with BENCH_r01/r02 (one one-time-costs number)
+    detail["bin_compile_sec"] = round(
+        detail["bin_sec"] + detail["transfer_sec"] + detail["compile_sec"], 2
+    )
+    t0 = time.perf_counter()
+    trainer.step_n(iterations)
+    train_sec = time.perf_counter() - t0
+    detail["train_sec"] = round(train_sec, 2)
+    detail["events_to_model_sec"] = round(
+        detail["read_sec"] + detail["prepare_sec"]
+        + detail["bin_compile_sec"] + train_sec, 2
+    )
+    detail["events_to_model_events_per_sec"] = round(
+        n_read / detail["events_to_model_sec"], 1
+    )
+    return train_sec
+
+
 def _read_prepare_bin_train(detail, n_expected):
     """The shared events->model path (both stages): returns everything
     the caller needs for quality gates / serving."""
-    from predictionio_tpu.ops.als import ALSConfig, ALSTrainer
+    from predictionio_tpu.ops.als import ALSTrainer
     from predictionio_tpu.parallel.mesh import MeshContext
     from predictionio_tpu.templates.recommendation import (
         RecoDataSource,
@@ -148,36 +201,14 @@ def _read_prepare_bin_train(detail, n_expected):
     tr_u, tr_i, tr_r = pd.user_idx[~hold], pd.item_idx[~hold], pd.ratings[~hold]
     ho = (pd.user_idx[hold], pd.item_idx[hold], pd.ratings[hold])
 
-    cfg = ALSConfig(rank=rank, iterations=iterations, reg=0.05,
-                    block_size=4096)
+    cfg = _bench_cfg()
+    cache_key = (pd.fingerprint + _HOLD_TAG) if pd.fingerprint else None
     t0 = time.perf_counter()
     trainer = ALSTrainer((tr_u, tr_i, tr_r), len(pd.user_ids),
-                         len(pd.item_ids), cfg)
+                         len(pd.item_ids), cfg, cache_key=cache_key)
     detail["bin_sec"] = round(time.perf_counter() - t0, 2)
-    # barrier on the async host->device puts, so compile_sec below is
-    # genuinely compile (+1 throwaway run), not hidden bulk transfer —
-    # on this tunneled chip the transfer is the larger of the two
-    t0 = time.perf_counter()
-    trainer.wait_device()
-    detail["transfer_sec"] = round(time.perf_counter() - t0, 2)
-    t0 = time.perf_counter()
-    trainer.compile()
-    detail["compile_sec"] = round(time.perf_counter() - t0, 2)
-    # continuity with BENCH_r01/r02 (one one-time-costs number)
-    detail["bin_compile_sec"] = round(
-        detail["bin_sec"] + detail["transfer_sec"] + detail["compile_sec"], 2
-    )
-
-    t0 = time.perf_counter()
-    trainer.step_n(iterations)
-    train_sec = time.perf_counter() - t0
-    detail["train_sec"] = round(train_sec, 2)
-    detail["events_to_model_sec"] = round(
-        read_sec + detail["prepare_sec"] + detail["bin_compile_sec"] + train_sec, 2
-    )
-    detail["events_to_model_events_per_sec"] = round(
-        n_read / detail["events_to_model_sec"], 1
-    )
+    detail["bin_cache_hit"] = bool(trainer.cache_hit)
+    train_sec = _transfer_and_compile(detail, trainer, iterations, n_read)
     return trainer, pd, ho, (tr_u, tr_i, tr_r), cfg, train_sec
 
 
@@ -351,10 +382,17 @@ def stage_cold(base_dir, out_path):
     detail["ingest_sec"] = round(ingest_sec, 2)
     detail["ingest_events_per_sec"] = round(n_ratings / ingest_sec, 1)
 
-    # row-path write rate, sampled (the per-request API the event
-    # server uses for live traffic). Timed in two phases: building the
-    # Event objects (the handler's job, from parsed JSON — hence plain
-    # python values below) and the DAO insert_batch append itself.
+    # row-path write rate, sampled — the lane the event server pays for
+    # live traffic. Since r4 that lane is the NATIVE JSON encoder
+    # (EventLogEventStore.insert_json_batch, wired into POST
+    # /batch/events.json): the raw API-format JSON array bytes go
+    # straight to C++ — parse + EventValidation + wire packing + append
+    # in one GIL-released call, no per-row Python objects. The timed
+    # region is exactly the server's post-HTTP work (auth/stats
+    # excluded); building the JSON bytes is the CLIENT's cost and is
+    # reported separately. The legacy Event-object path (the DAO
+    # fallback every non-native backend still uses) is kept as a
+    # secondary metric.
     sample = min(100_000, n_ratings)
     import datetime as dt
 
@@ -380,9 +418,47 @@ def stage_cold(base_dir, out_path):
         app.id,
     )
     detail["post_bulk_append_debt_sec"] = round(time.perf_counter() - t0, 2)
+
+    # client-side JSON build (the SDK's cost, not the server's)
+    t0 = time.perf_counter()
+    # event name is NOT the training event ("rate"), so the sampled
+    # lanes stay out of read_training and the RMSE gates see exactly
+    # the synthesized ratings
+    raw = json.dumps([
+        {"event": "bench-row", "entityType": "user", "entityId": f"u{uu_py[k]}",
+         "targetEntityType": "item", "targetEntityId": f"i{ii_py[k]}",
+         "properties": {"rating": vals_py[k]},
+         "eventTime": f"2026-01-01T{(k // 3600) % 24:02d}:"
+                      f"{(k // 60) % 60:02d}:{k % 60:02d}.000Z"}
+        for k in range(sample)
+    ]).encode()
+    t1 = time.perf_counter()
+    ids, codes, _, _ = storage.events().insert_json_batch(raw, app.id)
+    t2 = time.perf_counter()
+    assert all(c == 0 for c in codes) and len(ids) == sample
+    detail["json_build_events_per_sec"] = round(sample / (t1 - t0), 1)
+    detail["row_lane_events_per_sec"] = round(sample / (t2 - t1), 1)
+    detail["row_lane_gate_passed"] = bool(
+        detail["row_lane_events_per_sec"] >= 50_000.0)
+
+    # FSYNC=1 lane (the HBase SYNC_WAL durability contract): same
+    # batch, group-committed — one fdatasync per call
+    from predictionio_tpu.data.backends.eventlog import EventLogEventStore
+
+    fsync_store = EventLogEventStore(
+        os.path.join(base_dir, "bench_fsync_lane"), fsync=True)
+    fsync_store.init(1)
+    t0 = time.perf_counter()
+    fsync_store.insert_json_batch(raw, 1)
+    t1 = time.perf_counter()
+    fsync_store.close()
+    detail["row_lane_fsync_events_per_sec"] = round(sample / (t1 - t0), 1)
+
+    # legacy Event-object path (the non-native DAO fallback), two
+    # phases: object build + Python-packed append
     t0 = time.perf_counter()
     events = [
-        Event(event="rate", entity_type="user", entity_id=f"u{uu_py[k]}",
+        Event(event="bench-row", entity_type="user", entity_id=f"u{uu_py[k]}",
               target_entity_type="item", target_entity_id=f"i{ii_py[k]}",
               properties={"rating": vals_py[k]},
               event_time=epoch + k * second)
@@ -393,10 +469,10 @@ def stage_cold(base_dir, out_path):
     t2 = time.perf_counter()
     detail["event_build_events_per_sec"] = round(sample / (t1 - t0), 1)
     detail["insert_batch_events_per_sec"] = round(sample / (t2 - t1), 1)
-    detail["row_lane_events_per_sec"] = round(sample / (t2 - t0), 1)
+    detail["python_row_lane_events_per_sec"] = round(sample / (t2 - t0), 1)
 
     trainer, pd, ho, train_coo, cfg, train_sec = _read_prepare_bin_train(
-        detail, n_ratings + sample
+        detail, n_ratings
     )
     factors = trainer.factors()
 
@@ -431,17 +507,51 @@ def stage_cold(base_dir, out_path):
 
 
 def stage_warm(base_dir, out_path):
-    """Fresh process, same store + same compilation cache: the repeat
-    events->model path every retrain / deploy / reload pays."""
+    """Fresh process, same store + same compilation + layout caches:
+    the repeat events->model path every retrain / deploy / reload pays.
+
+    The retrain-on-unchanged-data fast path (VERDICT r3 item 2): the
+    event log's O(1) fingerprint keys the binned-layout cache the cold
+    stage populated, so read/prepare/bin are all SKIPPED — no 20M-row
+    re-scan, no re-binning. The device transfer IS re-paid: device
+    memory does not survive the process, so the compressed layout's
+    bytes must cross the tunnel again (reported with bytes + MB/s so
+    tunnel variance is distinguishable from a pipeline regression)."""
     from predictionio_tpu.data.storage import set_storage
+    from predictionio_tpu.ops.als import ALSTrainer, LayoutCacheMiss
     from predictionio_tpu.parallel.compile_cache import enable_persistent_cache
+    from predictionio_tpu.templates.recommendation import (
+        RecoDataSource,
+        RecoDataSourceParams,
+    )
 
     enable_persistent_cache()
-    n_users, n_items, n_ratings, _, _ = knobs()
-    sample = min(100_000, n_ratings)
+    n_users, n_items, n_ratings, _, iterations = knobs()
     _storage(base_dir)
     detail = {}
-    _read_prepare_bin_train(detail, n_ratings + sample)
+    fp = RecoDataSource(
+        RecoDataSourceParams(app_name="bench")).data_fingerprint()
+    trainer = None
+    if fp is not None:
+        try:
+            t0 = time.perf_counter()
+            trainer = ALSTrainer(None, None, None, _bench_cfg(),
+                                 cache_key=fp + _HOLD_TAG)
+            detail["bin_sec"] = round(time.perf_counter() - t0, 2)
+            detail["read_sec"] = 0.0    # skipped: layout cache hit on
+            detail["prepare_sec"] = 0.0  # the unchanged-data fingerprint
+            detail["bin_cache_hit"] = True
+            detail["transfer_note"] = (
+                "re-paid: device memory does not survive the process; "
+                "the compressed layout's bytes cross the tunnel again")
+        except LayoutCacheMiss:
+            trainer = None
+    if trainer is not None:
+        n_read = n_ratings  # what the skipped read would have returned
+        _transfer_and_compile(detail, trainer, iterations, n_read)
+    else:
+        detail["bin_cache_hit"] = False
+        _read_prepare_bin_train(detail, n_ratings)
     set_storage(None)
     with open(out_path, "w") as f:
         json.dump(detail, f)
@@ -453,6 +563,7 @@ def orchestrate():
     base_dir = tempfile.mkdtemp(prefix="pio_bench_")
     env = dict(os.environ)
     env["PIO_COMPILE_CACHE_DIR"] = os.path.join(base_dir, "compile_cache")
+    env["PIO_BIN_CACHE_DIR"] = os.path.join(base_dir, "bin_cache")
     try:
         stages = {}
         for stage in ("cold", "warm"):
@@ -472,7 +583,8 @@ def orchestrate():
         detail = stages["cold"]
         detail["warm"] = stages["warm"]
         gates = (detail["rmse_gate_passed"] and detail["rmse_band_passed"]
-                 and detail["serve_gate_passed"])
+                 and detail["serve_gate_passed"]
+                 and detail["row_lane_gate_passed"])
         value = detail.pop("updates_per_sec") if gates else 0.0
         detail["baseline_proxy"] = {
             "value": 1e6,

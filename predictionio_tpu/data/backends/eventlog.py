@@ -102,6 +102,16 @@ def _load():
         ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),  # tgt dict offsets
         ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),  # name dict offsets
     ]
+    u8pp = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))
+    lib.el_append_json.restype = ctypes.c_int64
+    lib.el_append_json.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_int64, ctypes.c_int32,
+        u8pp, u8pp,
+        u8pp, ctypes.POINTER(ctypes.c_uint64),
+        u8pp, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
     lib.el_append_columnar.restype = ctypes.c_int64
     lib.el_append_columnar.argtypes = [
         ctypes.c_void_p, ctypes.c_int64,
@@ -113,6 +123,9 @@ def _load():
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
         ctypes.POINTER(ctypes.c_double), ctypes.c_char_p,
     ]
+    lib.el_fingerprint.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_uint64)]
+    lib.el_fingerprint.restype = None
     lib.el_free.argtypes = [ctypes.c_void_p]
     return lib
 
@@ -254,6 +267,37 @@ def _unpack_records(buf: bytes) -> List[Event]:
     return events
 
 
+class JsonRowsUnsupported(Exception):
+    """The JSON payload uses a construct the native fast lane does not
+    handle (caller-stamped ids, exotic time formats, escaped property
+    keys, non-object properties, …) — the caller falls back to the
+    per-row Python path, which accepts everything."""
+
+
+#: native RowErr codes -> the validate_event / from_dict message shapes
+#: (data/event.py) — kept in lockstep with enum RowErr in eventlog.cpp
+_ROW_ERRORS = {
+    1: "field event is required",
+    2: "field entityType is required",
+    3: "field entityId is required",
+    4: "event must not be empty.",
+    5: "entityType must not be empty string.",
+    6: "entityId must not be empty string.",
+    7: "targetEntityType and targetEntityId must be specified together.",
+    8: "targetEntityType must not be empty string.",
+    9: "targetEntityId must not be empty string.",
+    10: "properties cannot be empty for $unset event",
+    11: "reserved event names must be one of $set/$unset/$delete.",
+    12: "Reserved events cannot have targetEntity.",
+    13: "The entityType is not allowed. 'pio_' is a reserved name prefix.",
+    14: "The targetEntityType is not allowed. 'pio_' is a reserved name prefix.",
+    15: "The property is not allowed. 'pio_' is a reserved name prefix.",
+    16: "Invalid time string.",
+    17: "event must be a JSON object",
+    18: "a string field exceeds the 65534-byte wire-format limit",
+}
+
+
 # ---------------------------------------------------------------------------
 # EventStore over the native log
 # ---------------------------------------------------------------------------
@@ -325,6 +369,74 @@ class EventLogEventStore(S.EventStore):
         if n != len(events):
             raise S.StorageError(f"append failed ({n} of {len(events)} written)")
         return out_ids
+
+    def insert_json_batch(
+        self,
+        raw: bytes,
+        app_id,
+        channel_id=None,
+        *,
+        strict: bool = True,
+    ):
+        """The native live lane (VERDICT r3 item 3): the API-format JSON
+        array the event server receives goes straight to C++ — parse,
+        EventValidation, wire-record packing and the append happen in
+        one call with the GIL released; no per-row Python objects exist
+        anywhere (the role of EventAPI's request pipeline,
+        data/.../api/EventAPI.scala:209).
+
+        Returns ``(ids, codes, names, entity_types)`` — per row: the
+        event id hex (None for a failed row), the validation code (0 =
+        appended; _ROW_ERRORS maps the rest), the event name and entity
+        type (stats + whitelist checks). ``strict=True`` (the DAO bulk
+        contract) raises on the first invalid row with NOTHING appended;
+        ``strict=False`` (the batch API route) appends the valid rows
+        and reports the rest. Raises JsonRowsUnsupported when the
+        payload needs the Python path."""
+        h = self._handle(app_id, channel_id)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        out_ids, out_codes, out_names, out_et = u8p(), u8p(), u8p(), u8p()
+        names_b, et_b = ctypes.c_uint64(), ctypes.c_uint64()
+        out_n = ctypes.c_int64()
+        now_us = _us(_dt.datetime.now(tz=UTC))
+        rc = self._lib.el_append_json(
+            h, raw, len(raw), now_us, 0 if not strict else 1,
+            ctypes.byref(out_ids), ctypes.byref(out_codes),
+            ctypes.byref(out_names), ctypes.byref(names_b),
+            ctypes.byref(out_et), ctypes.byref(et_b),
+            ctypes.byref(out_n),
+        )
+        try:
+            if rc == -2:
+                raise JsonRowsUnsupported()
+            if rc == -3:
+                raise S.StorageError("malformed JSON event array")
+            if rc == -4:
+                n = out_n.value
+                code = ctypes.string_at(out_codes, n)[-1] if out_codes else 0
+                raise S.StorageError(
+                    f"event {n - 1}: "
+                    f"{_ROW_ERRORS.get(code, f'validation error {code}')}"
+                )
+            if rc < 0:
+                raise S.StorageError("append failed in native event log")
+            n = out_n.value
+            ids_raw = ctypes.string_at(out_ids, 16 * n) if n else b""
+            codes = list(ctypes.string_at(out_codes, n)) if n else []
+            names = (ctypes.string_at(out_names, names_b.value)
+                     .decode("utf-8").split("\0")[:-1] if n else [])
+            etypes = (ctypes.string_at(out_et, et_b.value)
+                      .decode("utf-8").split("\0")[:-1] if n else [])
+        finally:
+            for p in (out_ids, out_codes, out_names, out_et):
+                if p:
+                    self._lib.el_free(p)
+        hex_all = ids_raw.hex()
+        ids = [
+            hex_all[32 * i:32 * i + 32] if codes[i] == 0 else None
+            for i in range(n)
+        ]
+        return ids, codes, names, etypes
 
     def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
         h = self._handle(app_id, channel_id)
@@ -585,6 +697,17 @@ class EventLogEventStore(S.EventStore):
                 )
             total += m
         return total
+
+    def data_fingerprint(self, app_id, channel_id=None) -> str:
+        """O(1) content fingerprint (generation, bytes, records,
+        tombstones) — changes whenever the app's event data does.
+        The binned-layout cache keys on it so retraining on unchanged
+        events skips the 20M-row re-read (VERDICT r3 item 2). Backends
+        without a cheap fingerprint simply lack this method."""
+        h = self._handle(app_id, channel_id)
+        out = (ctypes.c_uint64 * 4)()
+        self._lib.el_fingerprint(h, out)
+        return f"g{out[0]}-b{out[1]}-n{out[2]}-t{out[3]}"
 
     def compact(self, app_id, channel_id=None) -> Dict[str, int]:
         """Rewrite the log keeping only live records: reclaims the space

@@ -1628,6 +1628,25 @@ class RestStorageClient(S.StorageClient):
                 return enumerate_fn(proxies[endpoint])
 
             truth = {key_of(r): r for r in records_of(0)}
+            if not truth:
+                # empty-owner guard (code-review regression): a
+                # re-provisioned BLANK owner must never erase the
+                # surviving replicas' records under the banner of
+                # "repair" — that is exactly the outage replication
+                # exists to survive
+                for endpoint in range(1, len(metas)):
+                    n_replica = len(records_of(endpoint))
+                    if n_replica:
+                        raise S.StorageError(
+                            f"metadata repair refused: owner "
+                            f"{metas[0].base_url} has no {repo_name} "
+                            f"records while replica "
+                            f"{metas[endpoint].base_url} holds "
+                            f"{n_replica} — a blank (re-provisioned?) "
+                            "owner would delete them all; seed the "
+                            "owner from a replica or remove the stale "
+                            "replica data first")
+                continue
             truth_dicts = {k: MD.record_to_dict(r) for k, r in truth.items()}
             for endpoint in range(1, len(metas)):
                 have = {key_of(r): r for r in records_of(endpoint)}
@@ -1648,6 +1667,18 @@ class RestStorageClient(S.StorageClient):
         # model blobs: sha256 inventory diff, owner-authoritative
         model_proxies = [RestModelsRepo(t) for t in metas]
         truth_inv = {m["id"]: m for m in model_proxies[0].list()}
+        if not truth_inv:
+            # same empty-owner guard as the record repos above
+            for endpoint in range(1, len(metas)):
+                n_replica = len(model_proxies[endpoint].list())
+                if n_replica:
+                    raise S.StorageError(
+                        f"metadata repair refused: owner "
+                        f"{metas[0].base_url} has no model blobs while "
+                        f"replica {metas[endpoint].base_url} holds "
+                        f"{n_replica} — seed the owner from a replica "
+                        "or remove the stale replica data first")
+            return {"copied": copied, "deleted": deleted}
         for endpoint in range(1, len(metas)):
             have_inv = {m["id"]: m for m in model_proxies[endpoint].list()}
             for mid, info in truth_inv.items():

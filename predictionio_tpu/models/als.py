@@ -37,6 +37,9 @@ class PreparedRatings(SanityCheck):
     user_idx: np.ndarray     # [nnz] int
     item_idx: np.ndarray     # [nnz] int
     ratings: np.ndarray      # [nnz] float32
+    #: data+derivation fingerprint from the DataSource (None when the
+    #: backend has no cheap one) — keys the binned-layout cache
+    fingerprint: Optional[str] = None
 
     @property
     def n_users(self) -> int:
@@ -177,6 +180,8 @@ class ALSAlgorithm(Algorithm):
             mesh=ctx.mesh,
             max_ratings_per_user=p.max_ratings_per_user,
             max_ratings_per_item=p.max_ratings_per_item,
+            # retrain-on-unchanged-events skips re-binning (ops.bincache)
+            cache_key=pd.fingerprint,
         )
         return ALSModel(factors, pd.user_ids, pd.item_ids)
 

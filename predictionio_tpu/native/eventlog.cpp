@@ -973,6 +973,758 @@ int64_t el_append_batch(void* h, const uint8_t* buf, uint64_t nbytes,
   return append_packed(log, buf, nbytes, n, fresh_ids != 0);
 }
 
+// ---------------------------------------------------------------------------
+// JSON row ingest — the live event-server lane without per-row Python
+// objects (the role of EventAPI's request pipeline,
+// data/.../api/EventAPI.scala:209, rebuilt as a native batch encoder:
+// one call parses the API-format JSON array, validates each row by the
+// EventValidation contract (Event.scala:69-116), packs wire records and
+// appends them under one lock + one fsync, with the GIL released).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// per-row validation error codes; messages live in the Python binding
+// and mirror data/event.py validate_event
+enum RowErr : uint8_t {
+  kRowOk = 0,
+  kMissingEvent = 1,
+  kMissingEntityType = 2,
+  kMissingEntityId = 3,
+  kEmptyEvent = 4,
+  kEmptyEntityType = 5,
+  kEmptyEntityId = 6,
+  kTargetTogether = 7,
+  kEmptyTargetType = 8,
+  kEmptyTargetId = 9,
+  kUnsetNeedsProps = 10,
+  kReservedEventName = 11,
+  kSpecialHasTarget = 12,
+  kReservedEntityType = 13,
+  kReservedTargetType = 14,
+  kReservedPropertyKey = 15,
+  kBadTime = 16,
+  kRowNotObject = 17,
+  kTooLong = 18,  // a string field exceeds the u16 wire limit
+};
+
+struct JsonCur {
+  const char* p;
+  const char* end;
+  bool ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p;
+    return p < end;
+  }
+  bool lit(char c) {
+    if (!ws() || *p != c) return false;
+    ++p;
+    return true;
+  }
+  char peek() { return ws() ? *p : '\0'; }
+};
+
+// raw contents between the quotes (escapes untouched); cursor must be AT
+// the opening quote
+bool scan_quoted(JsonCur& c, std::string_view* out, bool* has_escape) {
+  if (c.p >= c.end || *c.p != '"') return false;
+  ++c.p;
+  const char* s = c.p;
+  *has_escape = false;
+  while (c.p < c.end) {
+    char ch = *c.p;
+    if (ch == '"') {
+      *out = std::string_view(s, static_cast<size_t>(c.p - s));
+      ++c.p;
+      return true;
+    }
+    if (ch == '\\') {
+      *has_escape = true;
+      c.p += 2;
+      continue;
+    }
+    ++c.p;
+  }
+  return false;
+}
+
+int hex_nibble(char c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+  return -1;
+}
+
+// resolve JSON escapes (incl. \uXXXX with surrogate pairs) to UTF-8
+bool unescape(std::string_view raw, std::string* out) {
+  out->clear();
+  out->reserve(raw.size());
+  for (size_t i = 0; i < raw.size();) {
+    char ch = raw[i];
+    if (ch != '\\') {
+      out->push_back(ch);
+      ++i;
+      continue;
+    }
+    if (i + 1 >= raw.size()) return false;
+    char e = raw[i + 1];
+    i += 2;
+    switch (e) {
+      case '"': out->push_back('"'); break;
+      case '\\': out->push_back('\\'); break;
+      case '/': out->push_back('/'); break;
+      case 'b': out->push_back('\b'); break;
+      case 'f': out->push_back('\f'); break;
+      case 'n': out->push_back('\n'); break;
+      case 'r': out->push_back('\r'); break;
+      case 't': out->push_back('\t'); break;
+      case 'u': {
+        if (i + 4 > raw.size()) return false;
+        uint32_t cp = 0;
+        for (int k = 0; k < 4; ++k) {
+          int v = hex_nibble(raw[i + k]);
+          if (v < 0) return false;
+          cp = cp * 16 + static_cast<uint32_t>(v);
+        }
+        i += 4;
+        if (cp >= 0xD800 && cp <= 0xDBFF) {  // high surrogate
+          if (i + 6 > raw.size() || raw[i] != '\\' || raw[i + 1] != 'u')
+            return false;
+          uint32_t lo = 0;
+          for (int k = 0; k < 4; ++k) {
+            int v = hex_nibble(raw[i + 2 + k]);
+            if (v < 0) return false;
+            lo = lo * 16 + static_cast<uint32_t>(v);
+          }
+          if (lo < 0xDC00 || lo > 0xDFFF) return false;
+          cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+          i += 6;
+        } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+          return false;  // lone low surrogate
+        }
+        if (cp < 0x80) {
+          out->push_back(static_cast<char>(cp));
+        } else if (cp < 0x800) {
+          out->push_back(static_cast<char>(0xC0 | (cp >> 6)));
+          out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+        } else if (cp < 0x10000) {
+          out->push_back(static_cast<char>(0xE0 | (cp >> 12)));
+          out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+          out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+        } else {
+          out->push_back(static_cast<char>(0xF0 | (cp >> 18)));
+          out->push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+          out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+          out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+        }
+        break;
+      }
+      default:
+        return false;
+    }
+  }
+  return true;
+}
+
+bool get_string(JsonCur& c, std::string* out) {
+  std::string_view raw;
+  bool esc;
+  if (!c.ws() || !scan_quoted(c, &raw, &esc)) return false;
+  if (!esc) {
+    out->assign(raw.data(), raw.size());
+    return true;
+  }
+  return unescape(raw, out);
+}
+
+// skip (and optionally capture the raw slice of) any JSON value
+bool skip_value(JsonCur& c, std::string_view* raw_out) {
+  if (!c.ws()) return false;
+  const char* s = c.p;
+  char ch = *c.p;
+  if (ch == '"') {
+    std::string_view sv;
+    bool e;
+    if (!scan_quoted(c, &sv, &e)) return false;
+  } else if (ch == '{' || ch == '[') {
+    // joint depth over both container kinds: for well-formed JSON the
+    // matching close is where the joint depth returns to zero, and the
+    // caller only ever appends after the WHOLE body parsed cleanly, so
+    // a malformed slice can never be stored
+    int depth = 0;
+    while (c.p < c.end) {
+      char d = *c.p;
+      if (d == '"') {
+        std::string_view sv;
+        bool e;
+        if (!scan_quoted(c, &sv, &e)) return false;
+        continue;
+      }
+      if (d == '{' || d == '[') {
+        ++depth;
+        ++c.p;
+        continue;
+      }
+      if (d == '}' || d == ']') {
+        --depth;
+        ++c.p;
+        if (depth == 0) break;
+        continue;
+      }
+      ++c.p;
+    }
+    if (depth != 0) return false;
+  } else {
+    // number / true / false / null
+    while (c.p < c.end && *c.p != ',' && *c.p != '}' && *c.p != ']' &&
+           *c.p != ' ' && *c.p != '\t' && *c.p != '\n' && *c.p != '\r')
+      ++c.p;
+    if (c.p == s) return false;
+  }
+  if (raw_out) *raw_out = std::string_view(s, static_cast<size_t>(c.p - s));
+  return true;
+}
+
+// days-from-civil (public-domain Hinnant algorithm) for ISO parsing
+int64_t days_from_civil(int64_t y, unsigned m, unsigned d) {
+  y -= m <= 2;
+  const int64_t era = (y >= 0 ? y : y - 399) / 400;
+  const unsigned yoe = static_cast<unsigned>(y - era * 400);
+  const unsigned doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+  const unsigned doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+  return era * 146097 + static_cast<int64_t>(doe) - 719468;
+}
+
+bool two_digits(std::string_view s, size_t at, unsigned* out) {
+  if (at + 2 > s.size() || s[at] < '0' || s[at] > '9' || s[at + 1] < '0' ||
+      s[at + 1] > '9')
+    return false;
+  *out = static_cast<unsigned>((s[at] - '0') * 10 + (s[at + 1] - '0'));
+  return true;
+}
+
+// Parse the dashed ISO-8601 subset the API contract uses:
+//   YYYY-MM-DD([T ]HH:MM(:SS(.ffffff)?)?)?(Z|±HH(:)?MM)?
+// Returns 0 ok, 1 invalid (Python's parser would reject it too),
+// 2 unsupported shape (fall back to the Python path, which accepts
+// more ISO variants than this fast lane).
+int parse_iso_us(std::string_view s, int64_t* out_us, int64_t* offset_us) {
+  *offset_us = 0;
+  if (s.size() < 10) return 2;
+  for (int k : {0, 1, 2, 3})
+    if (s[k] < '0' || s[k] > '9') return 2;
+  if (s[4] != '-' || s[7] != '-') return 2;
+  unsigned month, day;
+  int64_t year = (s[0] - '0') * 1000 + (s[1] - '0') * 100 + (s[2] - '0') * 10 +
+                 (s[3] - '0');
+  if (!two_digits(s, 5, &month) || !two_digits(s, 8, &day)) return 2;
+  if (month < 1 || month > 12 || day < 1) return 1;
+  static const unsigned kDays[12] = {31, 28, 31, 30, 31, 30,
+                                     31, 31, 30, 31, 30, 31};
+  unsigned dmax = kDays[month - 1];
+  if (month == 2 && (year % 4 == 0 && (year % 100 != 0 || year % 400 == 0)))
+    dmax = 29;
+  if (day > dmax) return 1;  // fromisoformat rejects impossible dates too
+  size_t i = 10;
+  unsigned hh = 0, mm = 0, ss = 0;
+  int64_t frac_us = 0;
+  if (i < s.size() && (s[i] == 'T' || s[i] == ' ')) {
+    ++i;
+    if (!two_digits(s, i, &hh)) return 2;
+    i += 2;
+    if (i >= s.size() || s[i] != ':') return 2;
+    ++i;
+    if (!two_digits(s, i, &mm)) return 2;
+    i += 2;
+    if (i < s.size() && s[i] == ':') {
+      ++i;
+      if (!two_digits(s, i, &ss)) return 2;
+      i += 2;
+      if (i < s.size() && s[i] == '.') {
+        ++i;
+        size_t fs = i;
+        int64_t v = 0;
+        while (i < s.size() && s[i] >= '0' && s[i] <= '9') {
+          if (i - fs < 6) v = v * 10 + (s[i] - '0');
+          ++i;
+        }
+        size_t ndig = i - fs;
+        if (ndig == 0 || ndig > 6) return 1;  // fromisoformat rejects too
+        for (size_t k = ndig; k < 6; ++k) v *= 10;
+        frac_us = v;
+      }
+    }
+    if (hh > 23 || mm > 59 || ss > 59) return 1;
+  }
+  if (i < s.size()) {  // timezone designator
+    char z = s[i];
+    if (z == 'Z') {
+      ++i;
+    } else if (z == '+' || z == '-') {
+      ++i;
+      unsigned oh, om = 0;
+      if (!two_digits(s, i, &oh)) return 2;
+      i += 2;
+      if (i < s.size() && s[i] == ':') ++i;
+      if (i < s.size()) {
+        if (!two_digits(s, i, &om)) return 2;
+        i += 2;
+      }
+      if (oh > 23 || om > 59) return 1;
+      int64_t off = (static_cast<int64_t>(oh) * 60 + om) * 60 * 1000000LL;
+      *offset_us = (z == '-') ? -off : off;
+    } else {
+      return 2;
+    }
+  }
+  if (i != s.size()) return 2;
+  int64_t days = days_from_civil(year, month, day);
+  int64_t local_us = days * 86400000000LL +
+                     (static_cast<int64_t>(hh) * 3600 + mm * 60 + ss) *
+                         1000000LL +
+                     frac_us;
+  *out_us = local_us - *offset_us;
+  return 0;
+}
+
+bool reserved_prefix(std::string_view s) {
+  return (!s.empty() && s[0] == '$') ||
+         (s.size() >= 4 && s.compare(0, 4, "pio_") == 0);
+}
+
+bool is_special_event(std::string_view s) {
+  return s == "$set" || s == "$unset" || s == "$delete";
+}
+
+// one parsed row (string storage owned by the caller-scoped strings)
+struct JsonRow {
+  std::string event, etype, eid, ttype, tid;
+  bool has_ttype = false, has_tid = false;
+  std::string_view props_raw;   // raw {...} slice, empty = absent
+  bool props_empty = true;
+  bool props_reserved_key = false;
+  uint8_t err = 0;              // deferred mid-parse row error (kBadTime)
+  std::string_view time_raw;    // raw quoted eventTime value (with quotes)
+  std::string_view ctime_raw;
+  std::string_view tags_raw;    // raw [...] slice
+  std::string_view prid_raw;    // raw quoted prId
+  int64_t t_us = 0, c_us = 0;
+  int64_t t_off_us = 0, c_off_us = 0;
+  bool has_time = false, has_ctime = false;
+};
+
+// parse one event object; returns 0 ok, -2 unsupported, or a RowErr > 0
+// (the row is skipped but parsing continues at the object end)
+int parse_row(JsonCur& c, JsonRow* row) {
+  if (c.peek() != '{') return kRowNotObject;
+  ++c.p;
+  bool first = true;
+  bool saw_event = false, saw_etype = false, saw_eid = false;
+  while (true) {
+    if (!c.ws()) return -2;
+    if (*c.p == '}') {
+      ++c.p;
+      break;
+    }
+    if (!first && *c.p == ',') {
+      ++c.p;
+      if (!c.ws()) return -2;
+    }
+    first = false;
+    std::string key;
+    if (!get_string(c, &key)) return -2;
+    if (!c.lit(':')) return -2;
+    if (key == "event") {
+      if (!get_string(c, &row->event)) return -2;
+      saw_event = true;
+    } else if (key == "entityType") {
+      if (!get_string(c, &row->etype)) return -2;
+      saw_etype = true;
+    } else if (key == "entityId") {
+      if (!get_string(c, &row->eid)) return -2;
+      saw_eid = true;
+    } else if (key == "targetEntityType") {
+      if (c.peek() == 'n') {  // null -> absent (from_dict d.get semantics)
+        if (!skip_value(c, nullptr)) return -2;
+      } else {
+        if (!get_string(c, &row->ttype)) return -2;
+        row->has_ttype = true;
+      }
+    } else if (key == "targetEntityId") {
+      if (c.peek() == 'n') {
+        if (!skip_value(c, nullptr)) return -2;
+      } else {
+        if (!get_string(c, &row->tid)) return -2;
+        row->has_tid = true;
+      }
+    } else if (key == "properties") {
+      char pk = c.peek();
+      if (pk == 'n') {
+        if (!skip_value(c, nullptr)) return -2;  // null -> absent
+      } else if (pk != '{') {
+        return -2;  // non-object properties: let Python shape the error
+      } else {
+        // walk the top level: reserved-prefix key check + emptiness,
+        // then keep the raw slice verbatim (no re-serialization)
+        const char* start = c.p;
+        ++c.p;
+        bool pfirst = true;
+        while (true) {
+          if (!c.ws()) return -2;
+          if (*c.p == '}') {
+            ++c.p;
+            break;
+          }
+          if (!pfirst && *c.p == ',') {
+            ++c.p;
+            if (!c.ws()) return -2;
+          }
+          pfirst = false;
+          std::string_view kraw;
+          bool kesc;
+          if (!scan_quoted(c, &kraw, &kesc)) return -2;
+          if (kesc) return -2;  // escaped key could hide a prefix: fallback
+          if (reserved_prefix(kraw)) row->props_reserved_key = true;
+          row->props_empty = false;
+          if (!c.lit(':')) return -2;
+          if (!skip_value(c, nullptr)) return -2;
+        }
+        row->props_raw =
+            std::string_view(start, static_cast<size_t>(c.p - start));
+      }
+    } else if (key == "eventTime" || key == "creationTime") {
+      if (!c.ws()) return -2;
+      std::string_view raw;
+      bool is_ctime = key[0] == 'c';
+      if (*c.p == '"') {
+        std::string_view sv;
+        bool esc;
+        const char* start = c.p;
+        if (!scan_quoted(c, &sv, &esc)) return -2;
+        if (esc) return -2;
+        raw = std::string_view(start, static_cast<size_t>(c.p - start));
+        int64_t us, off;
+        int rc = parse_iso_us(sv, &us, &off);
+        if (rc == 2) return -2;
+        if (rc == 1) {
+          // deferred: the object must still be consumed to its end so
+          // the array parse stays in sync for the rows after this one
+          row->err = kBadTime;
+          us = 0;
+          off = 0;
+        }
+        if (is_ctime) {
+          row->c_us = us;
+          row->c_off_us = off;
+          row->ctime_raw = raw;
+          row->has_ctime = true;
+        } else {
+          row->t_us = us;
+          row->t_off_us = off;
+          row->time_raw = raw;
+          row->has_time = true;
+        }
+      } else {
+        // epoch millis (int or float), the SDKs' alternative form
+        std::string_view num;
+        if (!skip_value(c, &num)) return -2;
+        char tmp[64];
+        if (num.size() >= sizeof(tmp)) return -2;
+        memcpy(tmp, num.data(), num.size());
+        tmp[num.size()] = 0;
+        char* endp = nullptr;
+        double ms = strtod(tmp, &endp);
+        if (endp != tmp + num.size()) return -2;
+        int64_t us = static_cast<int64_t>(ms * 1000.0);
+        if (is_ctime) {
+          row->c_us = us;
+          row->has_ctime = true;
+        } else {
+          row->t_us = us;
+          row->has_time = true;
+        }
+      }
+    } else if (key == "tags") {
+      if (c.peek() == 'n') {
+        if (!skip_value(c, nullptr)) return -2;
+      } else {
+        if (c.peek() != '[') return -2;
+        if (!skip_value(c, &row->tags_raw)) return -2;
+        if (row->tags_raw == "[]") row->tags_raw = {};
+      }
+    } else if (key == "prId") {
+      if (c.peek() == 'n') {
+        if (!skip_value(c, nullptr)) return -2;
+      } else {
+        if (c.peek() != '"') return -2;
+        if (!skip_value(c, &row->prid_raw)) return -2;
+      }
+    } else if (key == "eventId") {
+      // a caller-stamped id breaks the fresh-ids lazy-index invariant:
+      // that lane (replicated writes) stays on the Python path
+      if (c.peek() == 'n') {
+        if (!skip_value(c, nullptr)) return -2;
+      } else {
+        return -2;
+      }
+    } else {
+      if (!skip_value(c, nullptr)) return -2;  // unknown keys ignored
+    }
+  }
+  if (!saw_event) return kMissingEvent;
+  if (!saw_etype) return kMissingEntityType;
+  if (!saw_eid) return kMissingEntityId;
+  // the binding returns event names / entity types as NUL-joined
+  // buffers: an embedded \u0000 would misalign every later row, so
+  // that (pathological) shape goes to the Python path
+  if (row->event.find('\0') != std::string::npos ||
+      row->etype.find('\0') != std::string::npos)
+    return -2;
+  return row->err;
+}
+
+// the EventValidation contract (Event.scala:69-116 / data/event.py)
+uint8_t validate_row(const JsonRow& r) {
+  if (r.event.empty()) return kEmptyEvent;
+  if (r.etype.empty()) return kEmptyEntityType;
+  if (r.eid.empty()) return kEmptyEntityId;
+  if (r.has_ttype != r.has_tid) return kTargetTogether;
+  if (r.has_ttype && r.ttype.empty()) return kEmptyTargetType;
+  if (r.has_tid && r.tid.empty()) return kEmptyTargetId;
+  if (r.event == "$unset" && r.props_empty) return kUnsetNeedsProps;
+  if (reserved_prefix(r.event) && !is_special_event(r.event))
+    return kReservedEventName;
+  if (is_special_event(r.event) && r.has_tid) return kSpecialHasTarget;
+  if (reserved_prefix(r.etype) && r.etype != "pio_pr")
+    return kReservedEntityType;
+  if (r.has_ttype && reserved_prefix(r.ttype) && r.ttype != "pio_pr")
+    return kReservedTargetType;
+  if (r.props_reserved_key) return kReservedPropertyKey;
+  if (r.event.size() >= kAbsent || r.etype.size() >= kAbsent ||
+      r.eid.size() >= kAbsent || r.ttype.size() >= kAbsent ||
+      r.tid.size() >= kAbsent)
+    return kTooLong;
+  return kRowOk;
+}
+
+// strict UTF-8 validation (DFA-free scalar scan): the Python lane's
+// json.loads refuses invalid UTF-8, and anything appended here must
+// decode again on the read path
+bool valid_utf8(const uint8_t* p, uint64_t n) {
+  uint64_t i = 0;
+  while (i < n) {
+    uint8_t c = p[i];
+    if (c < 0x80) { ++i; continue; }
+    int extra;
+    uint32_t cp;
+    if ((c & 0xE0) == 0xC0) { extra = 1; cp = c & 0x1F; }
+    else if ((c & 0xF0) == 0xE0) { extra = 2; cp = c & 0x0F; }
+    else if ((c & 0xF8) == 0xF0) { extra = 3; cp = c & 0x07; }
+    else return false;
+    if (i + extra >= n) return false;
+    for (int k = 1; k <= extra; ++k) {
+      if ((p[i + k] & 0xC0) != 0x80) return false;
+      cp = (cp << 6) | (p[i + k] & 0x3F);
+    }
+    if (extra == 1 && cp < 0x80) return false;          // overlong
+    if (extra == 2 && cp < 0x800) return false;
+    if (extra == 3 && cp < 0x10000) return false;
+    if (cp > 0x10FFFF || (cp >= 0xD800 && cp <= 0xDFFF)) return false;
+    i += 1 + extra;
+  }
+  return true;
+}
+
+}  // namespace
+
+// Native live-lane ingest: one call takes the API-format JSON array the
+// event server receives, validates, packs and appends — no per-row
+// Python work. Returns rows APPENDED (valid rows), with *out_n = total
+// rows parsed; or -2 (unsupported construct anywhere: caller falls back
+// to the Python path), -3 (malformed JSON), -4 (strict mode and some
+// row failed validation: NOTHING appended; first bad row's code in
+// *out_n's row slot... see binding), -1 (I/O error). Outputs (malloc'd,
+// el_free): ids = n*16 raw bytes (zeroed for failed rows), codes = n
+// RowErr bytes, names/etypes = NUL-joined per-row event names and
+// entity types (for stats + whitelists).
+int64_t el_append_json(void* h, const uint8_t* body, uint64_t nbytes,
+                       int64_t now_us, int32_t strict,
+                       uint8_t** out_ids, uint8_t** out_codes,
+                       uint8_t** out_names, uint64_t* out_names_bytes,
+                       uint8_t** out_etypes, uint64_t* out_etypes_bytes,
+                       int64_t* out_n) {
+  Log* log = static_cast<Log*>(h);
+  *out_ids = nullptr;
+  *out_codes = nullptr;
+  *out_names = nullptr;
+  *out_etypes = nullptr;
+  *out_n = 0;
+  if (!valid_utf8(body, nbytes)) return -3;  // json.loads parity
+  JsonCur c{reinterpret_cast<const char*>(body),
+            reinterpret_cast<const char*>(body) + nbytes};
+  if (!c.lit('[')) return -3;
+
+  std::mt19937_64 rng(std::random_device{}() ^
+                      static_cast<uint64_t>(now_us) ^
+                      reinterpret_cast<uintptr_t>(h));
+  std::vector<uint8_t> buf;
+  buf.reserve(nbytes + (nbytes >> 2));
+  std::vector<uint8_t> ids;
+  std::vector<uint8_t> codes;
+  std::string names_join, etypes_join;
+  int64_t n_valid = 0;
+
+  bool first = true;
+  while (true) {
+    if (!c.ws()) return -3;
+    if (*c.p == ']') {
+      ++c.p;
+      break;
+    }
+    if (!first) {
+      if (*c.p != ',') return -3;
+      ++c.p;
+    }
+    first = false;
+    if (c.peek() != '{') {
+      // non-object element: a per-row 400 like the Python path's
+      // "event must be a JSON object", never a whole-batch failure
+      if (!skip_value(c, nullptr)) return -3;
+      codes.push_back(kRowNotObject);
+      names_join.push_back('\0');
+      etypes_join.push_back('\0');
+      if (strict) {
+        *out_n = static_cast<int64_t>(codes.size());
+        uint8_t* cd = static_cast<uint8_t*>(malloc(codes.size()));
+        if (cd) memcpy(cd, codes.data(), codes.size());
+        *out_codes = cd;
+        return -4;
+      }
+      ids.insert(ids.end(), 16, 0);
+      continue;
+    }
+    JsonRow row;
+    int rc = parse_row(c, &row);
+    if (rc == -2) return -2;
+    uint8_t code = rc > 0 ? static_cast<uint8_t>(rc) : validate_row(row);
+    codes.push_back(code);
+    names_join += row.event;
+    names_join.push_back('\0');
+    etypes_join += row.etype;
+    etypes_join.push_back('\0');
+    if (code != kRowOk) {
+      if (strict) {
+        *out_n = static_cast<int64_t>(codes.size());
+        // surface the code via the codes buffer in strict mode too
+        uint8_t* cd = static_cast<uint8_t*>(malloc(codes.size()));
+        if (cd) memcpy(cd, codes.data(), codes.size());
+        *out_codes = cd;
+        return -4;
+      }
+      ids.insert(ids.end(), 16, 0);
+      continue;
+    }
+    // pack the wire record (format documented at the top of this file)
+    std::string extra;
+    {
+      auto add = [&extra](const char* k, std::string_view raw) {
+        extra += extra.empty() ? "{" : ",";
+        extra += '"';
+        extra += k;
+        extra += "\":";
+        extra.append(raw.data(), raw.size());
+      };
+      if (row.has_time && row.t_off_us != 0) add("et", row.time_raw);
+      if (row.has_ctime && row.c_off_us != 0) add("ct", row.ctime_raw);
+      if (!row.props_raw.empty()) add("p", row.props_raw);
+      if (!row.tags_raw.empty()) add("t", row.tags_raw);
+      if (!row.prid_raw.empty()) add("pr", row.prid_raw);
+      if (!extra.empty()) extra += '}';
+    }
+    int64_t t_us = row.has_time ? row.t_us : now_us;
+    int64_t c_us = row.has_ctime ? row.c_us : now_us;
+    uint32_t l_ev = static_cast<uint32_t>(row.event.size());
+    uint32_t l_et = static_cast<uint32_t>(row.etype.size());
+    uint32_t l_ei = static_cast<uint32_t>(row.eid.size());
+    uint32_t l_tt = row.has_ttype ? static_cast<uint32_t>(row.ttype.size()) : 0;
+    uint32_t l_ti = row.has_tid ? static_cast<uint32_t>(row.tid.size()) : 0;
+    uint32_t l_ex = static_cast<uint32_t>(extra.size());
+    uint32_t rec_len = kHeaderLen + l_ev + l_et + l_ei + l_tt + l_ti + l_ex;
+    size_t base = buf.size();
+    buf.resize(base + 4 + rec_len);
+    uint8_t* p = buf.data() + base;
+    memcpy(p, &rec_len, 4);
+    p += 4;
+    uint64_t id_hi = rng(), id_lo = rng();
+    memcpy(p, &id_hi, 8);
+    memcpy(p + 8, &id_lo, 8);
+    ids.insert(ids.end(), p, p + 16);
+    memcpy(p + 16, &t_us, 8);
+    memcpy(p + 24, &c_us, 8);
+    uint16_t u16;
+    u16 = static_cast<uint16_t>(l_ev); memcpy(p + 32, &u16, 2);
+    u16 = static_cast<uint16_t>(l_et); memcpy(p + 34, &u16, 2);
+    u16 = static_cast<uint16_t>(l_ei); memcpy(p + 36, &u16, 2);
+    u16 = row.has_ttype ? static_cast<uint16_t>(l_tt) : kAbsent;
+    memcpy(p + 38, &u16, 2);
+    u16 = row.has_tid ? static_cast<uint16_t>(l_ti) : kAbsent;
+    memcpy(p + 40, &u16, 2);
+    memcpy(p + 42, &l_ex, 4);
+    uint8_t* s = p + kHeaderLen;
+    memcpy(s, row.event.data(), l_ev); s += l_ev;
+    memcpy(s, row.etype.data(), l_et); s += l_et;
+    memcpy(s, row.eid.data(), l_ei); s += l_ei;
+    if (row.has_ttype) { memcpy(s, row.ttype.data(), l_tt); s += l_tt; }
+    if (row.has_tid) { memcpy(s, row.tid.data(), l_ti); s += l_ti; }
+    if (l_ex) memcpy(s, extra.data(), l_ex);
+    ++n_valid;
+  }
+  if (c.ws()) return -3;  // trailing garbage after the array
+
+  int64_t n_rows = static_cast<int64_t>(codes.size());
+  if (n_valid > 0) {
+    int64_t appended =
+        append_packed(log, buf.data(), buf.size(), n_valid, /*fresh_ids=*/true);
+    if (appended != n_valid) return -1;
+  }
+  uint8_t* oi = static_cast<uint8_t*>(malloc(ids.size() ? ids.size() : 1));
+  uint8_t* oc = static_cast<uint8_t*>(malloc(codes.size() ? codes.size() : 1));
+  uint8_t* on = static_cast<uint8_t*>(
+      malloc(names_join.size() ? names_join.size() : 1));
+  uint8_t* oe = static_cast<uint8_t*>(
+      malloc(etypes_join.size() ? etypes_join.size() : 1));
+  if (!oi || !oc || !on || !oe) {
+    free(oi); free(oc); free(on); free(oe);
+    return -1;
+  }
+  memcpy(oi, ids.data(), ids.size());
+  memcpy(oc, codes.data(), codes.size());
+  memcpy(on, names_join.data(), names_join.size());
+  memcpy(oe, etypes_join.data(), etypes_join.size());
+  *out_ids = oi;
+  *out_codes = oc;
+  *out_names = on;
+  *out_names_bytes = names_join.size();
+  *out_etypes = oe;
+  *out_etypes_bytes = etypes_join.size();
+  *out_n = n_rows;
+  return n_valid;
+}
+
+// O(1) content fingerprint of the log: (generation, log bytes, record
+// count, tombstone count). An append-only log + monotonically renamed
+// compaction generations means this quadruple changes whenever the
+// data does — the cheap cache key the binned-layout cache uses to skip
+// re-reading 20M rows on retrain-with-unchanged-data (the HBase
+// region-sequence-id role).
+void el_fingerprint(void* h, uint64_t out[4]) {
+  Log* log = static_cast<Log*>(h);
+  std::shared_lock lk(log->mu);
+  out[0] = log->generation;
+  out[1] = log->file_size;
+  out[2] = log->recs.size();
+  out[3] = log->tombs.size();
+}
+
 int el_delete(void* h, const uint8_t* id16) {
   Log* log = static_cast<Log*>(h);
   std::unique_lock lk(log->mu);

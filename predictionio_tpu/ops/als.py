@@ -133,9 +133,14 @@ def _batched_cg(A, b, iters: int, x0=None, matvec_dtype=jnp.float32):
     return x
 
 
+#: uint8 value-code reserved for padded slots (compress_side): the
+#: decode table maps it to 0.0 and the mask derives as ``code != 255``
+PAD_CODE = 255
+
+
 def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
                  alpha, row_block, group_block, groups_loc, solver, cg_iters,
-                 cg_dtype, compute_dtype):
+                 cg_dtype, compute_dtype, val_table=None):
     """Solve all groups of one shard from segmented virtual rows.
 
     Three stages, all static-shape:
@@ -150,6 +155,12 @@ def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
          exactly; this is what removes the per-group length cap.
       3. batched regularized solve per group block (CG warm-started
          from the previous iteration's factors).
+
+    With ``val_table`` (the compressed layout, compress_side): ``val``
+    carries uint8 dictionary codes, ``mask`` is None — the slot value
+    decodes as ``val_table[code]`` and the mask as ``code != PAD_CODE``,
+    collapsing the val+mask HBM/transfer streams (8 bytes/slot) into
+    one byte.
     """
     R_loc, L = idx.shape
     nrb = R_loc // row_block
@@ -158,8 +169,14 @@ def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
     Yc = Y.astype(cdt)
 
     def partial_block(args):
-        idx_b, val_b, mask_b = args
-        Yg = Yc[idx_b] * mask_b[..., None].astype(cdt)  # [B, L, K] pad slots zeroed
+        if val_table is None:
+            idx_b, val_b, mask_b = args
+        else:
+            idx_b, code_b = args
+            val_b = val_table[code_b]            # [B, L] f32; pad -> 0.0
+            mask_b = code_b != PAD_CODE
+        maskc = mask_b.astype(cdt)
+        Yg = Yc[idx_b] * maskc[..., None]  # [B, L, K] pad slots zeroed
         if implicit:
             # partials of: alpha * Yg^T diag(r) Yg  and  Yg^T (1 + alpha r)
             A_r = alpha * jnp.einsum(
@@ -167,7 +184,8 @@ def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
                 preferred_element_type=f32,
             )
             b_r = jnp.einsum(
-                "blk,bl->bk", Yg, ((1.0 + alpha * val_b) * mask_b).astype(cdt),
+                "blk,bl->bk", Yg,
+                ((1.0 + alpha * val_b) * maskc.astype(val_b.dtype)).astype(cdt),
                 preferred_element_type=f32,
             )
         else:
@@ -176,11 +194,14 @@ def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
                              preferred_element_type=f32)
         return A_r, b_r
 
-    Ar, br = jax.lax.map(
-        partial_block,
-        (idx.reshape(nrb, row_block, L), val.reshape(nrb, row_block, L),
-         mask.reshape(nrb, row_block, L)),
-    )
+    if val_table is None:
+        operands = (idx.reshape(nrb, row_block, L),
+                    val.reshape(nrb, row_block, L),
+                    mask.reshape(nrb, row_block, L))
+    else:
+        operands = (idx.reshape(nrb, row_block, L),
+                    val.reshape(nrb, row_block, L))
+    Ar, br = jax.lax.map(partial_block, operands)
     Ar = Ar.reshape(R_loc, rank, rank)
     br = br.reshape(R_loc, rank)
     return _solve_groups(Ar, br, X_prev, seg, counts, Yc, rank=rank, reg=reg,
@@ -227,21 +248,37 @@ def _solve_groups(Ar, br, X_prev, seg, counts, Yc, *, rank, reg, implicit,
 
 
 def make_half_step(mesh: Optional[Mesh], cfg: ALSConfig, row_block: int,
-                   group_block: int, groups_loc: int):
-    """Compile one ALS half-step, sharded over the mesh ``data`` axis."""
+                   group_block: int, groups_loc: int,
+                   val_table: Optional[np.ndarray] = None):
+    """Compile one ALS half-step, sharded over the mesh ``data`` axis.
+
+    ``val_table`` switches the step to the compressed layout: the
+    positional args become (Y, X_prev, idx, codes, seg, counts) — no
+    mask stream — with the tiny decode table baked in as a constant."""
     kwargs = dict(
         rank=cfg.rank, reg=cfg.reg, implicit=cfg.implicit, alpha=cfg.alpha,
         row_block=row_block, group_block=group_block, groups_loc=groups_loc,
         solver=cfg.solver, cg_iters=cfg.cg_iters, cg_dtype=cfg.cg_dtype,
         compute_dtype=cfg.compute_dtype,
     )
-    fn = functools.partial(_solve_shard, **kwargs)
+    if val_table is None:
+        fn = functools.partial(_solve_shard, **kwargs)
+        in_specs = (P(), P("data", None), P("data", None), P("data", None),
+                    P("data", None), P("data"), P("data"))
+    else:
+        table = jnp.asarray(val_table, jnp.float32)
+
+        def fn(Y, X_prev, idx, codes, seg, counts):
+            return _solve_shard(Y, X_prev, idx, codes, None, seg, counts,
+                                val_table=table, **kwargs)
+
+        in_specs = (P(), P("data", None), P("data", None), P("data", None),
+                    P("data"), P("data"))
     if mesh is not None and np.prod([mesh.shape[a] for a in mesh.axis_names]) > 1:
         fn = jax.shard_map(
             fn,
             mesh=mesh,
-            in_specs=(P(), P("data", None), P("data", None), P("data", None),
-                      P("data", None), P("data"), P("data")),
+            in_specs=in_specs,
             out_specs=P("data", None),
         )
     return jax.jit(fn)
@@ -281,6 +318,105 @@ class ALSFactors:
     item_factors: np.ndarray  # [n_items, K] float32
 
 
+@dataclasses.dataclass
+class SideLayout:
+    """One side's device-bound arrays in transfer-compressed form.
+
+    The host->device transfer is the dominant one-time cost on a
+    tunneled chip (BENCH_r03: 23-36 s), so the wire layout is shrunk
+    before the put: indexes drop to int16 when the opposing vocabulary
+    fits, and when the ratings take <= 255 distinct values (explicit
+    feedback: 10 half-star steps) the val+mask float streams (8 B/slot)
+    collapse into ONE uint8 dictionary code (table[code] decodes on
+    device, code 255 = padded slot). ML-20M: 9 -> 3 bytes/slot on the
+    user side, 9 -> 5 on the item side."""
+
+    idx: np.ndarray               # [R, L] int16 | int32
+    val: np.ndarray               # [R, L] uint8 codes | float32
+    mask: Optional[np.ndarray]    # [R, L] uint8, None when val is coded
+    seg: np.ndarray               # [R] int32
+    counts: np.ndarray            # [G] int32
+    table: Optional[np.ndarray]   # [256] float32 decode table
+    row_block: int
+    group_block: int
+    groups_per_shard: int
+    n_shards: int
+
+    @property
+    def kept_entries(self) -> int:
+        return int(self.counts.sum())
+
+    @property
+    def slot_bytes(self) -> int:
+        return (self.idx.dtype.itemsize + self.val.dtype.itemsize
+                + (1 if self.mask is not None else 0))
+
+    @property
+    def transfer_bytes(self) -> int:
+        n = self.idx.nbytes + self.val.nbytes + self.seg.nbytes + self.counts.nbytes
+        if self.mask is not None:
+            n += self.mask.nbytes
+        return n
+
+    def to_arrays(self, prefix: str) -> dict:
+        out = {f"{prefix}idx": self.idx, f"{prefix}val": self.val,
+               f"{prefix}seg": self.seg, f"{prefix}counts": self.counts}
+        if self.mask is not None:
+            out[f"{prefix}mask"] = self.mask
+        if self.table is not None:
+            out[f"{prefix}table"] = self.table
+        return out
+
+    @classmethod
+    def from_arrays(cls, arrays: dict, prefix: str, meta: dict) -> "SideLayout":
+        return cls(
+            idx=arrays[f"{prefix}idx"], val=arrays[f"{prefix}val"],
+            mask=arrays.get(f"{prefix}mask"), seg=arrays[f"{prefix}seg"],
+            counts=arrays[f"{prefix}counts"],
+            table=arrays.get(f"{prefix}table"),
+            row_block=int(meta[f"{prefix}row_block"]),
+            group_block=int(meta[f"{prefix}group_block"]),
+            groups_per_shard=int(meta[f"{prefix}groups_per_shard"]),
+            n_shards=int(meta["n_shards"]),
+        )
+
+    def meta(self, prefix: str) -> dict:
+        return {f"{prefix}row_block": self.row_block,
+                f"{prefix}group_block": self.group_block,
+                f"{prefix}groups_per_shard": self.groups_per_shard}
+
+
+def compress_side(sg: SegmentedGroups, n_opposing: int) -> SideLayout:
+    """Shrink one side's arrays for the wire (see SideLayout)."""
+    idx = (sg.idx.astype(np.int16)
+           if n_opposing <= np.iinfo(np.int16).max else sg.idx)
+    # cheap distinct-count probe (first 256k ELEMENTS of the flattened
+    # array) before committing to the full 20M-element unique
+    probe = np.unique(sg.val.reshape(-1)[:1 << 18])
+    table = None
+    if len(probe) <= PAD_CODE:
+        uniq = np.unique(sg.val)
+        if len(uniq) <= PAD_CODE:  # 0..254 real codes; 255 reserved
+            codes = np.searchsorted(uniq, sg.val).astype(np.uint8)
+            codes[sg.mask == 0] = PAD_CODE
+            table = np.zeros(256, np.float32)
+            table[:len(uniq)] = uniq
+            return SideLayout(
+                idx=idx, val=codes, mask=None, seg=sg.seg,
+                counts=sg.counts, table=table,
+                row_block=sg.row_block, group_block=sg.group_block,
+                groups_per_shard=sg.groups_per_shard, n_shards=sg.n_shards)
+    return SideLayout(
+        idx=idx, val=sg.val, mask=sg.mask.astype(np.uint8), seg=sg.seg,
+        counts=sg.counts, table=None,
+        row_block=sg.row_block, group_block=sg.group_block,
+        groups_per_shard=sg.groups_per_shard, n_shards=sg.n_shards)
+
+
+class LayoutCacheMiss(LookupError):
+    """No cached layout for the key (caller falls back to the read path)."""
+
+
 class ALSTrainer:
     """Prepared ALS run: data binned + placed on device, steps compiled.
 
@@ -292,72 +428,123 @@ class ALSTrainer:
 
     def __init__(
         self,
-        user_coo: Tuple[np.ndarray, np.ndarray, np.ndarray],
-        n_users: int,
-        n_items: int,
+        user_coo: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]],
+        n_users: Optional[int],
+        n_items: Optional[int],
         cfg: ALSConfig,
         mesh: Optional[Mesh] = None,
         max_ratings_per_user: Optional[int] = None,
         max_ratings_per_item: Optional[int] = None,
+        cache_key: Optional[str] = None,
     ):
-        u_idx, i_idx, vals = user_coo
+        """``cache_key`` enables the persistent binned-layout cache
+        (ops.bincache, VERDICT r3 item 2): the compressed device layout
+        is loaded by key when present — ``user_coo``/``n_users``/
+        ``n_items`` may then be None, and retraining on unchanged
+        events skips the whole read->bin pipeline — and saved after a
+        build otherwise. The key must already identify the DATA (event
+        fingerprint + derivation); layout-affecting config is appended
+        here. With no cached layout and no COO, raises LayoutCacheMiss
+        so the caller can fall back to reading events."""
         self.cfg = cfg
         self.mesh = mesh
-        self.n_users, self.n_items = n_users, n_items
         n_shards = mesh.shape["data"] if mesh is not None else 1
+        self.cache_hit = False
 
-        # build one side, START its (async) device transfer, then build
-        # the other: on a tunneled chip the bulk transfer is the
-        # dominant one-time cost, and this hides the second side's host
-        # binning underneath the first side's bytes in flight
-        by_user = _build_side(
-            u_idx, i_idx, vals, n_users, cfg, n_shards, max_ratings_per_user
-        )
-        self._ud = self._to_device(by_user)
-        by_item = _build_side(
-            i_idx, u_idx, vals, n_items, cfg, n_shards, max_ratings_per_item
-        )
-        self._it = self._to_device(by_item)
-        self._g_users = by_user.groups_per_shard * n_shards
-        self._g_items = by_item.groups_per_shard * n_shards
+        full_key = None
+        if cache_key is not None:
+            from predictionio_tpu.ops import bincache
+
+            full_key = bincache.layout_key(
+                cache_key, "als-segmented",
+                {"seg_len": cfg.seg_len, "block_size": cfg.block_size,
+                 "rank": cfg.rank, "n_shards": n_shards,
+                 "max_u": max_ratings_per_user, "max_i": max_ratings_per_item})
+            cached = bincache.load(full_key)
+            if cached is not None:
+                arrays, meta = cached
+                self.n_users = int(meta["n_users"])
+                self.n_items = int(meta["n_items"])
+                self.total_entries = int(meta["total_entries"])
+                # load + put one side at a time: side 2's disk read
+                # overlaps side 1's bytes in flight
+                user_side = SideLayout.from_arrays(arrays, "u_", meta)
+                self._ud = self._put_side(user_side)
+                item_side = SideLayout.from_arrays(arrays, "i_", meta)
+                self._it = self._put_side(item_side)
+                self.cache_hit = True
+        if not self.cache_hit:
+            if user_coo is None:
+                raise LayoutCacheMiss(
+                    f"no cached layout for key {cache_key!r} and no COO "
+                    "data was provided")
+            u_idx, i_idx, vals = user_coo
+            self.n_users, self.n_items = n_users, n_items
+            # build one side, START its (async) device transfer, then
+            # build the other: on a tunneled chip the bulk transfer is
+            # the dominant one-time cost, and this hides the second
+            # side's host binning underneath the first side's bytes in
+            # flight
+            by_user = _build_side(
+                u_idx, i_idx, vals, n_users, cfg, n_shards,
+                max_ratings_per_user)
+            user_side = compress_side(by_user, n_items)
+            self._ud = self._put_side(user_side)
+            by_item = _build_side(
+                i_idx, u_idx, vals, n_items, cfg, n_shards,
+                max_ratings_per_item)
+            item_side = compress_side(by_item, n_users)
+            self._it = self._put_side(item_side)
+            self.total_entries = len(vals)
+            if full_key is not None:
+                from predictionio_tpu.ops import bincache
+
+                arrays = {**user_side.to_arrays("u_"),
+                          **item_side.to_arrays("i_")}
+                bincache.save(full_key, arrays, {
+                    "n_users": n_users, "n_items": n_items,
+                    "n_shards": n_shards, "total_entries": len(vals),
+                    **user_side.meta("u_"), **item_side.meta("i_"),
+                })
+
+        self._g_users = user_side.groups_per_shard * n_shards
+        self._g_items = item_side.groups_per_shard * n_shards
         # entries actually processed per half-step (all of them unless an
         # explicit max_ratings_per_* cap is set)
-        self.kept_user_entries = int(by_user.counts.sum())
-        self.kept_item_entries = int(by_item.counts.sum())
-        self.total_entries = len(vals)
+        self.kept_user_entries = user_side.kept_entries
+        self.kept_item_entries = item_side.kept_entries
+        self.transfer_bytes = (user_side.transfer_bytes
+                               + item_side.transfer_bytes)
+        self._slot_bytes = (user_side.slot_bytes, item_side.slot_bytes)
 
         key = jax.random.PRNGKey(cfg.seed)
         ku, ki = jax.random.split(key)
-        self._X = _init_factors(ku, self._g_users, n_users, cfg.rank)
-        self._Y = _init_factors(ki, self._g_items, n_items, cfg.rank)
+        self._X = _init_factors(ku, self._g_users, self.n_users, cfg.rank)
+        self._Y = _init_factors(ki, self._g_items, self.n_items, cfg.rank)
 
         self._user_step = make_half_step(
-            mesh, cfg, by_user.row_block, by_user.group_block,
-            by_user.groups_per_shard,
+            mesh, cfg, user_side.row_block, user_side.group_block,
+            user_side.groups_per_shard, val_table=user_side.table,
         )
         self._item_step = make_half_step(
-            mesh, cfg, by_item.row_block, by_item.group_block,
-            by_item.groups_per_shard,
+            mesh, cfg, item_side.row_block, item_side.group_block,
+            item_side.groups_per_shard, val_table=item_side.table,
         )
         self._run_cache = {}
 
-    def _to_device(self, sg: SegmentedGroups):
-        # mask travels as uint8 (it is 0/1): the float32 host layout
-        # would be a third full-size stream over the tunnel; device
-        # consumers already .astype() it into the compute dtype, and
-        # uint8*f32 promotes to f32 — this is the "4 + 4 + 1" byte
-        # model work_model() documents
-        arrs = (jnp.asarray(sg.idx), jnp.asarray(sg.val),
-                jnp.asarray(sg.mask.astype(np.uint8)),
-                jnp.asarray(sg.seg), jnp.asarray(sg.counts))
+    def _put_side(self, side: SideLayout):
+        arrs = [jnp.asarray(side.idx), jnp.asarray(side.val)]
+        if side.mask is not None:
+            arrs.append(jnp.asarray(side.mask))
+        arrs += [jnp.asarray(side.seg), jnp.asarray(side.counts)]
         if self.mesh is not None:
             shardings = [
                 NamedSharding(self.mesh, P("data", None)) if a.ndim == 2
                 else NamedSharding(self.mesh, P("data"))
                 for a in arrs
             ]
-            arrs = tuple(jax.device_put(a, s) for a, s in zip(arrs, shardings))
-        return arrs
+            arrs = [jax.device_put(a, s) for a, s in zip(arrs, shardings)]
+        return tuple(arrs)
 
     def _run_compiled(self, n: int):
         """One jitted program for n full alternations: `lax.scan` over
@@ -454,7 +641,9 @@ class ALSTrainer:
         cg_iters = self.cfg.cg_iters if self.cfg.solver == "cg" else 0
         flops = 0.0
         bytes_ = 0.0
-        for side, n_groups in ((self._ud, self._g_users), (self._it, self._g_items)):
+        for side, n_groups, slot_b in (
+                (self._ud, self._g_users, self._slot_bytes[0]),
+                (self._it, self._g_items, self._slot_bytes[1])):
             idx = side[0]
             S = float(idx.shape[0]) * float(idx.shape[1])  # slots incl. pad
             G = float(n_groups)
@@ -463,7 +652,7 @@ class ALSTrainer:
             flops += (cg_iters + 1) * 2.0 * G * K * K  # CG matvecs
             bytes_ += S * K * cs              # factor gather read
             bytes_ += 2.0 * S * K * cs        # materialized Yg write+read
-            bytes_ += S * (4 + 4 + 1)         # idx/val/mask reads
+            bytes_ += S * slot_b              # idx/val[/mask] input reads
             bytes_ += 2.0 * float(idx.shape[0]) * K * K * 4  # partials w+r
             bytes_ += (cg_iters + 1) * G * K * K * cg_b      # CG A re-reads
             bytes_ += G * K * 4               # solved factors write
@@ -478,12 +667,14 @@ def als_train(
     mesh: Optional[Mesh] = None,
     max_ratings_per_user: Optional[int] = None,
     max_ratings_per_item: Optional[int] = None,
+    cache_key: Optional[str] = None,
 ) -> ALSFactors:
     """One-call train from COO (user_idx, item_idx, rating) triples."""
     return ALSTrainer(
         user_coo, n_users, n_items, cfg, mesh=mesh,
         max_ratings_per_user=max_ratings_per_user,
         max_ratings_per_item=max_ratings_per_item,
+        cache_key=cache_key,
     ).run()
 
 

@@ -34,6 +34,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from predictionio_tpu.data.backends.eventlog import _ROW_ERRORS, JsonRowsUnsupported
 from predictionio_tpu.data.event import Event, EventValidationError, validate_event, _parse_time
 from predictionio_tpu.data.storage import UNSET, Storage, StorageError, get_storage
 from predictionio_tpu.serving.http import HTTPServerBase, JSONRequestHandler
@@ -108,6 +109,60 @@ class EventServerCore:
             return 500, {"message": str(e)}
         self.stats.update(auth.app_id, 201, event.event, event.entity_type)
         return 201, {"eventId": event_id}
+
+    def create_events_batch(self, auth: AuthData, raw_body: bytes) -> Tuple[int, Any]:
+        """``POST /batch/events.json`` (ref: EventAPI.scala:252): a JSON
+        array of events in, an array of per-event statuses out (201 with
+        the eventId, or 400 with the validation message — one bad event
+        never fails its batchmates).
+
+        The fast lane hands the RAW request bytes to the native event
+        log (EventLogEventStore.insert_json_batch): parse + validation +
+        wire packing + append in one GIL-released call, no per-row
+        Python objects. It engages when the store supports it and the
+        access key has no event whitelist (a whitelist needs per-event
+        allow/deny before insert); everything else — including payload
+        shapes the native parser declines — falls back to the per-row
+        Python path. Unlike the reference there is no 50-events cap
+        (MaxNumberOfEventsPerBatchRequest): large batches are the point
+        of the native lane."""
+        store = self.storage.events()
+        fast = getattr(store, "insert_json_batch", None)
+        if fast is not None and not auth.events:
+            try:
+                ids, codes, names, etypes = fast(
+                    raw_body, auth.app_id, auth.channel_id, strict=False)
+            except JsonRowsUnsupported:
+                pass  # the Python path below accepts more shapes
+            except StorageError as e:
+                return 400, {"message": str(e)}
+            else:
+                results = []
+                for eid, code, name, etype in zip(ids, codes, names, etypes):
+                    if code == 0:
+                        results.append({"status": 201, "eventId": eid})
+                        self.stats.update(auth.app_id, 201, name, etype)
+                    else:
+                        results.append({
+                            "status": 400,
+                            "message": _ROW_ERRORS.get(
+                                code, f"validation error {code}"),
+                        })
+                        self.stats.update(auth.app_id, 400, name, etype)
+                return 200, results
+        try:
+            payload = json.loads(raw_body)
+        except json.JSONDecodeError as e:
+            return 400, {"message": f"invalid JSON: {e}"}
+        if not isinstance(payload, list):
+            return 400, {"message": "batch events must be a JSON array"}
+        results = []
+        for item in payload:
+            status, body = self.create_event(auth, item)
+            entry = {"status": status}
+            entry.update(body)
+            results.append(entry)
+        return 200, results
 
     def get_event(self, auth: AuthData, event_id: str) -> Tuple[int, dict]:
         event = self.storage.events().get(event_id, auth.app_id, auth.channel_id)
@@ -255,6 +310,15 @@ class _EventRequestHandler(JSONRequestHandler):
                     self._send(*self.core.query_events(auth, params))
                 else:
                     self._send(405, {"message": "method not allowed"})
+                return
+            if path == "/batch/events.json":
+                auth = self._auth(params)
+                if method != "POST":
+                    self._send(405, {"message": "method not allowed"})
+                    return
+                # RAW body bytes: the native lane parses them itself
+                self._send(*self.core.create_events_batch(
+                    auth, self._read_body()))
                 return
             if path.startswith("/events/") and path.endswith(".json"):
                 auth = self._auth(params)

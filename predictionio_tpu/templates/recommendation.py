@@ -54,10 +54,14 @@ class RatingColumns:
 @dataclass
 class RatingsTD(SanityCheck):
     """TD: (user, item, rating) triples from the event store — as a
-    row list (small data, eval folds) or columnar arrays (bulk path)."""
+    row list (small data, eval folds) or columnar arrays (bulk path).
+    ``fingerprint`` (when the backend offers a cheap one) identifies
+    the exact data + derivation, keying the binned-layout cache so a
+    retrain on unchanged events skips re-binning."""
 
     ratings: List[RatingEvent] = field(default_factory=list)
     columns: Optional[RatingColumns] = None
+    fingerprint: Optional[str] = None
 
     def sanity_check(self) -> None:
         if not self.ratings and (self.columns is None or not len(self.columns.ratings)):
@@ -126,11 +130,25 @@ class RecoDataSource(DataSource):
             ratings=ratings,
         )
 
+    def data_fingerprint(self) -> Optional[str]:
+        """O(1) derivation-qualified fingerprint of what read_training
+        would produce: the event store's content fingerprint (None on
+        backends without one) + every param that shapes the derived
+        COO. Callers with a cached layout under this key can skip the
+        read entirely (ops.bincache)."""
+        p: RecoDataSourceParams = self.params
+        fp = store.data_fingerprint(p.app_name, p.channel_name)
+        if fp is None:
+            return None
+        return (f"{fp}|reco|{p.rate_event}|{p.buy_event}|{p.buy_rating}"
+                f"|{p.columnar}")
+
     def read_training(self, ctx: MeshContext) -> RatingsTD:
         p: RecoDataSourceParams = self.params
+        fp = self.data_fingerprint()
         if p.columnar:
-            return RatingsTD(columns=self._read_columnar())
-        return RatingsTD(ratings=self._read())
+            return RatingsTD(columns=self._read_columnar(), fingerprint=fp)
+        return RatingsTD(ratings=self._read(), fingerprint=fp)
 
     def read_eval(self, ctx: MeshContext):
         """k-fold split by idx % k (ref: CrossValidation.scala:33)."""
@@ -167,6 +185,7 @@ class RecoPreparator(Preparator):
                 user_idx=c.user_idx.astype(np.int64, copy=False),
                 item_idx=c.item_idx.astype(np.int64, copy=False),
                 ratings=c.ratings,
+                fingerprint=td.fingerprint,
             )
         users = BiMap.string_int(r.user for r in td.ratings)
         items = BiMap.string_int(r.item for r in td.ratings)

@@ -288,3 +288,26 @@ def test_status_exit_1_when_metadata_tier_dies():
         set_storage(None)
         for s in servers:
             s.stop()
+
+
+def test_repair_refuses_blank_owner(three_replicated):
+    """Code-review regression: a re-provisioned BLANK metadata owner
+    must never erase the surviving replicas records via repair."""
+    backends, _, client = three_replicated
+    app, key, _ = _seed_meta(client)
+
+    # wipe the OWNER only (the re-provisioned-blank-host scenario)
+    backends[0].apps().delete(app.id)
+    backends[0].access_keys().delete(key.key)
+    with pytest.raises(StorageError, match="repair refused"):
+        client.client_for("METADATA").repair_meta()
+    # the replica records survived
+    assert backends[1].apps().get_by_name("repl-app") is not None
+
+    # blank owner MODELS only: also refused
+    backends[0].apps().put(app)           # restore records
+    backends[0].access_keys().put(key)
+    backends[0].models().delete("inst-1")
+    with pytest.raises(StorageError, match="no model blobs"):
+        client.client_for("METADATA").repair_meta()
+    assert backends[1].models().get("inst-1") is not None

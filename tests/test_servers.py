@@ -524,3 +524,102 @@ def test_log_url_error_forwarding(memory_storage):
     finally:
         server.stop()
         sink.stop()
+
+
+# ---------------------------------------------------------------------------
+# POST /batch/events.json (ref: EventAPI.scala:252) — array in,
+# per-event statuses out, through BOTH lanes: the native fast path
+# (eventlog storage, raw bytes to C++) and the per-row Python fallback
+# (memory storage / whitelisted keys).
+# ---------------------------------------------------------------------------
+
+BATCH_ROWS = [
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "targetEntityType": "item", "targetEntityId": "i1",
+     "properties": {"rating": 5.0},
+     "eventTime": "2026-01-01T00:00:00.000Z"},
+    {"event": "", "entityType": "user", "entityId": "u2"},      # invalid
+    {"event": "view", "entityType": "user", "entityId": "u3",
+     "eventTime": "2026-01-01T01:00:00.000Z"},
+]
+
+
+def _assert_batch_contract(base, key, storage, app_id):
+    status, results = http("POST", f"{base}/batch/events.json?accessKey={key.key}",
+                           BATCH_ROWS)
+    assert status == 200 and len(results) == 3
+    assert results[0]["status"] == 201 and results[0]["eventId"]
+    assert results[1]["status"] == 400 and "empty" in results[1]["message"]
+    assert results[2]["status"] == 201
+    # one bad event never fails its batchmates
+    stored = storage.events().find(app_id)
+    assert sorted(e.entity_id for e in stored
+                  if e.event in ("rate", "view")) == ["u1", "u3"]
+    got = storage.events().get(results[0]["eventId"], app_id)
+    assert got is not None and got.properties.to_dict() == {"rating": 5.0}
+    # stats counted both statuses
+    s, report = http("GET", f"{base}/stats.json?accessKey={key.key}")
+    counts = {(c["status"], c["event"]): c["count"]
+              for b in report["buckets"] for c in b["counts"]}
+    assert counts.get((201, "rate")) == 1
+    assert counts.get((400, "")) == 1
+
+
+def test_batch_events_python_fallback_lane(event_server):
+    """Memory storage has no native lane: the per-row Python path."""
+    server, app, key = event_server
+    _assert_batch_contract(f"http://127.0.0.1:{server.port}", key,
+                           server.core.storage, app.id)
+
+
+def test_batch_events_native_lane(tmp_path):
+    """Eventlog storage: the raw body goes straight to the native
+    encoder — same wire contract as the Python path."""
+    from tests.test_storage import make_storage
+
+    storage = make_storage("eventlog", tmp_path)
+    app = storage.apps().insert("batch-app")
+    storage.events().init(app.id)
+    key = AccessKey.generate(app.id)
+    storage.access_keys().insert(key)
+    server = EventServer(storage=storage, host="127.0.0.1", port=0).start()
+    try:
+        _assert_batch_contract(f"http://127.0.0.1:{server.port}", key,
+                               storage, app.id)
+    finally:
+        server.stop()
+        storage.events().close()
+
+
+def test_batch_events_whitelist_uses_python_path(tmp_path):
+    """A key with an event whitelist needs per-event allow/deny: the
+    native lane must NOT engage, and disallowed events 403 per-row."""
+    from tests.test_storage import make_storage
+
+    storage = make_storage("eventlog", tmp_path)
+    app = storage.apps().insert("wl-app")
+    storage.events().init(app.id)
+    key = AccessKey.generate(app.id, events=["rate"])
+    storage.access_keys().insert(key)
+    server = EventServer(storage=storage, host="127.0.0.1", port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        status, results = http(
+            "POST", f"{base}/batch/events.json?accessKey={key.key}",
+            BATCH_ROWS)
+        assert status == 200
+        assert results[0]["status"] == 201
+        assert results[1]["status"] == 400
+        assert results[2]["status"] == 403  # "view" not whitelisted
+        assert [e.event for e in storage.events().find(app.id)] == ["rate"]
+    finally:
+        server.stop()
+        storage.events().close()
+
+
+def test_batch_events_malformed_body(event_server):
+    server, app, key = event_server
+    base = f"http://127.0.0.1:{server.port}"
+    status, body = http("POST", f"{base}/batch/events.json?accessKey={key.key}",
+                        {"not": "an array"})
+    assert status == 400
