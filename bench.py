@@ -36,9 +36,13 @@ cache, so both halves of the compile story are measured:
     serve   - the trained model is persisted through the models repo,
               deployed via the REAL EngineServer (prepare_deploy +
               warm-up), and driven over HTTP POST /queries.json:
-              sequential p50/p99 + concurrent throughput. Gate:
-              p50 < 10 ms (BASELINE.json north-star) or the headline
-              is zeroed.
+              sequential p50/p99 + concurrent throughput, then a
+              SATURATING stage: 32 keep-alive connections, p50/p99/qps
+              with zero errors tolerated and the MicroBatcher's
+              dispatch-size histogram recorded (batches > 1 must form).
+              Gates: sequential p50 < 10 ms (BASELINE.json north-star)
+              AND 32-conn p99 < 25 ms with real batching, or the
+              headline is zeroed.
 
   warm stage (fresh process, same cache): read -> prepare -> bin ->
     compile -> train again. Compile becomes a disk-cache HIT; this is
@@ -59,8 +63,8 @@ publishes no benchmark numbers at all (BASELINE.json "published": {});
 the proxy is our own stated assumption, recorded in the detail block,
 and the >=5x north-star (BASELINE.md) reads as vs_baseline >= 5.
 If ANY gate fails (relative RMSE, absolute RMSE band, serving p50,
-row-lane >= 50k ev/s), value is reported as 0.0 with the gate flags
-telling which.
+32-conn p99 + batching, row-lane >= 50k ev/s), value is reported as
+0.0 with the gate flags telling which.
 
 Scale knobs via env: PIO_BENCH_USERS/ITEMS/RATINGS/RANK/ITERS (the
 absolute RMSE band only applies at the default knobs).
@@ -212,6 +216,115 @@ def _read_prepare_bin_train(detail, n_expected):
     return trainer, pd, ho, (tr_u, tr_i, tr_r), cfg, train_sec
 
 
+def _parse_train_profile(profile_dir):
+    """Parse the profiled train step's xplane trace into MEASURED
+    occupancy numbers (VERDICT r3 item 4): per-HLO-category device time,
+    XLA cost-model flops, and bytes split by memory space (space 1 =
+    HBM on TPU xplanes). Runs in its own subprocess (tensorflow's proto
+    stack must not share the bench process). Prints ONE JSON line."""
+    import glob
+
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    def varint(buf, i):
+        out = shift = 0
+        while True:
+            b = buf[i]
+            out |= (b & 0x7F) << shift
+            i += 1
+            if not b & 0x80:
+                return out, i
+            shift += 7
+
+    def hbm_bytes_of(breakdown: bytes) -> int:
+        """Decode OpMetrics.MemoryAccessed entries; sum bytes where
+        memory_space == 1 (HBM)."""
+        total = 0
+        i = 0
+        while i < len(breakdown):
+            tag, i = varint(breakdown, i)
+            if tag >> 3 != 1 or (tag & 7) != 2:  # repeated message field
+                break
+            ln, i = varint(breakdown, i)
+            sub = breakdown[i:i + ln]
+            i += ln
+            j = 0
+            space = by = 0
+            while j < len(sub):
+                t, j = varint(sub, j)
+                v, j = varint(sub, j)
+                f = t >> 3
+                if f == 2:
+                    space = v
+                elif f == 3:
+                    by = v
+            if space == 1:
+                total += by
+        return total
+
+    files = glob.glob(os.path.join(profile_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not files:
+        print(json.dumps({"error": "no xplane trace found"}))
+        return
+    space = xplane_pb2.XSpace()
+    with open(sorted(files)[-1], "rb") as f:
+        space.ParseFromString(f.read())
+    plane = next((p for p in space.planes if "TPU" in p.name), None)
+    if plane is None:
+        print(json.dumps({"error": "no TPU plane in trace"}))
+        return
+    smeta = {k: v.name for k, v in plane.stat_metadata.items()}
+    # per-op (event metadata) cost stats: bytes/flops are XLA's cost
+    # analysis of the compiled HLO — measured occupancy comes from the
+    # recorded durations, bytes/flops from the compiler's own accounting
+    em_stats = {}
+    for k, em in plane.event_metadata.items():
+        st = {}
+        for s in em.stats:
+            name = smeta.get(s.metadata_id)
+            st[name] = (s.bytes_value if s.bytes_value
+                        else (s.int64_value or s.uint64_value
+                              or s.double_value or s.str_value))
+        em_stats[k] = (em.name, st)
+    ops_line = next((l for l in plane.lines if l.name == "XLA Ops"), None)
+    if ops_line is None:
+        print(json.dumps({"error": "no XLA Ops line"}))
+        return
+    by_cat = {}
+    tot_dur_ps = tot_flops = tot_bytes = tot_hbm = 0
+    for ev in ops_line.events:
+        name, st = em_stats.get(ev.metadata_id, ("?", {}))
+        cat = st.get("hlo_category", "?")
+        dur = ev.duration_ps
+        flops = int(st.get("flops") or 0)
+        byts = int(st.get("bytes_accessed") or 0)
+        hbm = hbm_bytes_of(st.get("memory_access_breakdown") or b"")
+        agg = by_cat.setdefault(cat, {"dur_ps": 0, "flops": 0,
+                                      "bytes": 0, "hbm_bytes": 0})
+        agg["dur_ps"] += dur
+        agg["flops"] += flops
+        agg["bytes"] += byts
+        agg["hbm_bytes"] += hbm
+        tot_dur_ps += dur
+        tot_flops += flops
+        tot_bytes += byts
+        tot_hbm += hbm
+    cats = sorted(by_cat.items(), key=lambda kv: -kv[1]["dur_ps"])
+    out = {
+        "device_time_sec": round(tot_dur_ps / 1e12, 4),
+        "flops_total": tot_flops,
+        "bytes_total": tot_bytes,
+        "hbm_bytes_total": tot_hbm,
+        "by_category": {
+            k: {"time_frac": round(v["dur_ps"] / max(tot_dur_ps, 1), 3),
+                "hbm_bytes": v["hbm_bytes"], "flops": v["flops"]}
+            for k, v in cats[:8]
+        },
+    }
+    print(json.dumps(out))
+
+
 def _roofline(trainer, train_sec, iterations):
     wm = trainer.work_model()
     achieved_flops = wm["flops_per_iter"] * iterations / train_sec
@@ -340,8 +453,122 @@ def _serve_stage(storage, factors, pd, cfg, detail):
         detail["serve_p99_ms"] = round(p99 * 1e3, 2)
         detail["serve_qps"] = round(n_threads * per_thread / wall, 1)
         detail["serve_gate_passed"] = bool(p50 * 1e3 < 10.0)  # BASELINE north-star
+
+        # saturating load (VERDICT r3 item 6): 32 keep-alive connections
+        # hammering /queries.json — per-request latencies for p50/p99,
+        # no errors tolerated, and the MicroBatcher's dispatch-size
+        # histogram proving batches actually form (the amortization the
+        # design claims). The load generator runs in a SEPARATE process:
+        # in-process client threads would share the server's GIL and
+        # bill the clients' own CPU to the server's tail (measured: the
+        # same stage in-process reads ~2x worse p99 than any external
+        # client would see).
+        import tempfile as _tf
+
+        with _tf.NamedTemporaryFile("w", suffix=".json", delete=False) as uf:
+            json.dump(users, uf)
+            users_file = uf.name
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--stage", "loadgen",
+                 "--base", json.dumps({
+                     "port": server.port, "users_file": users_file,
+                     "threads": 32, "per_thread": 60})],
+                capture_output=True, text=True, timeout=600,
+            )
+            lines = [l for l in proc.stdout.splitlines()
+                     if l.startswith("{")]
+            assert proc.returncode == 0 and lines, (
+                proc.returncode, proc.stdout[-500:], proc.stderr[-500:])
+            load = json.loads(lines[-1])
+            assert load["errors"] == 0, load
+        finally:
+            os.unlink(users_file)
+        hist = server._batcher.histogram() if server._batcher else {}
+        batched = sum(v for k, v in
+                      hist.get("batchSizeHistogram", {}).items()
+                      if int(k) > 1)
+        detail["serve_qps_32conn"] = load["qps"]
+        detail["serve_p50_ms_32conn"] = load["p50_ms"]
+        detail["serve_p99_ms_32conn"] = load["p99_ms"]
+        detail["serve_batch_histogram"] = hist.get("batchSizeHistogram", {})
+        detail["serve_32_gate_passed"] = bool(
+            load["p99_ms"] < 25.0 and batched > 0)
     finally:
         server.stop()
+
+
+def stage_loadgen(config_json):
+    """Out-of-process load generator for the saturation stage (its own
+    GIL — client CPU must not masquerade as server latency). Drives
+    ``threads`` keep-alive connections ``per_thread`` requests each
+    against POST /queries.json; prints ONE JSON line with latencies."""
+    import http.client
+    import socket
+    import threading
+
+    cfg = json.loads(config_json)
+    with open(cfg["users_file"]) as f:
+        users = json.load(f)
+    port = int(cfg["port"])
+    n_threads = int(cfg["threads"])
+    per_thread = int(cfg["per_thread"])
+    errs = []
+    lat = [[] for _ in range(n_threads)]
+    spans = [None] * n_threads
+    barrier = threading.Barrier(n_threads)
+
+    def one(conn, user):
+        body = json.dumps({"user": user, "num": 10})
+        conn.request("POST", "/queries.json", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        assert resp.status == 200 and b"itemScores" in data, data[:200]
+
+    def worker(tid):
+        try:
+            c = http.client.HTTPConnection("127.0.0.1", port)
+            c.connect()
+            c.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # per-connection warm-up OUTSIDE the timed region (TCP
+            # setup + server thread spawn are connection costs)
+            for j in range(3):
+                one(c, users[(tid + j) % len(users)])
+            barrier.wait()
+            t_start = time.perf_counter()
+        except Exception as e:  # noqa: BLE001
+            errs.append(repr(e))
+            barrier.abort()  # fail fast, never hang the stage
+            return
+        try:
+            for j in range(per_thread):
+                t0 = time.perf_counter()
+                one(c, users[(tid * per_thread + j) % len(users)])
+                lat[tid].append(time.perf_counter() - t0)
+            spans[tid] = (t_start, time.perf_counter())
+            c.close()
+        except Exception as e:  # noqa: BLE001
+            errs.append(repr(e))
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errs:
+        print(json.dumps({"errors": len(errs), "first": errs[0]}))
+        return
+    wall = max(s[1] for s in spans) - min(s[0] for s in spans)
+    flat = sorted(x for ls in lat for x in ls)
+    print(json.dumps({
+        "errors": 0,
+        "qps": round(n_threads * per_thread / wall, 1),
+        "p50_ms": round(flat[len(flat) // 2] * 1e3, 2),
+        "p99_ms": round(flat[int(len(flat) * 0.99)] * 1e3, 2),
+    }))
 
 
 def stage_cold(base_dir, out_path):
@@ -492,6 +719,56 @@ def stage_cold(base_dir, out_path):
     assert int(effective) == len(train_coo[2]), (effective, len(train_coo[2]))
     detail["updates_per_sec"] = round(effective * iterations / train_sec, 1)
     detail["roofline"] = _roofline(trainer, train_sec, iterations)
+
+    # MEASURED roofline (VERDICT r3 item 4): profile ONE alternation
+    # under the JAX profiler (the PIO_PROFILE_DIR hook's machinery),
+    # parse the xplane trace in a subprocess (per-category device time,
+    # XLA cost-model flops + HBM-space bytes), and measure the
+    # governing resource empirically — the claim is gather-ISSUE-bound
+    # (ops/als.py), so the roof is a pure gather+mask kernel at the
+    # real shapes, and the fraction is train slots/s over roof slots/s.
+    import jax
+
+    prof_dir = os.environ.get("PIO_PROFILE_DIR",
+                              os.path.join(base_dir, "train_profile"))
+    t0 = time.perf_counter()
+    with jax.profiler.trace(prof_dir):
+        trainer.step_n(1)
+    profiled_step_sec = time.perf_counter() - t0
+    roof = trainer.measure_gather_roof()
+    trace = {}
+    try:
+        proc = subprocess.run(
+            [sys.executable, sys.argv[0] if sys.argv[0].endswith(".py")
+             else os.path.abspath(__file__),
+             "--stage", "parse_profile", "--base", prof_dir],
+            capture_output=True, text=True, timeout=600,
+        )
+        lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+        trace = json.loads(lines[-1]) if lines else {
+            "error": f"parse rc={proc.returncode}: {proc.stderr[-300:]}"}
+    except Exception as e:  # noqa: BLE001 — measurement must not fail bench
+        trace = {"error": str(e)}
+    train_slots_per_sec = roof["slots_per_iteration"] / profiled_step_sec
+    governing_fraction = train_slots_per_sec / roof["roof_slots_per_sec"]
+    measured = {
+        "measured": True,
+        "governing": "gather-issue",
+        "profiled_step_sec": round(profiled_step_sec, 3),
+        "train_slots_per_sec": round(train_slots_per_sec / 1e9, 3),
+        "gather_roof_slots_per_sec": round(
+            roof["roof_slots_per_sec"] / 1e9, 3),
+        "slots_unit": "Gslots/s (one slot = one gathered K-vector row)",
+        "governing_fraction": round(governing_fraction, 3),
+        "trace": trace,
+    }
+    if trace.get("hbm_bytes_total"):
+        measured["achieved_hbm_gb_per_sec_traced"] = round(
+            trace["hbm_bytes_total"] / trace["device_time_sec"] / 1e9, 1)
+        measured["hbm_fraction_traced"] = round(
+            trace["hbm_bytes_total"] / trace["device_time_sec"]
+            / V5E_PEAK_HBM_BYTES, 3)
+    detail["roofline"]["measured"] = measured
     # release the trainer's HBM before the serving deployment compiles
     del trainer
 
@@ -584,6 +861,7 @@ def orchestrate():
         detail["warm"] = stages["warm"]
         gates = (detail["rmse_gate_passed"] and detail["rmse_band_passed"]
                  and detail["serve_gate_passed"]
+                 and detail["serve_32_gate_passed"]
                  and detail["row_lane_gate_passed"])
         value = detail.pop("updates_per_sec") if gates else 0.0
         detail["baseline_proxy"] = {
@@ -607,7 +885,8 @@ def orchestrate():
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--stage", choices=["cold", "warm"])
+    parser.add_argument("--stage",
+                        choices=["cold", "warm", "parse_profile", "loadgen"])
     parser.add_argument("--base")
     parser.add_argument("--out")
     args = parser.parse_args()
@@ -615,6 +894,10 @@ def main() -> None:
         stage_cold(args.base, args.out)
     elif args.stage == "warm":
         stage_warm(args.base, args.out)
+    elif args.stage == "parse_profile":
+        _parse_train_profile(args.base)
+    elif args.stage == "loadgen":
+        stage_loadgen(args.base)
     else:
         orchestrate()
 

@@ -50,7 +50,8 @@ class FastEvalEngineWorkflow:
         self.model_cache: Dict[str, Any] = {}
         self.predict_cache: Dict[str, Any] = {}
         # instrumentation for tests + cache-hit logging
-        self.counts = {"read": 0, "prepare": 0, "train": 0, "predict": 0}
+        self.counts = {"read": 0, "prepare": 0, "train": 0, "predict": 0,
+                       "grid_dispatches": 0}
 
     # -- stages -------------------------------------------------------------
     def _eval_data(self, ep: EngineParams):
@@ -108,6 +109,60 @@ class FastEvalEngineWorkflow:
                 per_fold.append(dict(algo.batch_predict(model, indexed)))
             self.predict_cache[key] = per_fold
         return self.predict_cache[key]
+
+    def prefetch_grid(self, engine_params_list) -> int:
+        """Vmapped grid tuning (ref role: MetricEvaluator over
+        engineParamsList, MetricEvaluator.scala:177): when every
+        candidate shares the DASE prefix and differs only inside ONE
+        algorithm slot whose class offers ``grid_train`` (e.g. ALS reg
+        sweeps), all candidates' models are trained in a single
+        compiled dispatch per fold and seeded into the model cache —
+        the per-candidate eval path then scores them without ever
+        calling train. Returns the number of candidates grid-trained
+        (0 = shape did not apply; the sequential path runs as before).
+        Leaderboard, ranking and best.json are unchanged either way."""
+        eps = list(engine_params_list)
+        if len(eps) < 2:
+            return 0
+        base = eps[0]
+        prefix = _key(_slot_key(base.data_source_params),
+                      _slot_key(base.preparator_params),
+                      _slot_key(base.serving_params))
+        for ep in eps:
+            if (_key(_slot_key(ep.data_source_params),
+                     _slot_key(ep.preparator_params),
+                     _slot_key(ep.serving_params)) != prefix
+                    or len(ep.algorithm_params_list) != 1
+                    or ep.algorithm_params_list[0][0]
+                    != base.algorithm_params_list[0][0]):
+                return 0
+        name = base.algorithm_params_list[0][0]
+        hook = getattr(self.engine.algorithm_classes[name], "grid_train", None)
+        if hook is None:
+            return 0
+        params_list = [ep.algorithm_params_list[0][1] for ep in eps]
+        folds = self._prepared(base)
+        per_fold_models = []
+        for pd, _ei, _qa in folds:
+            models = hook(self.ctx, pd, params_list)
+            if models is None:
+                return 0  # shape inapplicable (params differ beyond the
+                # grid scalar, or a sharded mesh): sequential path
+            self.counts["grid_dispatches"] += 1
+            per_fold_models.append(models)
+        for ci, ep in enumerate(eps):
+            key = _key(
+                _slot_key(ep.data_source_params),
+                _slot_key(ep.preparator_params),
+                _slot_key(ep.algorithm_params_list[0]),
+            )
+            self.model_cache[key] = [fold[ci] for fold in per_fold_models]
+        log.info(
+            "grid tuning: %d candidates trained in %d dispatch(es) "
+            "(one vmapped compile instead of %d sequential trains)",
+            len(eps), self.counts["grid_dispatches"],
+            len(eps) * len(folds))
+        return len(eps)
 
     # -- public -------------------------------------------------------------
     def eval(self, ep: EngineParams):

@@ -410,7 +410,11 @@ class EventLogEventStore(S.EventStore):
             if rc == -2:
                 raise JsonRowsUnsupported()
             if rc == -3:
-                raise S.StorageError("malformed JSON event array")
+                # a CLIENT error (the Python lane's json.loads would
+                # refuse the body too) — ValueError so callers can map
+                # it to 400 while I/O failures (StorageError below)
+                # stay 500-shaped
+                raise ValueError("malformed JSON event array")
             if rc == -4:
                 n = out_n.value
                 code = ctypes.string_at(out_codes, n)[-1] if out_codes else 0

@@ -185,6 +185,61 @@ class ALSAlgorithm(Algorithm):
         )
         return ALSModel(factors, pd.user_ids, pd.item_ids)
 
+    @classmethod
+    def grid_train(
+        cls,
+        ctx: MeshContext,
+        pd: PreparedRatings,
+        params_list: Sequence["ALSParams"],
+    ) -> Optional[List[ALSModel]]:
+        """Train EVERY candidate in ONE compiled dispatch when the
+        candidates differ only in the regularization scalar — the
+        vmapped tuning path (ops.als.als_grid_train) behind
+        MetricEvaluator (VERDICT r3 item 5; reference role:
+        MetricEvaluator over engineParamsList,
+        controller/MetricEvaluator.scala:177, which trains G times).
+
+        Returns one model per candidate, or None when the grid shape
+        does not apply (params differing beyond lambda_, or a
+        multi-device mesh — the grid axis occupies the batch dimension,
+        so sharded data training keeps the sequential path)."""
+        if len(params_list) < 2:
+            return None
+        base = params_list[0]
+        for p in params_list:
+            if not isinstance(p, ALSParams):
+                return None
+            a, b = dict(vars(p)), dict(vars(base))
+            a.pop("lambda_"), b.pop("lambda_")
+            if a != b:
+                return None
+        if (base.max_ratings_per_user is not None
+                or base.max_ratings_per_item is not None):
+            # als_grid_train builds its sides uncapped; silently
+            # training different data than the sequential path would is
+            # exactly the kind of divergence grid tuning must not have
+            # (code-review regression) — sequential path instead
+            return None
+        if ctx.mesh is not None and np.prod(
+                [ctx.mesh.shape[a] for a in ctx.mesh.axis_names]) > 1:
+            return None
+        from predictionio_tpu.ops.als import als_grid_train
+
+        cfg = ALSConfig(
+            rank=base.rank, iterations=base.num_iterations,
+            implicit=base.implicit_prefs, alpha=base.alpha,
+            block_size=base.block_size, seed=base.seed,
+            seg_len=base.seg_len, solver=base.solver,
+            cg_iters=base.cg_iters, cg_dtype=base.cg_dtype,
+            compute_dtype=base.compute_dtype,
+        )
+        factors_list = als_grid_train(
+            (pd.user_idx, pd.item_idx, pd.ratings),
+            pd.n_users, pd.n_items, cfg,
+            regs=[p.lambda_ for p in params_list],
+        )
+        return [ALSModel(f, pd.user_ids, pd.item_ids) for f in factors_list]
+
     def load_persistent_model(self, persisted: ALSModel, ctx: MeshContext) -> ALSModel:
         """Re-enable sharded serving after unpickle when the model was
         trained with it (the mesh never pickles; rebuild from ctx)."""

@@ -1136,49 +1136,94 @@ bool get_string(JsonCur& c, std::string* out) {
   return unescape(raw, out);
 }
 
-// skip (and optionally capture the raw slice of) any JSON value
-bool skip_value(JsonCur& c, std::string_view* raw_out) {
-  if (!c.ws()) return false;
+// Skip (and optionally capture the raw slice of) any JSON value —
+// STRICT grammar: captured slices are stored verbatim in the record's
+// extra blob and re-parsed by json.loads on every read, so anything
+// json.loads would reject must be rejected HERE (a stored malformed
+// slice would poison every later read of the app — code-review
+// regression: the old joint-depth scan accepted '[}' and 'truex').
+bool skip_value(JsonCur& c, std::string_view* raw_out, int depth = 0) {
+  if (depth > 64 || !c.ws()) return false;  // recursion bound
   const char* s = c.p;
   char ch = *c.p;
   if (ch == '"') {
     std::string_view sv;
     bool e;
     if (!scan_quoted(c, &sv, &e)) return false;
-  } else if (ch == '{' || ch == '[') {
-    // joint depth over both container kinds: for well-formed JSON the
-    // matching close is where the joint depth returns to zero, and the
-    // caller only ever appends after the WHOLE body parsed cleanly, so
-    // a malformed slice can never be stored
-    int depth = 0;
-    while (c.p < c.end) {
-      char d = *c.p;
-      if (d == '"') {
-        std::string_view sv;
-        bool e;
-        if (!scan_quoted(c, &sv, &e)) return false;
-        continue;
-      }
-      if (d == '{' || d == '[') {
-        ++depth;
+  } else if (ch == '{') {
+    ++c.p;
+    bool first = true;
+    while (true) {
+      if (!c.ws()) return false;
+      if (*c.p == '}') {
         ++c.p;
-        continue;
+        break;
       }
-      if (d == '}' || d == ']') {
-        --depth;
+      if (!first) {
+        if (*c.p != ',') return false;
         ++c.p;
-        if (depth == 0) break;
-        continue;
+        if (!c.ws()) return false;
       }
-      ++c.p;
+      first = false;
+      std::string_view k;
+      bool e;
+      if (!scan_quoted(c, &k, &e)) return false;
+      if (!c.lit(':')) return false;
+      if (!skip_value(c, nullptr, depth + 1)) return false;
     }
-    if (depth != 0) return false;
+  } else if (ch == '[') {
+    ++c.p;
+    bool first = true;
+    while (true) {
+      if (!c.ws()) return false;
+      if (*c.p == ']') {
+        ++c.p;
+        break;
+      }
+      if (!first) {
+        if (*c.p != ',') return false;
+        ++c.p;
+      }
+      first = false;
+      if (!skip_value(c, nullptr, depth + 1)) return false;
+    }
+  } else if (ch == 't') {
+    if (c.end - c.p < 4 || memcmp(c.p, "true", 4) != 0) return false;
+    c.p += 4;
+  } else if (ch == 'f') {
+    if (c.end - c.p < 5 || memcmp(c.p, "false", 5) != 0) return false;
+    c.p += 5;
+  } else if (ch == 'n') {
+    if (c.end - c.p < 4 || memcmp(c.p, "null", 4) != 0) return false;
+    c.p += 4;
   } else {
-    // number / true / false / null
-    while (c.p < c.end && *c.p != ',' && *c.p != '}' && *c.p != ']' &&
-           *c.p != ' ' && *c.p != '\t' && *c.p != '\n' && *c.p != '\r')
+    // number: -?int frac? exp? (RFC 8259)
+    if (ch == '-') ++c.p;
+    if (c.p >= c.end || *c.p < '0' || *c.p > '9') return false;
+    if (*c.p == '0') {
       ++c.p;
-    if (c.p == s) return false;
+    } else {
+      while (c.p < c.end && *c.p >= '0' && *c.p <= '9') ++c.p;
+    }
+    if (c.p < c.end && *c.p == '.') {
+      ++c.p;
+      if (c.p >= c.end || *c.p < '0' || *c.p > '9') return false;
+      while (c.p < c.end && *c.p >= '0' && *c.p <= '9') ++c.p;
+    }
+    if (c.p < c.end && (*c.p == 'e' || *c.p == 'E')) {
+      ++c.p;
+      if (c.p < c.end && (*c.p == '+' || *c.p == '-')) ++c.p;
+      if (c.p >= c.end || *c.p < '0' || *c.p > '9') return false;
+      while (c.p < c.end && *c.p >= '0' && *c.p <= '9') ++c.p;
+    }
+  }
+  // a value must terminate at a structural boundary, never run into
+  // trailing junk ('truex', '1.5abc')
+  if (c.p < c.end) {
+    char t = *c.p;
+    if (t != ',' && t != '}' && t != ']' && t != ' ' && t != '\t' &&
+        t != '\n' && t != '\r')
+      return false;
   }
   if (raw_out) *raw_out = std::string_view(s, static_cast<size_t>(c.p - s));
   return true;

@@ -58,10 +58,33 @@ def _paths(key: str) -> Tuple[str, str]:
     return os.path.join(d, f"{key}.npz"), os.path.join(d, f"{key}.json")
 
 
+def _prune(keep: int) -> None:
+    """Keep only the ``keep`` most-recently-used entries: fingerprints
+    never repeat once the data changes, so without eviction a retrain
+    loop would grow the cache without bound (code-review regression).
+    LRU by npz mtime (load() touches it)."""
+    try:
+        entries = sorted(
+            (f for f in os.listdir(cache_dir()) if f.endswith(".npz")),
+            key=lambda f: os.path.getmtime(os.path.join(cache_dir(), f)),
+            reverse=True,
+        )
+    except OSError:
+        return
+    for stale in entries[keep:]:
+        for path in (os.path.join(cache_dir(), stale),
+                     os.path.join(cache_dir(), stale[:-4] + ".json")):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+
 def save(key: str, arrays: Dict[str, np.ndarray],
          meta: Dict[str, Any]) -> None:
     """Atomic write (tmp + rename) so a crashed save never leaves a
-    half-written layout a later load would trust."""
+    half-written layout a later load would trust. After the write, the
+    cache is pruned to ``PIO_BIN_CACHE_KEEP`` entries (default 4)."""
     npz_path, meta_path = _paths(key)
     os.makedirs(cache_dir(), exist_ok=True)
     try:
@@ -75,6 +98,7 @@ def save(key: str, arrays: Dict[str, np.ndarray],
         os.replace(tmp, meta_path)
     except OSError as e:  # a full disk must not fail the training run
         log.warning("bin-cache save failed (%s) — continuing uncached", e)
+    _prune(max(1, int(os.environ.get("PIO_BIN_CACHE_KEEP", "4"))))
 
 
 def load(key: str) -> Optional[Tuple[Dict[str, np.ndarray], Dict[str, Any]]]:
@@ -84,6 +108,7 @@ def load(key: str) -> Optional[Tuple[Dict[str, np.ndarray], Dict[str, Any]]]:
             meta = json.load(f)
         data = np.load(npz_path)
         arrays = {k: data[k] for k in data.files}
+        os.utime(npz_path)  # LRU touch for _prune
         return arrays, meta
     except (OSError, ValueError, KeyError):
         return None

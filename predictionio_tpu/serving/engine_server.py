@@ -110,6 +110,11 @@ class MicroBatcher:
         self._run_one = run_one
         self._max_batch = max_batch
         self._queue: "_queue.Queue[_Pending]" = _queue.Queue()
+        # batch-size histogram: the observable proof that amortization
+        # actually happens under load (VERDICT r3 item 6) — exposed in
+        # the server's status JSON
+        self._hist_lock = threading.Lock()
+        self._hist: dict = {}
         self._stop = False
         # orders submit()'s stop-check+enqueue against stop()'s flag+wake,
         # so nothing can be enqueued after the worker's shutdown drain
@@ -170,10 +175,24 @@ class MicroBatcher:
                 p.error = RuntimeError("serving batcher stopped")
                 p.event.set()
 
+    def histogram(self) -> dict:
+        """Dispatch-size distribution since start: {"1": lone requests,
+        "2": two-query dispatches, ...}. Sizes > 1 are queries that
+        shared one device dispatch."""
+        with self._hist_lock:
+            hist = {str(k): v for k, v in sorted(self._hist.items())}
+        return {
+            "maxBatch": self._max_batch,
+            "dispatches": sum(hist.values()),
+            "batchSizeHistogram": hist,
+        }
+
     def _answer(self, batch) -> None:
         batch = [p for p in batch if not p.abandoned]
         if not batch:
             return
+        with self._hist_lock:
+            self._hist[len(batch)] = self._hist.get(len(batch), 0) + 1
         if len(batch) == 1:
             p = batch[0]
             try:
@@ -380,6 +399,10 @@ class EngineServer(HTTPServerBase):
             "trainedAt": instance.end_time.isoformat(),
             "algorithms": json.loads(instance.algorithms_params or "[]"),
             "stats": self.stats.snapshot(),
+            # micro-batching evidence: dispatch-size distribution
+            # (None when micro-batching is disabled)
+            "batcher": (self._batcher.histogram()
+                        if self._batcher is not None else None),
         }
 
 

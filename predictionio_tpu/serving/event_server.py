@@ -134,8 +134,13 @@ class EventServerCore:
                     raw_body, auth.app_id, auth.channel_id, strict=False)
             except JsonRowsUnsupported:
                 pass  # the Python path below accepts more shapes
+            except ValueError as e:
+                return 400, {"message": str(e)}  # malformed body
             except StorageError as e:
-                return 400, {"message": str(e)}
+                # an append I/O failure is a SERVER fault: a 400 would
+                # make SDKs drop the events as permanently bad instead
+                # of retrying (code-review regression)
+                return 500, {"message": str(e)}
             else:
                 results = []
                 for eid, code, name, etype in zip(ids, codes, names, etypes):

@@ -95,6 +95,9 @@ def run_evaluation(
             from predictionio_tpu.core.fast_eval import FastEvalEngineWorkflow
 
             workflow = FastEvalEngineWorkflow(evaluation.engine, ctx)
+            # reg-style scalar sweeps train every candidate in ONE
+            # vmapped dispatch per fold (Algorithm.grid_train hook)
+            workflow.prefetch_grid(engine_params_list)
             eval_fn = lambda c, ep: workflow.eval(ep)
 
         result = evaluator.evaluate(

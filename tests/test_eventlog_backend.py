@@ -782,9 +782,28 @@ def test_json_lane_calendar_and_encoding_parity(tmp_path):
     # too), nothing appended
     bad = b'[{"event":"a\xff","entityType":"u","entityId":"u1"}]'
     n_before = len(st.events().find(1))
-    with pytest.raises(StorageError, match="malformed"):
+    with pytest.raises(ValueError, match="malformed"):
         st.events().insert_json_batch(bad, 1, strict=False)
     assert len(st.events().find(1)) == n_before
+
+    # STRICT value grammar (code-review regression): mismatched
+    # brackets and trailing-junk literals json.loads would reject must
+    # never be stored (a poison extra slice breaks every later read)
+    for poison in (
+        b'[{"event":"e","entityType":"u","entityId":"x","tags":[}]}]',
+        b'[{"event":"e","entityType":"u","entityId":"x",'
+        b'"properties":{"a":truex}}]',
+        b'[{"event":"e","entityType":"u","entityId":"x",'
+        b'"properties":{"a":1.5abc}}]',
+        b'[{"event":"e","entityType":"u","entityId":"x",'
+        b'"properties":{"a":[1,{]}}}]',
+    ):
+        with pytest.raises((ValueError, JsonRowsUnsupported)):
+            st.events().insert_json_batch(poison, 1, strict=False)
+    assert len(st.events().find(1)) == n_before
+    # every stored record still parses
+    for e in st.events().find(1):
+        e.properties.to_dict()
 
     # an escaped NUL inside a name would desync the NUL-joined stats
     # buffers: Python path instead
