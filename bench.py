@@ -465,6 +465,11 @@ def _serve_stage(storage, factors, pd, cfg, detail):
         # client would see).
         import tempfile as _tf
 
+        # snapshot the cumulative histogram so the evidence below is
+        # the SATURATION stage's own dispatches, not batches the 4-conn
+        # stage already formed (code-review regression)
+        hist_before = (server._batcher.histogram()["batchSizeHistogram"]
+                       if server._batcher else {})
         with _tf.NamedTemporaryFile("w", suffix=".json", delete=False) as uf:
             json.dump(users, uf)
             users_file = uf.name
@@ -485,14 +490,18 @@ def _serve_stage(storage, factors, pd, cfg, detail):
             assert load["errors"] == 0, load
         finally:
             os.unlink(users_file)
-        hist = server._batcher.histogram() if server._batcher else {}
-        batched = sum(v for k, v in
-                      hist.get("batchSizeHistogram", {}).items()
-                      if int(k) > 1)
+        hist_after = (server._batcher.histogram()["batchSizeHistogram"]
+                      if server._batcher else {})
+        stage_hist = {
+            k: hist_after.get(k, 0) - hist_before.get(k, 0)
+            for k in hist_after
+            if hist_after.get(k, 0) - hist_before.get(k, 0) > 0
+        }
+        batched = sum(v for k, v in stage_hist.items() if int(k) > 1)
         detail["serve_qps_32conn"] = load["qps"]
         detail["serve_p50_ms_32conn"] = load["p50_ms"]
         detail["serve_p99_ms_32conn"] = load["p99_ms"]
-        detail["serve_batch_histogram"] = hist.get("batchSizeHistogram", {})
+        detail["serve_batch_histogram"] = stage_hist
         detail["serve_32_gate_passed"] = bool(
             load["p99_ms"] < 25.0 and batched > 0)
     finally:

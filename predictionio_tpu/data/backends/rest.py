@@ -74,13 +74,20 @@ class _Transport:
 
     def _error(self, path: str, e: urllib.error.HTTPError) -> S.StorageError:
         payload = e.read()
+        error_type = None
         try:
-            message = json.loads(payload).get("message", payload.decode())
+            body = json.loads(payload)
+            message = body.get("message", payload.decode())
+            error_type = body.get("type")
         except Exception:  # noqa: BLE001 — raw body is the best we have
             message = payload.decode(errors="replace")
-        return S.StorageError(
+        err = S.StorageError(
             f"storage server {self.base_url}{path}: HTTP {e.code}: {message}"
         )
+        # structured discriminator (the server's "type" field) so
+        # callers can re-map client errors without grepping messages
+        err.error_type = error_type
+        return err
 
     def _sleep_backoff(self, attempt: int) -> None:
         time.sleep(self.backoff * (2 ** attempt) * (1 + random.random()))
@@ -204,6 +211,41 @@ class RestEventStore(S.EventStore):
         out = self._call("insert_batch", app_id, channel_id,
                          events=[e.to_dict(api_format=False) for e in events])
         return out["eventIds"]
+
+    def insert_json_batch(self, raw: bytes, app_id, channel_id=None, *,
+                          strict: bool = True):
+        """Forward the RAW API-format JSON array to the storage
+        server's native encoder (/storage/events/insert_json) — the
+        event server's batch route then has zero per-row Python on
+        either host. Raises JsonRowsUnsupported when the server's
+        backend has no native lane (or declines the shape), so callers
+        fall back to the per-row wire path. Same return contract as
+        EventLogEventStore.insert_json_batch."""
+        from urllib.parse import urlencode
+
+        from predictionio_tpu.data.backends.eventlog import (
+            JsonRowsUnsupported,
+        )
+
+        params = {"app_id": int(app_id), "strict": "1" if strict else "0"}
+        if channel_id is not None:
+            params["channel_id"] = int(channel_id)
+        try:
+            status, body = self._t.request(
+                "/storage/events/insert_json?" + urlencode(params), raw)
+        except S.StorageError as e:
+            if "unknown route" in str(e):
+                raise JsonRowsUnsupported() from None  # older server
+            if getattr(e, "error_type", None) == "ValueError":
+                # the server's structured discriminator: a CLIENT error
+                # (malformed body) — re-raise as ValueError so the
+                # batch route answers 400, not 500
+                raise ValueError(str(e)) from None
+            raise
+        out = json.loads(body)
+        if out.get("unsupported"):
+            raise JsonRowsUnsupported()
+        return out["ids"], out["codes"], out["names"], out["etypes"]
 
     def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
         out = self._call("get", app_id, channel_id, event_id=event_id,

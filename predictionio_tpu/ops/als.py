@@ -133,14 +133,14 @@ def _batched_cg(A, b, iters: int, x0=None, matvec_dtype=jnp.float32):
     return x
 
 
-#: uint8 value-code reserved for padded slots (compress_side): the
-#: decode table maps it to 0.0 and the mask derives as ``code != 255``
+#: uint8 value-code reserved for padded slots (compress_side); the
+#: mask derives as ``code != 255``
 PAD_CODE = 255
 
 
 def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
                  alpha, row_block, group_block, groups_loc, solver, cg_iters,
-                 cg_dtype, compute_dtype, val_table=None):
+                 cg_dtype, compute_dtype, val_affine=None):
     """Solve all groups of one shard from segmented virtual rows.
 
     Three stages, all static-shape:
@@ -156,11 +156,14 @@ def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
       3. batched regularized solve per group block (CG warm-started
          from the previous iteration's factors).
 
-    With ``val_table`` (the compressed layout, compress_side): ``val``
-    carries uint8 dictionary codes, ``mask`` is None — the slot value
-    decodes as ``val_table[code]`` and the mask as ``code != PAD_CODE``,
-    collapsing the val+mask HBM/transfer streams (8 bytes/slot) into
-    one byte.
+    With ``val_affine=(a, b)`` (the compressed layout, compress_side):
+    ``val`` carries uint8 codes, ``mask`` is None — the slot value
+    decodes as ``a + b*code`` (one VPU multiply-add; a table GATHER
+    here would double the gather issue the stage is bound by) and the
+    mask as ``code != PAD_CODE``, collapsing the val+mask HBM/transfer
+    streams (8 bytes/slot) into one byte. Pad slots decode to a+255b,
+    which is safe: every consumer multiplies by the mask (through the
+    zeroed Yg rows or explicitly).
     """
     R_loc, L = idx.shape
     nrb = R_loc // row_block
@@ -169,11 +172,12 @@ def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
     Yc = Y.astype(cdt)
 
     def partial_block(args):
-        if val_table is None:
+        if val_affine is None:
             idx_b, val_b, mask_b = args
         else:
             idx_b, code_b = args
-            val_b = val_table[code_b]            # [B, L] f32; pad -> 0.0
+            a, b = val_affine
+            val_b = a + b * code_b.astype(jnp.float32)  # VPU, no gather
             mask_b = code_b != PAD_CODE
         maskc = mask_b.astype(cdt)
         Yg = Yc[idx_b] * maskc[..., None]  # [B, L, K] pad slots zeroed
@@ -194,7 +198,7 @@ def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
                              preferred_element_type=f32)
         return A_r, b_r
 
-    if val_table is None:
+    if val_affine is None:
         operands = (idx.reshape(nrb, row_block, L),
                     val.reshape(nrb, row_block, L),
                     mask.reshape(nrb, row_block, L))
@@ -249,28 +253,28 @@ def _solve_groups(Ar, br, X_prev, seg, counts, Yc, *, rank, reg, implicit,
 
 def make_half_step(mesh: Optional[Mesh], cfg: ALSConfig, row_block: int,
                    group_block: int, groups_loc: int,
-                   val_table: Optional[np.ndarray] = None):
+                   val_affine=None):
     """Compile one ALS half-step, sharded over the mesh ``data`` axis.
 
-    ``val_table`` switches the step to the compressed layout: the
+    ``val_affine`` switches the step to the compressed layout: the
     positional args become (Y, X_prev, idx, codes, seg, counts) — no
-    mask stream — with the tiny decode table baked in as a constant."""
+    mask stream — with the affine decode constants baked in."""
     kwargs = dict(
         rank=cfg.rank, reg=cfg.reg, implicit=cfg.implicit, alpha=cfg.alpha,
         row_block=row_block, group_block=group_block, groups_loc=groups_loc,
         solver=cfg.solver, cg_iters=cfg.cg_iters, cg_dtype=cfg.cg_dtype,
         compute_dtype=cfg.compute_dtype,
     )
-    if val_table is None:
+    if val_affine is None:
         fn = functools.partial(_solve_shard, **kwargs)
         in_specs = (P(), P("data", None), P("data", None), P("data", None),
                     P("data", None), P("data"), P("data"))
     else:
-        table = jnp.asarray(val_table, jnp.float32)
+        ab = (float(val_affine[0]), float(val_affine[1]))
 
         def fn(Y, X_prev, idx, codes, seg, counts):
             return _solve_shard(Y, X_prev, idx, codes, None, seg, counts,
-                                val_table=table, **kwargs)
+                                val_affine=ab, **kwargs)
 
         in_specs = (P(), P("data", None), P("data", None), P("data", None),
                     P("data"), P("data"))
@@ -324,19 +328,21 @@ class SideLayout:
 
     The host->device transfer is the dominant one-time cost on a
     tunneled chip (BENCH_r03: 23-36 s), so the wire layout is shrunk
-    before the put: indexes drop to int16 when the opposing vocabulary
-    fits, and when the ratings take <= 255 distinct values (explicit
-    feedback: 10 half-star steps) the val+mask float streams (8 B/slot)
-    collapse into ONE uint8 dictionary code (table[code] decodes on
-    device, code 255 = padded slot). ML-20M: 9 -> 3 bytes/slot on the
-    user side, 9 -> 5 on the item side."""
+    before the put: when the ratings form an exact affine ladder of
+    <= 255 distinct values (explicit feedback: half-star steps) the
+    val+mask float streams (8 B/slot) collapse into ONE uint8 code
+    (a + b*code decodes on the VPU, code 255 = padded slot) — 9 -> 5
+    bytes/slot at ML-20M shapes, and measured FASTER per step than the
+    f32 streams (less HBM read). Indexes stay int32: an int16 variant
+    saved another 2 B/slot but cost ~12% step time (the gather pays an
+    int16->s32 conversion), and the train step is the headline."""
 
-    idx: np.ndarray               # [R, L] int16 | int32
+    idx: np.ndarray               # [R, L] int32
     val: np.ndarray               # [R, L] uint8 codes | float32
     mask: Optional[np.ndarray]    # [R, L] uint8, None when val is coded
     seg: np.ndarray               # [R] int32
     counts: np.ndarray            # [G] int32
-    table: Optional[np.ndarray]   # [256] float32 decode table
+    affine: Optional[tuple]       # (a, b): value = a + b*code, VPU decode
     row_block: int
     group_block: int
     groups_per_shard: int
@@ -363,17 +369,16 @@ class SideLayout:
                f"{prefix}seg": self.seg, f"{prefix}counts": self.counts}
         if self.mask is not None:
             out[f"{prefix}mask"] = self.mask
-        if self.table is not None:
-            out[f"{prefix}table"] = self.table
         return out
 
     @classmethod
     def from_arrays(cls, arrays: dict, prefix: str, meta: dict) -> "SideLayout":
+        affine = meta.get(f"{prefix}affine")
         return cls(
             idx=arrays[f"{prefix}idx"], val=arrays[f"{prefix}val"],
             mask=arrays.get(f"{prefix}mask"), seg=arrays[f"{prefix}seg"],
             counts=arrays[f"{prefix}counts"],
-            table=arrays.get(f"{prefix}table"),
+            affine=tuple(affine) if affine is not None else None,
             row_block=int(meta[f"{prefix}row_block"]),
             group_block=int(meta[f"{prefix}group_block"]),
             groups_per_shard=int(meta[f"{prefix}groups_per_shard"]),
@@ -383,32 +388,54 @@ class SideLayout:
     def meta(self, prefix: str) -> dict:
         return {f"{prefix}row_block": self.row_block,
                 f"{prefix}group_block": self.group_block,
-                f"{prefix}groups_per_shard": self.groups_per_shard}
+                f"{prefix}groups_per_shard": self.groups_per_shard,
+                f"{prefix}affine": (list(self.affine)
+                                    if self.affine is not None else None)}
 
 
 def compress_side(sg: SegmentedGroups, n_opposing: int) -> SideLayout:
-    """Shrink one side's arrays for the wire (see SideLayout)."""
-    idx = (sg.idx.astype(np.int16)
-           if n_opposing <= np.iinfo(np.int16).max else sg.idx)
+    """Shrink one side's arrays for the wire (see SideLayout).
+
+    Value coding engages only when the distinct values form an exact
+    AFFINE ladder (``uniq[k] == a + b*k`` — explicit-feedback half-star
+    ratings do): the device then decodes with one multiply-add on the
+    VPU instead of a 256-entry table GATHER. The stage is
+    gather-issue-bound, so a table lookup would ADD a second gather per
+    slot and give back the transfer win as train time (measured ~2x
+    step regression with the table form). Non-affine value sets stay
+    float32 + mask. ``n_opposing`` is unused since the int16-index
+    variant was dropped (12% step-time cost); kept for API stability."""
+    idx = sg.idx
     # cheap distinct-count probe (first 256k ELEMENTS of the flattened
     # array) before committing to the full 20M-element unique
     probe = np.unique(sg.val.reshape(-1)[:1 << 18])
-    table = None
     if len(probe) <= PAD_CODE:
-        uniq = np.unique(sg.val)
-        if len(uniq) <= PAD_CODE:  # 0..254 real codes; 255 reserved
-            codes = np.searchsorted(uniq, sg.val).astype(np.uint8)
+        # pads are coded 255 regardless, so their 0.0 filler must NOT
+        # join the codebook (it would break the affine ladder for any
+        # rating scale that does not start at 0)
+        uniq = np.unique(sg.val[sg.mask != 0])
+        n = len(uniq)
+        affine = None
+        if n == 1:
+            affine = (float(uniq[0]), 0.0)
+        elif 2 <= n <= PAD_CODE:
+            a, b = float(uniq[0]), float(uniq[1] - uniq[0])
+            if b != 0.0 and np.array_equal(
+                    uniq, np.float32(a) + np.float32(b)
+                    * np.arange(n, dtype=np.float32)):
+                affine = (a, b)
+        if affine is not None:
+            codes = np.searchsorted(
+                uniq, sg.val).clip(0, n - 1).astype(np.uint8)
             codes[sg.mask == 0] = PAD_CODE
-            table = np.zeros(256, np.float32)
-            table[:len(uniq)] = uniq
             return SideLayout(
                 idx=idx, val=codes, mask=None, seg=sg.seg,
-                counts=sg.counts, table=table,
+                counts=sg.counts, affine=affine,
                 row_block=sg.row_block, group_block=sg.group_block,
                 groups_per_shard=sg.groups_per_shard, n_shards=sg.n_shards)
     return SideLayout(
         idx=idx, val=sg.val, mask=sg.mask.astype(np.uint8), seg=sg.seg,
-        counts=sg.counts, table=None,
+        counts=sg.counts, affine=None,
         row_block=sg.row_block, group_block=sg.group_block,
         groups_per_shard=sg.groups_per_shard, n_shards=sg.n_shards)
 
@@ -517,7 +544,7 @@ class ALSTrainer:
                                + item_side.transfer_bytes)
         self._slot_bytes = (user_side.slot_bytes, item_side.slot_bytes)
         self._user_row_block = user_side.row_block
-        self._user_table = user_side.table  # measure_gather_roof
+        self._user_affine = user_side.affine  # measure_gather_roof
 
         key = jax.random.PRNGKey(cfg.seed)
         ku, ki = jax.random.split(key)
@@ -526,11 +553,11 @@ class ALSTrainer:
 
         self._user_step = make_half_step(
             mesh, cfg, user_side.row_block, user_side.group_block,
-            user_side.groups_per_shard, val_table=user_side.table,
+            user_side.groups_per_shard, val_affine=user_side.affine,
         )
         self._item_step = make_half_step(
             mesh, cfg, item_side.row_block, item_side.group_block,
-            item_side.groups_per_shard, val_table=item_side.table,
+            item_side.groups_per_shard, val_affine=item_side.affine,
         )
         self._run_cache = {}
 
@@ -637,14 +664,14 @@ class ALSTrainer:
         row_block = min(self._user_row_block, R)
         nrb = R // row_block
         cdt = jnp.dtype(self.cfg.compute_dtype)
-        table = self._user_table
+        affine = self._user_affine
 
         def kernel(Y, idx, val):
             Yc = Y.astype(cdt)
 
             def block(args):
                 idx_b, val_b = args
-                if table is not None:
+                if affine is not None:
                     mask_b = (val_b != PAD_CODE).astype(cdt)
                 else:
                     mask_b = val_b  # uncoded: val doubles as a stream read

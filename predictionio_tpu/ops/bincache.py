@@ -6,9 +6,10 @@ the device are a pure function of (event-log content, layout knobs),
 so they are persisted here keyed by the event store's O(1)
 ``data_fingerprint`` (generation + bytes + record/tombstone counts —
 eventlog.cpp el_fingerprint) plus every layout-affecting parameter.
-The cache stores the COMPRESSED device-bound form (int16 indexes,
-uint8 value codes — ops/als.py compress_side), so a warm hit loads a
-fraction of the raw COO bytes and goes straight to device_put.
+The cache stores the COMPRESSED device-bound form (uint8 affine value
+codes folding the val+mask streams — ops/als.py compress_side), so a
+warm hit loads a fraction of the raw COO bytes and goes straight to
+device_put.
 
 Lives next to the persistent XLA compile cache: ``PIO_BIN_CACHE_DIR``
 or ``$PIO_FS_BASEDIR/bin_cache`` (default ``~/.pio_store/bin_cache``).
@@ -29,7 +30,8 @@ import numpy as np
 
 log = logging.getLogger(__name__)
 
-_FORMAT_VERSION = 1  # bump when the stored layout shape changes
+_FORMAT_VERSION = 2  # bump when the stored layout shape changes
+# v2: value coding is affine (a, b in meta), no table array
 
 
 def cache_dir() -> str:

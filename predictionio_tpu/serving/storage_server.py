@@ -216,7 +216,7 @@ _REPO_SPECS: Dict[str, Dict[str, Any]] = {
 
 _EVENT_METHODS = frozenset(
     {"init", "remove", "insert", "insert_batch", "get", "delete", "find",
-     "find_columnar", "insert_columnar", "compact"}
+     "find_columnar", "insert_columnar", "insert_json", "compact"}
 )
 
 
@@ -409,6 +409,39 @@ class StorageRequestHandler(JSONRequestHandler):
         if method not in _EVENT_METHODS:
             return self._send(404, {"message": f"unknown events method {method!r}"})
         store = self.server_ref.storage.events()
+        if method == "insert_json":
+            # the native live lane over the wire: the RAW API-format
+            # JSON array travels untouched from the event server's
+            # socket to this server's local eventlog encoder — no
+            # per-row Python objects on EITHER host. Answers
+            # {"unsupported": true} when the local backend has no
+            # native lane (or declines the payload shape) so the
+            # client falls back to the per-row wire path.
+            from urllib.parse import parse_qs, urlparse
+
+            from predictionio_tpu.data.backends.eventlog import (
+                JsonRowsUnsupported,
+            )
+
+            q = {k: v[0] for k, v in
+                 parse_qs(urlparse(self.path).query).items()}
+            fast = getattr(store, "insert_json_batch", None)
+            raw = self._read_body()
+            if fast is None:
+                return self._send(200, {"unsupported": True})
+            try:
+                ids, codes, names, etypes = fast(
+                    raw, int(q["app_id"]),
+                    int(q["channel_id"]) if q.get("channel_id") else None,
+                    strict=q.get("strict", "1") == "1",
+                )
+            except JsonRowsUnsupported:
+                return self._send(200, {"unsupported": True})
+            except ValueError as e:
+                return self._send(400, {"message": str(e),
+                                        "type": "ValueError"})
+            return self._send(201, {"ids": ids, "codes": codes,
+                                    "names": names, "etypes": etypes})
         if method == "insert_columnar":
             # binary npz body; scalar params ride in the query string
             # (percent-encoded UTF-8 — headers are latin-1-only). The

@@ -96,8 +96,19 @@ def run_evaluation(
 
             workflow = FastEvalEngineWorkflow(evaluation.engine, ctx)
             # reg-style scalar sweeps train every candidate in ONE
-            # vmapped dispatch per fold (Algorithm.grid_train hook)
-            workflow.prefetch_grid(engine_params_list)
+            # vmapped dispatch per fold (Algorithm.grid_train hook).
+            # Best-effort: a failing grid dispatch (e.g. the [G, n, K]
+            # factor tensors OOM where one-at-a-time fits) must fall
+            # back to the sequential path, never abort the evaluation
+            # (prefetch seeds the cache only after ALL folds succeed,
+            # so a failure leaves nothing half-seeded)
+            try:
+                workflow.prefetch_grid(engine_params_list)
+            except Exception as e:  # noqa: BLE001 — sequential fallback
+                log.warning(
+                    "grid tuning dispatch failed (%s: %s) — falling back "
+                    "to sequential candidate evaluation",
+                    type(e).__name__, e)
             eval_fn = lambda c, ep: workflow.eval(ep)
 
         result = evaluator.evaluate(

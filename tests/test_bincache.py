@@ -41,7 +41,7 @@ def test_compressed_layout_trains_identically(monkeypatch):
     def no_compress(sg, n_opposing):
         return SideLayout(
             idx=sg.idx, val=sg.val, mask=sg.mask.astype(np.uint8),
-            seg=sg.seg, counts=sg.counts, table=None,
+            seg=sg.seg, counts=sg.counts, affine=None,
             row_block=sg.row_block, group_block=sg.group_block,
             groups_per_shard=sg.groups_per_shard, n_shards=sg.n_shards)
 
@@ -60,21 +60,26 @@ def test_compression_kicks_in_and_shrinks_the_wire():
 
     side = compress_side(_build_side(u, i, v, users, CFG, 1, None), items)
     assert side.val.dtype == np.uint8 and side.mask is None
-    assert side.idx.dtype == np.int16  # 300 items fit
-    assert side.table is not None
-    # 255 reserved for pads; decode of pads is 0
-    assert side.table[255] == 0.0
-    assert side.slot_bytes == 3  # vs 9 uncompressed
+    assert side.idx.dtype == np.int32  # int16 dropped: 12% step cost
+    # value ladder is 1.0..5.0 in 0.5 steps -> affine; the pads' 0.0
+    # filler stays OUT of the codebook (it would break the ladder)
+    assert side.affine == (1.0, 0.5)
+    assert side.slot_bytes == 5  # vs 9 uncompressed
 
     # >255 distinct values: stays float32 + mask
     v_many = v + np.arange(len(v)) * 1e-6
     side2 = compress_side(_build_side(u, i, v_many, users, CFG, 1, None), items)
     assert side2.val.dtype == np.float32 and side2.mask is not None
-    assert side2.table is None
+    assert side2.affine is None
 
-    # big opposing vocabulary: idx stays int32
-    side3 = compress_side(_build_side(u, i, v, users, CFG, 1, None), 70_000)
-    assert side3.idx.dtype == np.int32
+    # few distinct values but NOT an affine ladder: a table decode
+    # would need a second gather per slot, so it stays float32 + mask
+    v_nonaffine = np.where(v > 3.0, 7.25, v)
+    side3 = compress_side(
+        _build_side(u, i, v_nonaffine, users, CFG, 1, None), items)
+    assert side3.affine is None and side3.val.dtype == np.float32
+
+
 
 
 def test_layout_cache_roundtrip(tmp_path, monkeypatch):

@@ -703,3 +703,65 @@ def test_saturating_load_batches_form_and_p99_bounded(memory_storage):
         assert batched > 0, hist
     finally:
         server.stop()
+
+
+def test_batch_events_native_lane_over_rest_tier(tmp_path):
+    """The native lane END-TO-END across the distributed tier: event
+    server -> rest storage client -> storage server -> native eventlog
+    encoder — the raw JSON array bytes cross both hosts with zero
+    per-row Python anywhere. Non-native backends answer "unsupported"
+    and the event server falls back per-row."""
+    from tests.test_sharded_storage import _client
+    from tests.test_storage import make_storage
+    from predictionio_tpu.serving.storage_server import StorageServer
+
+    backend = make_storage("eventlog", tmp_path)
+    ss = StorageServer(storage=backend, host="127.0.0.1", port=0).start()
+    try:
+        client = _client([ss.port])
+        app = client.apps().insert("wire-app")
+        client.events().init(app.id)
+        key = AccessKey.generate(app.id)
+        client.access_keys().insert(key)
+        es = EventServer(storage=client, host="127.0.0.1", port=0).start()
+        try:
+            _assert_batch_contract(f"http://127.0.0.1:{es.port}", key,
+                                   client, app.id)
+            # the rows really landed on the storage server's backend
+            stored = backend.events().find(app.id)
+            assert sorted(e.entity_id for e in stored
+                          if e.event in ("rate", "view")) == ["u1", "u3"]
+        finally:
+            es.stop()
+    finally:
+        ss.stop()
+        backend.events().close()
+
+
+def test_rest_insert_json_unsupported_backend_falls_back(memory_storage):
+    """A storage server on a backend with no native lane answers
+    "unsupported"; the rest client raises JsonRowsUnsupported and the
+    event server batch route still works via the per-row path."""
+    from tests.test_sharded_storage import _client
+    from predictionio_tpu.data.backends.eventlog import JsonRowsUnsupported
+    from predictionio_tpu.serving.storage_server import StorageServer
+
+    ss = StorageServer(storage=memory_storage, host="127.0.0.1",
+                       port=0).start()
+    try:
+        client = _client([ss.port])
+        app = client.apps().insert("fb-app")
+        client.events().init(app.id)
+        with pytest.raises(JsonRowsUnsupported):
+            client.events().insert_json_batch(
+                json.dumps(BATCH_ROWS[:1]).encode(), app.id)
+        key = AccessKey.generate(app.id)
+        client.access_keys().insert(key)
+        es = EventServer(storage=client, host="127.0.0.1", port=0).start()
+        try:
+            _assert_batch_contract(f"http://127.0.0.1:{es.port}", key,
+                                   client, app.id)
+        finally:
+            es.stop()
+    finally:
+        ss.stop()
