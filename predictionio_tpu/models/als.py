@@ -253,14 +253,20 @@ class ALSAlgorithm(Algorithm):
         return persisted
 
     def warmup(self, model: ALSModel, ctx: MeshContext) -> None:
-        """Pre-compile the default serve buckets (B=1, E=1, k buckets
-        8 and 16) so the first query after deploy/reload answers at
-        warm latency (SURVEY.md §7.5 hard part #2)."""
+        """Pre-warm the serve path so the first queries after
+        deploy/reload answer at steady-state latency (SURVEY.md §7.5
+        hard part #2): k buckets 8 and 16 at B=1, then the BATCH-size
+        buckets the micro-batched server dispatches under load (8/32)
+        — covering first-touch costs on both scorer routes (XLA
+        compiles on the device route, BLAS/thread-pool init on the
+        host route) before live traffic pays them."""
         if len(model.user_ids) == 0 or len(model.item_ids) == 0:
             return
-        uv = model.user_factors[:1]
         for k in (5, 10):
-            model.scorer().score(uv, k)
+            model.scorer().score(model.user_factors[:1], k)
+        for b in (8, 32):
+            rows = model.user_factors[:min(b, len(model.user_ids))]
+            model.scorer().score(rows, 10)
 
     def predict(self, model: ALSModel, query: Dict[str, Any]) -> Dict[str, Any]:
         num = int(query.get("num", 10))

@@ -50,30 +50,53 @@ UTC = _dt.timezone.utc
 
 
 class ServingStats:
-    """Request bookkeeping (ref: CreateServer.scala:552-559)."""
+    """Request bookkeeping (ref: CreateServer.scala:552-559).
+
+    Beyond the reference's count/average, a bounded window of recent
+    per-request serving times (queue wait + dispatch, measured INSIDE
+    the server) feeds p50/p99 in the status JSON — the server's own
+    latency contribution, unpolluted by client-side CPU contention on
+    shared hosts."""
+
+    WINDOW = 8192
 
     def __init__(self):
+        import collections
+
         self._lock = threading.Lock()
         self.request_count = 0
         self.total_serving_sec = 0.0
         self.last_serving_sec = 0.0
         self.start_time = _dt.datetime.now(tz=UTC)
+        self._window: collections.deque = collections.deque(maxlen=self.WINDOW)
 
     def record(self, seconds: float) -> None:
         with self._lock:
             self.request_count += 1
             self.total_serving_sec += seconds
             self.last_serving_sec = seconds
+            self._window.append(seconds)
+
+    def recent(self, n: Optional[int] = None) -> List[float]:
+        """The last ``n`` (default: all windowed) serving times."""
+        with self._lock:
+            out = list(self._window)
+        return out if n is None else out[-n:]
 
     def snapshot(self) -> dict:
         with self._lock:
             avg = self.total_serving_sec / self.request_count if self.request_count else 0.0
-            return {
-                "startTime": self.start_time.isoformat(),
-                "requestCount": self.request_count,
-                "avgServingSec": avg,
-                "lastServingSec": self.last_serving_sec,
-            }
+            window = sorted(self._window)
+        pct = (lambda q: window[min(len(window) - 1, int(len(window) * q))]
+               if window else 0.0)
+        return {
+            "startTime": self.start_time.isoformat(),
+            "requestCount": self.request_count,
+            "avgServingSec": avg,
+            "lastServingSec": self.last_serving_sec,
+            "p50ServingSec": pct(0.50),
+            "p99ServingSec": pct(0.99),
+        }
 
 
 class _Pending:
