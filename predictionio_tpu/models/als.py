@@ -262,11 +262,18 @@ class ALSAlgorithm(Algorithm):
         host route) before live traffic pays them."""
         if len(model.user_ids) == 0 or len(model.item_ids) == 0:
             return
-        for k in (5, 10):
-            model.scorer().score(model.user_factors[:1], k)
-        for b in (8, 32):
+        # every (B, k) bucket the server can dispatch (B buckets up to
+        # the default micro-batch cap of 64, k buckets 8 and 16): on
+        # the device route each distinct bucket is an XLA compile that
+        # would otherwise block a LIVE batch (code-review regression);
+        # on the host route these are millisecond no-ops. Deploy/reload
+        # warm BEFORE the swap, so this cost never blocks traffic.
+        for b in (1, 2, 4, 8, 16, 32, 64):
             rows = model.user_factors[:min(b, len(model.user_ids))]
-            model.scorer().score(rows, 10)
+            for k in (5, 10):
+                model.scorer().score(rows, k)
+            if b >= len(model.user_ids):
+                break
 
     def predict(self, model: ALSModel, query: Dict[str, Any]) -> Dict[str, Any]:
         num = int(query.get("num", 10))
