@@ -69,7 +69,8 @@ class ALSParams(Params):
                                       # "auto": sized from the group-
                                       # size histogram (ops.ragged)
     solver: str = "cg"               # "cg" | "direct"
-    cg_iters: int = 16
+    cg_iters: int = 10  # warm-started CG needs far fewer steps than a
+                        # cold solve (see ops.als.ALSConfig.cg_iters)
     cg_dtype: str = "bfloat16"       # CG matvec dtype ("float32" to opt out)
     compute_dtype: str = "bfloat16"  # Gramian input dtype (f32 accumulate)
     # optional hard caps (None = keep every rating; the segmented layout
@@ -269,11 +270,13 @@ class ALSAlgorithm(Algorithm):
         # on the host route these are millisecond no-ops. Deploy/reload
         # warm BEFORE the swap, so this cost never blocks traffic.
         for b in (1, 2, 4, 8, 16, 32, 64):
-            rows = model.user_factors[:min(b, len(model.user_ids))]
+            # batch size is bounded by CONCURRENT QUERIES (max_batch),
+            # not distinct users — duplicate-user queries coalesce into
+            # big batches, so small catalogs still need every bucket
+            # warm (tile rows instead of capping at the user count)
+            rows = model.user_factors[np.arange(b) % len(model.user_ids)]
             for k in (5, 10):
                 model.scorer().score(rows, k)
-            if b >= len(model.user_ids):
-                break
 
     def predict(self, model: ALSModel, query: Dict[str, Any]) -> Dict[str, Any]:
         num = int(query.get("num", 10))
