@@ -110,6 +110,22 @@ def _batched_cg(A, b, iters: int, x0=None, matvec_dtype=jnp.float32):
     iteration, so this halves solve time; the bf16 residual floor
     (~2e-3 relative at K=64) sits below ALS's tolerance. All scalar
     recurrences (alpha, beta, x, r) stay f32.
+
+    MEASURED alternatives (r4 roofline follow-up; the trace put this
+    solve at ~45% of step time), all REJECTED on integrated step time
+    at ML-20M/K=64 even when their ISOLATED microbenchmarks won:
+      - full-G f32 CG (no lax.map): isolated 113 ms vs 168 ms mapped —
+        but the INTEGRATED step regressed 1.52 s -> 1.77 s (the blocked
+        form fuses the regularize+cast into the per-block loop; the
+        full-G form materializes extra [G, K, K] copies);
+      - full-G bf16: integrated 1.67 s;
+      - a Pallas kernel holding A VMEM-resident across all CG steps
+        (lanes = groups, unrolled multi-accumulator FMA matvec):
+        best 106 ms isolated, but it needs A in a [K, K, T]-transposed
+        layout rebuilt EVERY outer iteration, which eats the win.
+    The lesson is the same as the gather kernel note above: the fused
+    XLA program beats locally-faster formulations with worse layouts
+    or fusion boundaries.
     """
     Am = A.astype(matvec_dtype)
 
