@@ -1033,35 +1033,55 @@ struct JsonCur {
   char peek() { return ws() ? *p : '\0'; }
 };
 
-// raw contents between the quotes (escapes untouched); cursor must be AT
-// the opening quote
+int hex_nibble(char c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+  return -1;
+}
+
+// raw contents between the quotes (escapes untouched but VALIDATED);
+// cursor must be AT the opening quote
 bool scan_quoted(JsonCur& c, std::string_view* out, bool* has_escape) {
   if (c.p >= c.end || *c.p != '"') return false;
   ++c.p;
   const char* s = c.p;
   *has_escape = false;
   while (c.p < c.end) {
-    char ch = *c.p;
+    unsigned char ch = static_cast<unsigned char>(*c.p);
     if (ch == '"') {
       *out = std::string_view(s, static_cast<size_t>(c.p - s));
       ++c.p;
       return true;
     }
+    if (ch < 0x20) return false;  // RFC 8259: raw control chars are
+    // invalid in strings — json.loads rejects them, and an accepted
+    // raw slice would poison every later read (fuzz-found regression)
     if (ch == '\\') {
+      // escapes must be VALID even when the slice is stored raw:
+      // json.loads rejects \q / bad \uXXXX, so an unvalidated pass
+      // here would store a slice the read path cannot decode
+      // (code-review regression)
       *has_escape = true;
-      c.p += 2;
-      continue;
+      if (c.p + 1 >= c.end) return false;
+      char e = c.p[1];
+      if (e == '"' || e == '\\' || e == '/' || e == 'b' || e == 'f' ||
+          e == 'n' || e == 'r' || e == 't') {
+        c.p += 2;
+        continue;
+      }
+      if (e == 'u') {
+        if (c.p + 6 > c.end) return false;
+        for (int k = 2; k < 6; ++k)
+          if (hex_nibble(c.p[k]) < 0) return false;
+        c.p += 6;
+        continue;
+      }
+      return false;
     }
     ++c.p;
   }
   return false;
-}
-
-int hex_nibble(char c) {
-  if (c >= '0' && c <= '9') return c - '0';
-  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
-  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
-  return -1;
 }
 
 // resolve JSON escapes (incl. \uXXXX with surrogate pairs) to UTF-8

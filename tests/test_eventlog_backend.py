@@ -811,3 +811,123 @@ def test_json_lane_calendar_and_encoding_parity(tmp_path):
     with pytest.raises(JsonRowsUnsupported):
         st.events().insert_json_batch(nul, 1, strict=False)
     st.events().close()
+
+
+def test_json_lane_differential_fuzz(tmp_path):
+    """Randomized differential test: for generated API-format events,
+    the native JSON lane must store EXACTLY what the Event-object path
+    stores (field-for-field, tz fidelity included) or decline to the
+    Python path — and arbitrary byte mutations of valid bodies must
+    never corrupt the log (every surviving record still decodes)."""
+    import json
+    import random
+
+    from predictionio_tpu.data.backends.eventlog import JsonRowsUnsupported
+    from predictionio_tpu.data.storage import StorageError
+
+    rng = random.Random(20260730)
+    ENT = ["u1", "ué", "日本語", 'q"uote', "back\\slash", "tab\tchar",
+           "a" * 200, "nul-adjacent\u0001"]
+    PROPS = [
+        {}, {"rating": 4.5}, {"n": {"deep": [1, 2, {"x": None}]}},
+        {"unicode": "中文", "b": True, "f": False, "z": None},
+        {"list": [1.5, "two", [3]], "neg": -12.75, "exp": 1.5e-3},
+    ]
+    # every generated row carries an explicit eventTime: the "absent ->
+    # now()" default necessarily differs by microseconds between the
+    # two paths (covered by test_json_lane_matches_python_path instead)
+    TIMES = ["2026-01-01T00:00:00Z", "2026-06-15T23:59:59.999Z",
+             "2024-02-29T12:00:00+05:30", "2026-01-01 08:30:00-02:00",
+             1767225600000]
+
+    def gen_event():
+        e = {"event": rng.choice(["rate", "view", "$set"]),
+             "entityType": "user", "entityId": rng.choice(ENT)}
+        if e["event"] != "$set" and rng.random() < 0.7:
+            e["targetEntityType"] = "item"
+            e["targetEntityId"] = rng.choice(ENT)
+        p = rng.choice(PROPS)
+        if e["event"] == "$set" and not p:
+            p = {"rating": 1.0}
+        if p:
+            e["properties"] = p
+        e["eventTime"] = rng.choice(TIMES)
+        if rng.random() < 0.3:
+            e["tags"] = ["t1", "ü2"][: rng.randint(1, 2)]
+        if rng.random() < 0.2:
+            e["prId"] = "pr-9"
+        return e
+
+    def canon(events):
+        # None-safe sort key (targets/prId are optional)
+        return sorted(
+            (e.event, e.entity_type, e.entity_id,
+             e.target_entity_type or "", e.target_entity_id or "",
+             json.dumps(e.properties.to_dict(), sort_keys=True),
+             e.event_time, str(e.event_time.utcoffset()), e.tags,
+             e.pr_id or "")
+            for e in events
+        )
+
+    compared = 0
+    for trial in range(15):
+        rows = [gen_event() for _ in range(rng.randint(1, 12))]
+        raw = json.dumps(rows).encode()
+        st_n = _mk(tmp_path / f"n{trial}")
+        st_n.events().init(1)
+        st_p = _mk(tmp_path / f"p{trial}")
+        st_p.events().init(1)
+        try:
+            try:
+                ids, codes, _, _ = st_n.events().insert_json_batch(raw, 1)
+                assert all(c == 0 for c in codes), (codes, rows)
+            except JsonRowsUnsupported:
+                continue  # declining is always allowed
+            st_p.events().insert_batch([Event.from_dict(r) for r in rows], 1)
+            got_n = canon(st_n.events().find(1))
+            got_p = canon(st_p.events().find(1))
+            assert got_n == got_p, (trial, rows)
+            compared += 1
+        finally:
+            st_n.events().close()
+            st_p.events().close()
+
+    assert compared >= 5, "native lane declined too many valid batches"
+
+    # directed poison probes (code-review regression): constructs
+    # json.loads REJECTS must never be accepted into the log
+    st = _mk(tmp_path / "mut")
+    st.events().init(1)
+    for poison in (
+        b'[{"event":"r","entityType":"u","entityId":"x",'
+        b'"properties":{"k":"a\\qb"}}]',          # invalid \q escape
+        b'[{"event":"r","entityType":"u","entityId":"x",'
+        b'"properties":{"k":"a\\uZZ00"}}]',       # bad \u hex
+        b'[{"event":"r","entityType":"u","entityId":"x",'
+        b'"properties":{"k":"a\x01b"}}]',          # raw control char
+    ):
+        with pytest.raises((ValueError, JsonRowsUnsupported, StorageError)):
+            st.events().insert_json_batch(poison, 1, strict=False)
+    assert st.events().find(1) == []
+
+    # mutation fuzz: corrupting valid bodies must never poison the log
+    base = json.dumps([gen_event() for _ in range(4)]).encode()
+    for trial in range(120):
+        body = bytearray(base)
+        muts = rng.randint(1, 3)
+        for _ in range(muts):
+            pos = rng.randrange(len(body))
+            # bias toward the dangerous classes: structural bytes,
+            # backslashes and control chars
+            body[pos] = rng.choice(
+                [0x5C, 0x22, 0x7B, 0x7D, 0x5B, 0x5D, 0x01, 0x1F]
+                + [rng.randrange(256)])
+        try:
+            st.events().insert_json_batch(bytes(body), 1, strict=False)
+        except (ValueError, JsonRowsUnsupported, StorageError):
+            pass
+    # every record the log DID accept must still decode cleanly
+    for e in st.events().find(1):
+        e.properties.to_dict()
+        assert e.event and e.entity_type and e.entity_id
+    st.events().close()
