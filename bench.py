@@ -1,8 +1,12 @@
 """Headline benchmark: the full events->model->serving pipeline at
 MovieLens-20M scale on one chip.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": {...}}
+Prints ONE COMPACT JSON line (< MAX_HEADLINE_BYTES — the driver only
+captures a ~2KB stdout tail, BENCH_r04 lesson):
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+   "gates": {...}, "key": {...}, "detail_file": "BENCH_DETAIL.json"}
+The full detail blob (histograms, per-run arrays, roofline trace) is
+written to BENCH_DETAIL.json beside this file and committed.
 
 Unlike a kernel microbench, this drives the framework's own data path —
 the `pio train` call stack (SURVEY.md §3.1) — TWICE, in two fresh
@@ -60,7 +64,7 @@ Headline metric: rating-updates/sec/chip = n_train_ratings * iterations
 / train_sec (cold stage). ``vs_baseline`` divides by an ASSUMED PROXY
 of 1e6 ratings*iters/sec for a Spark-MLlib-ALS CPU node — the reference
 publishes no benchmark numbers at all (BASELINE.json "published": {});
-the proxy is our own stated assumption, recorded in the detail block,
+the proxy is our own stated assumption, recorded in the detail file,
 and the >=5x north-star (BASELINE.md) reads as vs_baseline >= 5.
 If ANY gate fails (relative RMSE, absolute RMSE band, serving p50,
 32-conn p99 + batching, row-lane >= 50k ev/s), value is reported as
@@ -913,6 +917,98 @@ def stage_warm(base_dir, out_path):
         json.dump(detail, f)
 
 
+#: hard ceiling for the final stdout line. The driver records only a
+#: ~2 KB tail of bench stdout; round 4's single fat line outgrew it and
+#: the whole round's headline landed as ``"parsed": null`` in
+#: BENCH_r04.json (VERDICT r4 weak #1). The compact line carries the
+#: metric, the gate booleans, and the ~dozen key numbers; EVERYTHING
+#: else goes to BENCH_DETAIL.json next to this file, committed, and is
+#: referenced by path from the line.
+MAX_HEADLINE_BYTES = 1536
+
+DETAIL_FILE = "BENCH_DETAIL.json"
+
+#: the one assumed Spark-MLlib-ALS CPU-node throughput proxy —
+#: vs_baseline and the detail's baseline_proxy block must agree
+BASELINE_PROXY = 1e6
+
+
+def emit_headline(detail, detail_path=None):
+    """Build the compact final-line dict from the merged stage detail,
+    write the full detail to ``BENCH_DETAIL.json`` (repo root, beside
+    this file), and return the line dict. If the line would exceed
+    ``MAX_HEADLINE_BYTES``, optional ``key`` entries are pruned (worst
+    first) until it fits — a multi-hour run must ALWAYS end in a
+    parseable headline (raising here would reproduce the exact
+    BENCH_r04 parsed:null failure this split exists to prevent); the
+    pruning is recorded in the detail file."""
+    gates = {
+        "rmse": bool(detail["rmse_gate_passed"]),
+        "rmse_band": bool(detail["rmse_band_passed"]),
+        "serve_p50": bool(detail["serve_gate_passed"]),
+        "serve_32conn": bool(detail["serve_32_gate_passed"]),
+        "row_lane": bool(detail["row_lane_gate_passed"]),
+    }
+    value = detail["updates_per_sec"] if all(gates.values()) else 0.0
+    detail["baseline_proxy"] = {
+        "value": BASELINE_PROXY,
+        "unit": "ratings*iters/sec",
+        "basis": ("ASSUMED Spark-MLlib-ALS CPU-node throughput; the "
+                  "reference publishes no numbers (BASELINE.json "
+                  "published={}) — this proxy is our own stated "
+                  "assumption, not a citation"),
+    }
+    key = {
+        "train_sec": detail.get("train_sec"),
+        "events_to_model_sec": detail.get("events_to_model_sec"),
+        "warm_events_to_model_sec": detail.get("warm", {})
+        .get("events_to_model_sec"),
+        "warm_transfer_mb_per_sec": detail.get("warm", {})
+        .get("transfer_mb_per_sec"),
+        "row_lane_events_per_sec": detail.get("row_lane_events_per_sec"),
+        "rmse_heldout": detail.get("rmse_heldout"),
+        "serve_p50_ms": detail.get("serve_p50_ms"),
+        "serve_p99_ms": detail.get("serve_p99_ms"),
+        "serve_32_srv_p50_ms": detail.get("serve_p50_ms_32conn_serverside"),
+        "serve_32_srv_p99_ms": detail.get("serve_p99_ms_32conn_serverside"),
+        "serve_32_qps": detail.get("serve_qps_32conn"),
+    }
+    if "twotower" in detail:
+        tt = detail["twotower"]
+        gates["twotower_loss"] = bool(tt.get("loss_gate_passed", False))
+        key["twotower_mfu"] = tt.get("mfu")
+        key["twotower_step_ms"] = tt.get("step_ms")
+        if not gates["twotower_loss"]:
+            value = 0.0
+    line = {
+        "metric": "als_ml20m_rating_updates_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "ratings*iters/sec",
+        "vs_baseline": round(value / BASELINE_PROXY, 2),
+        "gates": gates,
+        "key": {k: v for k, v in key.items() if v is not None},
+        "detail_file": DETAIL_FILE,
+    }
+    pruned = []
+    while (len(json.dumps(line).encode()) > MAX_HEADLINE_BYTES
+           and line["key"]):
+        pruned.append(line["key"].popitem()[0])  # last = least essential
+    if pruned:
+        detail["headline_pruned_keys"] = pruned
+    if detail_path is None:
+        detail_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), DETAIL_FILE)
+    try:
+        with open(detail_path, "w") as f:
+            json.dump(detail, f, indent=1, sort_keys=True)
+    except OSError as e:
+        # a failed detail write must never cost the headline (the whole
+        # point of the split is that the line ALWAYS lands)
+        line["detail_file"] = None
+        line["detail_error"] = str(e)[:120]
+    return line
+
+
 def orchestrate():
     """Parent: never touches JAX (the chip is exclusive per process);
     runs the two stages as children sharing one store + compile cache."""
@@ -938,26 +1034,7 @@ def orchestrate():
 
         detail = stages["cold"]
         detail["warm"] = stages["warm"]
-        gates = (detail["rmse_gate_passed"] and detail["rmse_band_passed"]
-                 and detail["serve_gate_passed"]
-                 and detail["serve_32_gate_passed"]
-                 and detail["row_lane_gate_passed"])
-        value = detail.pop("updates_per_sec") if gates else 0.0
-        detail["baseline_proxy"] = {
-            "value": 1e6,
-            "unit": "ratings*iters/sec",
-            "basis": ("ASSUMED Spark-MLlib-ALS CPU-node throughput; the "
-                      "reference publishes no numbers (BASELINE.json "
-                      "published={}) — this proxy is our own stated "
-                      "assumption, not a citation"),
-        }
-        print(json.dumps({
-            "metric": "als_ml20m_rating_updates_per_sec_per_chip",
-            "value": round(value, 1),
-            "unit": "ratings*iters/sec",
-            "vs_baseline": round(value / 1e6, 2),
-            "detail": detail,
-        }))
+        print(json.dumps(emit_headline(detail)))
     finally:
         shutil.rmtree(base_dir, ignore_errors=True)
 
