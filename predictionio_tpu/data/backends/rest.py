@@ -75,18 +75,24 @@ class _Transport:
     def _error(self, path: str, e: urllib.error.HTTPError) -> S.StorageError:
         payload = e.read()
         error_type = None
+        row_error = False
         try:
             body = json.loads(payload)
             message = body.get("message", payload.decode())
             error_type = body.get("type")
+            row_error = bool(body.get("row_error", False))
         except Exception:  # noqa: BLE001 — raw body is the best we have
             message = payload.decode(errors="replace")
         err = S.StorageError(
             f"storage server {self.base_url}{path}: HTTP {e.code}: {message}"
         )
-        # structured discriminator (the server's "type" field) so
-        # callers can re-map client errors without grepping messages
+        # structured discriminators (the server's "type" / "row_error"
+        # fields) so callers can re-map client errors without grepping
+        # messages; server_message carries the unwrapped text for
+        # re-raises that want local/remote message parity
         err.error_type = error_type
+        err.row_error = row_error
+        err.server_message = message
         return err
 
     def _sleep_backoff(self, attempt: int) -> None:
@@ -241,6 +247,15 @@ class RestEventStore(S.EventStore):
                 # (malformed body) — re-raise as ValueError so the
                 # batch route answers 400, not 500
                 raise ValueError(str(e)) from None
+            if getattr(e, "row_error", False):
+                # the server's row_error discriminator, set ONLY for a
+                # strict=True row-validation failure: re-raise clean
+                # (transport wrapper stripped) under the same type the
+                # local DAO raises synchronously. Other StorageErrors
+                # (lock contention, I/O) keep their transport context
+                # and type (ADVICE r4 low + r5 review)
+                raise S.RowValidationError(
+                    getattr(e, "server_message", str(e))) from None
             raise
         out = json.loads(body)
         if out.get("unsupported"):

@@ -56,6 +56,15 @@ class StorageUnavailableError(StorageError):
     callers can distinguish outage from bad-request."""
 
 
+class RowValidationError(StorageError):
+    """strict=True batch insert hit an invalid row: a PERMANENT
+    client-data error (nothing was appended), never a backend fault —
+    retrying the same batch can only fail the same way. The rest tier
+    maps it to 400 with a ``row_error`` discriminator and re-raises it
+    client-side under this same type, so local and remote strict paths
+    fail identically (ADVICE r4 low)."""
+
+
 @dataclasses.dataclass
 class EventColumns:
     """Dict-encoded columnar view of a filtered event scan — the bulk

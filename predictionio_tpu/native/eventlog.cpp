@@ -1400,7 +1400,11 @@ int parse_row(JsonCur& c, JsonRow* row) {
       ++c.p;
       break;
     }
-    if (!first && *c.p == ',') {
+    if (!first) {
+      // strict RFC-8259 member separator, same grammar as skip_value's
+      // object branch: a missing comma must reject (fallback lane 400s
+      // it), never silently accept what json.loads would refuse
+      if (*c.p != ',') return -2;
       ++c.p;
       if (!c.ws()) return -2;
     }
@@ -1449,7 +1453,12 @@ int parse_row(JsonCur& c, JsonRow* row) {
             ++c.p;
             break;
           }
-          if (!pfirst && *c.p == ',') {
+          if (!pfirst) {
+            // strict comma: the raw slice is stored VERBATIM and
+            // re-read with json.loads — accepting {"a":1 "b":2} here
+            // would poison every later read of this app (get/find/
+            // training all json.loads the stored blob)
+            if (*c.p != ',') return -2;
             ++c.p;
             if (!c.ws()) return -2;
           }
@@ -1658,6 +1667,10 @@ int64_t el_append_json(void* h, const uint8_t* body, uint64_t nbytes,
     if (!first) {
       if (*c.p != ',') return -3;
       ++c.p;
+      // a comma commits to another element: '[{...},]' is a json.loads
+      // error and must not be acked (strict RFC-8259, ADVICE r4 family)
+      if (!c.ws()) return -3;
+      if (*c.p == ']') return -3;
     }
     first = false;
     if (c.peek() != '{') {

@@ -64,6 +64,7 @@ from predictionio_tpu.data.metadata import (
 )
 from predictionio_tpu.data.storage import (
     UNSET,
+    RowValidationError,
     Storage,
     StorageError,
     columns_to_npz_file,
@@ -440,6 +441,16 @@ class StorageRequestHandler(JSONRequestHandler):
             except ValueError as e:
                 return self._send(400, {"message": str(e),
                                         "type": "ValueError"})
+            except RowValidationError as e:
+                # strict=True row-validation failure: a PERMANENT
+                # client-data error, not a retryable backend fault —
+                # answer 400 with the row_error discriminator so the
+                # rest client re-raises it under the same type; other
+                # StorageErrors (lock contention, I/O) fall through to
+                # _guarded WITHOUT the flag (ADVICE r4 low)
+                return self._send(400, {"message": str(e),
+                                        "type": "StorageError",
+                                        "row_error": True})
             return self._send(201, {"ids": ids, "codes": codes,
                                     "names": names, "etypes": etypes})
         if method == "insert_columnar":

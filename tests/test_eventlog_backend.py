@@ -931,3 +931,87 @@ def test_json_lane_differential_fuzz(tmp_path):
         e.properties.to_dict()
         assert e.event and e.entity_type and e.entity_id
     st.events().close()
+
+
+def test_json_lane_strict_comma_grammar(tmp_path):
+    """ADVICE r4 (high): the native lane's object walks must REQUIRE
+    the member comma. A missing comma inside properties used to be
+    acked 201 with the malformed raw slice stored verbatim — poisoning
+    json.loads on EVERY later read of the app (get/find/training). Both
+    loops (parse_row top level + the properties walk) must now reject
+    exactly what json.loads rejects, falling back to the Python lane
+    which 400s it."""
+    import json
+
+    from predictionio_tpu.data.backends.eventlog import JsonRowsUnsupported
+
+    st = _mk(tmp_path)
+    st.events().init(1)
+    ok = [{"event": "rate", "entityType": "u", "entityId": "x",
+           "properties": {"a": 1, "b": 2}}]
+    ids, codes, _, _ = st.events().insert_json_batch(
+        json.dumps(ok).encode(), 1)
+    assert codes == [0]
+    n_before = len(st.events().find(1))
+
+    for poison in (
+        # missing comma between properties members (the poisoned-read
+        # reproduction from the advisor finding)
+        b'[{"event":"rate","entityType":"u","entityId":"x",'
+        b'"properties":{"a":1 "b":2}}]',
+        # missing comma between top-level members (silent grammar
+        # divergence: 201 where the Python lane 400s)
+        b'[{"event":"rate" "entityType":"u","entityId":"x"}]',
+        # missing comma straight after the properties object
+        b'[{"event":"rate","entityType":"u","entityId":"x",'
+        b'"properties":{"a":1} "targetEntityType":"i"}]',
+        # trailing comma in the event array (json.loads rejects)
+        b'[{"event":"rate","entityType":"u","entityId":"x"},]',
+    ):
+        # json.loads parity: the reference body must actually be bad
+        with pytest.raises(json.JSONDecodeError):
+            json.loads(poison)
+        with pytest.raises((ValueError, JsonRowsUnsupported)):
+            st.events().insert_json_batch(poison, 1, strict=False)
+
+    # nothing stored, and — the real stake — every read still parses
+    events = st.events().find(1)
+    assert len(events) == n_before
+    for e in events:
+        assert e.properties.to_dict() == {"a": 1, "b": 2}
+    assert st.events().get(ids[0], 1).properties.to_dict() == {"a": 1, "b": 2}
+    st.events().close()
+
+
+def test_fingerprint_distinguishes_apps_with_identical_content(tmp_path):
+    """ADVICE r4 (medium): the machine-global bincache keys on the
+    fingerprint, so two apps whose logs coincide on the content
+    quadruple (same record sizes/counts — here byte-identical data)
+    must still produce DIFFERENT fingerprints, or a retrain on app B
+    silently loads app A's cached binned layout."""
+    import json
+
+    st = _mk(tmp_path)
+    raw = json.dumps([
+        {"event": "rate", "entityType": "u", "entityId": f"u{i}",
+         "targetEntityType": "i", "targetEntityId": f"i{i}",
+         "properties": {"rating": 3.5}}
+        for i in range(50)
+    ]).encode()
+    st.events().init(1)
+    st.events().init(2)
+    st.events().insert_json_batch(raw, 1)
+    st.events().insert_json_batch(raw, 2)
+    fp1 = st.events().data_fingerprint(1)
+    fp2 = st.events().data_fingerprint(2)
+    # identical content quadruple...
+    assert fp1.split("-", 1)[1] == fp2.split("-", 1)[1]
+    # ...but distinct log identity
+    assert fp1 != fp2
+    # channels are distinct logs too
+    st.events().init(1, 7)
+    st.events().insert_json_batch(raw, 1, 7)
+    assert st.events().data_fingerprint(1, 7) != fp1
+    # and the fingerprint is stable for the same unchanged log
+    assert st.events().data_fingerprint(1) == fp1
+    st.events().close()

@@ -649,3 +649,52 @@ def test_insert_never_auto_retries(tmp_path):
         client.events().insert(_event(), 1)
     # no backoff sleeps -> fails in well under the first retry delay
     assert time.time() - t0 < 0.2
+
+
+def test_strict_json_row_error_maps_to_clean_storage_error(tmp_path):
+    """ADVICE r4 (low): a strict=True row-validation failure on the
+    server is a PERMANENT client-data error; the rest client must
+    surface it as the same clean StorageError the local DAO raises
+    synchronously — not a transport-wrapped, retryable-looking server
+    fault — and malformed JSON must stay a ValueError (400 route)."""
+    import json
+
+    from predictionio_tpu.data.storage import StorageError
+    from tests.test_storage import make_storage
+
+    server_storage = make_storage("eventlog", tmp_path)
+    server = StorageServer(storage=server_storage, host="127.0.0.1",
+                           port=0).start()
+    try:
+        client = _client_storage(server.port)
+        app = client.apps().insert("strictjson")
+        client.events().init(app.id)
+        bad = json.dumps([
+            {"event": "ok", "entityType": "u", "entityId": "u1"},
+            {"event": "$badspecial", "entityType": "u", "entityId": "u2"},
+        ]).encode()
+        with pytest.raises(StorageError) as ei:
+            client.events().insert_json_batch(bad, app.id, strict=True)
+        # the clean server-side message, not the HTTP-wrapped transport
+        # string (local-path parity)
+        assert "HTTP 400" not in str(ei.value)
+        assert "event 1" in str(ei.value)
+        # strict: nothing appended
+        assert client.events().find(app.id) == []
+        # a body malformed at the array level stays ValueError (the 400
+        # ValueError-discriminator path); object-level grammar the
+        # native lane declines (e.g. missing member comma) raises
+        # JsonRowsUnsupported instead, routing to the Python lane
+        with pytest.raises(ValueError):
+            client.events().insert_json_batch(
+                b'[{"event":"e","entityType":"u","entityId":"x"} '
+                b'{"event":"f","entityType":"u","entityId":"y"}]',
+                app.id, strict=True)
+        # the server survived both client errors
+        ids, codes, _, _ = client.events().insert_json_batch(
+            json.dumps([{"event": "ok", "entityType": "u",
+                         "entityId": "u1"}]).encode(), app.id)
+        assert codes == [0]
+    finally:
+        server.stop()
+        server_storage.events().close()
