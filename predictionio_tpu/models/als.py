@@ -69,8 +69,13 @@ class ALSParams(Params):
                                       # "auto": sized from the group-
                                       # size histogram (ops.ragged)
     solver: str = "cg"               # "cg" | "direct"
-    cg_iters: int = 10  # warm-started CG needs far fewer steps than a
-                        # cold solve (see ops.als.ALSConfig.cg_iters)
+    cg_iters: int = 6   # warm-started + Jacobi-preconditioned CG needs
+                        # far fewer steps than a cold solve (measured
+                        # sweep: ops.als.ALSConfig.cg_iters)
+    cg_unroll: bool = True           # straight-line CG recurrence
+                                     # (False restores the lax.scan form)
+    cg_precond: str = "jacobi"       # "jacobi" | "none"; with "none",
+                                     # raise cg_iters to >= 8 (see sweep)
     cg_dtype: str = "bfloat16"       # CG matvec dtype ("float32" to opt out)
     compute_dtype: str = "bfloat16"  # Gramian input dtype (f32 accumulate)
     # optional hard caps (None = keep every rating; the segmented layout
@@ -170,6 +175,8 @@ class ALSAlgorithm(Algorithm):
             seg_len=p.seg_len,
             solver=p.solver,
             cg_iters=p.cg_iters,
+            cg_unroll=p.cg_unroll,
+            cg_precond=p.cg_precond,
             cg_dtype=p.cg_dtype,
             compute_dtype=p.compute_dtype,
         )
@@ -231,7 +238,8 @@ class ALSAlgorithm(Algorithm):
             implicit=base.implicit_prefs, alpha=base.alpha,
             block_size=base.block_size, seed=base.seed,
             seg_len=base.seg_len, solver=base.solver,
-            cg_iters=base.cg_iters, cg_dtype=base.cg_dtype,
+            cg_iters=base.cg_iters, cg_unroll=base.cg_unroll,
+            cg_precond=base.cg_precond, cg_dtype=base.cg_dtype,
             compute_dtype=base.compute_dtype,
         )
         factors_list = als_grid_train(

@@ -50,17 +50,30 @@ class ALSConfig:
     block_size: int = 4096    # users solved per lax.map step
     seed: int = 7
     solver: str = "cg"        # "cg" (MXU-friendly, default) | "direct" (LU)
-    cg_iters: int = 10        # CG steps. The solve WARM-STARTS from the
+    cg_iters: int = 6         # CG steps. The solve WARM-STARTS from the
                               # previous iteration's factors, so far fewer
-                              # steps than a cold solve needs: measured at
-                              # ML-20M/K=64, held-out RMSE is identical to
-                              # the 4th decimal from 16 down to 8 steps
-                              # (cliff at 4), while the CG while-loop holds
-                              # ~47% of step time (BENCH_r04 trace) — 10 is
-                              # the safety-margin choice, ~8% faster steps
+                              # steps than a cold solve needs. r5 on-chip
+                              # sweep at ML-20M/K=64 under jacobi+unroll
+                              # (below): held-out RMSE identical to the 4th
+                              # decimal from 10 down to 6 (0.4276); first
+                              # movement at 5 (0.4277), visible at 4
+                              # (0.4281). Integrated step 1.477->1.410 s
+                              # vs the r4 scan-none-10 default; 6 keeps a
+                              # one-step margin above the visible cliff
     cg_dtype: str = "bfloat16"  # CG matvec storage dtype: the solve is
                                 # HBM-bound on re-reading A each step, so
                                 # bf16 halves it (f32 accumulate/recurrences)
+    cg_unroll: bool = True    # unroll the CG recurrence into straight-line
+                              # code instead of a lax.scan: the loop body is
+                              # a handful of SMALL ops ([B,K] matvec + dots),
+                              # so the while-loop's per-step sync/dispatch
+                              # overhead dominates its actual HBM traffic
+                              # (r5 measurement below)
+    cg_precond: str = "jacobi"  # "jacobi" | "none": diagonal preconditioner
+                                # — one [B,K] divide per solve, buys the same
+                                # residual in fewer CG steps (ALS-WR adds
+                                # reg*n_u to the diagonal, so group scales
+                                # vary wildly and Jacobi normalizes them)
     compute_dtype: str = "bfloat16"  # gather/Gramian input dtype; accumulation
                                      # is always f32 (MXU native bf16xbf16->f32)
     seg_len: object = "auto"  # virtual-row length (int), or "auto": sized
@@ -95,7 +108,8 @@ def _build_side(
     )
 
 
-def _batched_cg(A, b, iters: int, x0=None, matvec_dtype=jnp.float32):
+def _batched_cg(A, b, iters: int, x0=None, matvec_dtype=jnp.float32,
+                unroll: bool = False, precond: str = "none"):
     """Batched conjugate gradient for SPD K x K systems.
 
     TPU-shaped replacement for ``jnp.linalg.solve``: batched LU/Cholesky
@@ -126,6 +140,34 @@ def _batched_cg(A, b, iters: int, x0=None, matvec_dtype=jnp.float32):
     The lesson is the same as the gather kernel note above: the fused
     XLA program beats locally-faster formulations with worse layouts
     or fusion boundaries.
+
+    ``unroll=True`` replaces the ``lax.scan`` with straight-line code:
+    the recurrence body is a few SMALL [B, K] ops whose while-loop
+    dispatch/sync overhead exceeds their HBM traffic, so unrolling lets
+    XLA fuse across iterations and schedule without per-step loop
+    plumbing.
+
+    ``precond="jacobi"`` runs preconditioned CG with M = diag(A): one
+    [B, K] reciprocal per solve (A's diagonal is reg*n_u-shifted, so
+    per-group scales vary by orders of magnitude and Jacobi equalizes
+    them), reaching the same residual in fewer steps — the knob that
+    lets cg_iters drop below the unpreconditioned cliff.
+
+    r5 ON-CHIP MEASUREMENTS (ML-20M, K=64, integrated 5-iteration train,
+    min-of-2, /tmp-harness reproduced in ROUND5.md):
+      scan-none-10 (r4 default)  1.477 s  rmse 0.4276
+      unroll-none-10             1.468 s  rmse 0.4276
+      unroll-jacobi-10           1.434 s  rmse 0.4276
+      unroll-jacobi-6  (DEFAULT) 1.410 s  rmse 0.4276
+      unroll-jacobi-4            1.400 s  rmse 0.4281  <- quality moves
+      scan-jacobi-6              1.508 s  <- REGRESSION: under the scan
+        the extra precondition ops cost more than 4 saved iterations,
+        confirming the loop is dispatch-bound, not HBM-bound
+    The sweep also corrects the r4 narrative: cutting CG work 40% moved
+    the step only ~4.5%, so the trace's ~47% "while" fraction is mostly
+    the lax.map over row/group blocks (also while-lowered), not this
+    recurrence; a block_size sweep (4096->32768) found 4096 already
+    optimal (8192: 1.467 s).
     """
     Am = A.astype(matvec_dtype)
 
@@ -133,14 +175,30 @@ def _batched_cg(A, b, iters: int, x0=None, matvec_dtype=jnp.float32):
         return jnp.einsum("bij,bj->bi", Am, v.astype(matvec_dtype),
                           preferred_element_type=jnp.float32)
 
+    if precond == "jacobi":
+        # f32 diagonal BEFORE the matvec cast: the reg*n_u shift spans
+        # orders of magnitude and bf16 would quantize the equalization
+        Minv = 1.0 / (jnp.diagonal(A, axis1=-2, axis2=-1) + 1e-20)
+    elif precond == "none":
+        Minv = None
+    else:
+        # a typo must not silently run unpreconditioned: the cg_iters=6
+        # default is validated only WITH Jacobi
+        raise ValueError(f"unknown cg_precond {precond!r} "
+                         "(expected 'jacobi' or 'none')")
+
+    def prec(r):
+        return r if Minv is None else Minv * r
+
     if x0 is None:
         x = jnp.zeros_like(b)
         r = b
     else:
         x = x0
         r = b - matvec(x0)
-    p = r
-    rs = jnp.einsum("bi,bi->b", r, r)
+    z = prec(r)
+    p = z
+    rs = jnp.einsum("bi,bi->b", r, z)
 
     def body(carry, _):
         x, r, p, rs = carry
@@ -148,12 +206,18 @@ def _batched_cg(A, b, iters: int, x0=None, matvec_dtype=jnp.float32):
         alpha = rs / (jnp.einsum("bi,bi->b", p, Ap) + 1e-20)
         x = x + alpha[:, None] * p
         r = r - alpha[:, None] * Ap
-        rs_new = jnp.einsum("bi,bi->b", r, r)
-        p = r + (rs_new / (rs + 1e-20))[:, None] * p
+        z = prec(r)
+        rs_new = jnp.einsum("bi,bi->b", r, z)
+        p = z + (rs_new / (rs + 1e-20))[:, None] * p
         return (x, r, p, rs_new), None
 
-    (x, _, _, _), _ = jax.lax.scan(body, (x, r, p, rs), None, length=iters)
-    return x
+    carry = (x, r, p, rs)
+    if unroll:
+        for _ in range(iters):
+            carry, _ = body(carry, None)
+    else:
+        carry, _ = jax.lax.scan(body, carry, None, length=iters)
+    return carry[0]
 
 
 #: uint8 value-code reserved for padded slots (compress_side); the
@@ -163,7 +227,8 @@ PAD_CODE = 255
 
 def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
                  alpha, row_block, group_block, groups_loc, solver, cg_iters,
-                 cg_dtype, compute_dtype, val_affine=None):
+                 cg_dtype, compute_dtype, cg_unroll=False, cg_precond="none",
+                 val_affine=None):
     """Solve all groups of one shard from segmented virtual rows.
 
     Three stages, all static-shape:
@@ -234,11 +299,13 @@ def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
     return _solve_groups(Ar, br, X_prev, seg, counts, Yc, rank=rank, reg=reg,
                          implicit=implicit, group_block=group_block,
                          groups_loc=groups_loc, solver=solver,
-                         cg_iters=cg_iters, cg_dtype=cg_dtype)
+                         cg_iters=cg_iters, cg_dtype=cg_dtype,
+                         cg_unroll=cg_unroll, cg_precond=cg_precond)
 
 
 def _solve_groups(Ar, br, X_prev, seg, counts, Yc, *, rank, reg, implicit,
-                  group_block, groups_loc, solver, cg_iters, cg_dtype):
+                  group_block, groups_loc, solver, cg_iters, cg_dtype,
+                  cg_unroll=False, cg_precond="none"):
     """Stages 2+3: segment-sum row partials to groups, regularize, solve."""
     f32 = jnp.float32
     A = jax.ops.segment_sum(Ar, seg, num_segments=groups_loc,
@@ -266,9 +333,16 @@ def _solve_groups(Ar, br, X_prev, seg, counts, Yc, *, rank, reg, implicit,
             n_u = jnp.maximum(cnt_b.astype(f32), 1.0)
             A_b = A_b + (reg * n_u)[:, None, None] * eye
         if solver == "cg":
-            return _batched_cg(A_b, b_b, cg_iters, x0=x0_b,
-                               matvec_dtype=jnp.dtype(cg_dtype))   # [B, K]
-        return jnp.linalg.solve(A_b, b_b[..., None])[..., 0]
+            x = _batched_cg(A_b, b_b, cg_iters, x0=x0_b,
+                            matvec_dtype=jnp.dtype(cg_dtype),
+                            unroll=cg_unroll,
+                            precond=cg_precond)   # [B, K]
+        else:
+            x = jnp.linalg.solve(A_b, b_b[..., None])[..., 0]
+        # groups with no ratings keep EXACT zero factors (the iterative
+        # solve only drives the random x0 toward 0 to its residual
+        # floor; the reference's unseen users have no factors at all)
+        return x * (cnt_b > 0)[:, None]
 
     out = jax.lax.map(solve_block, (A, b, cnt, x0))  # [ngb, B, K]
     return out.reshape(groups_loc, rank)
@@ -286,7 +360,8 @@ def make_half_step(mesh: Optional[Mesh], cfg: ALSConfig, row_block: int,
         rank=cfg.rank, reg=cfg.reg, implicit=cfg.implicit, alpha=cfg.alpha,
         row_block=row_block, group_block=group_block, groups_loc=groups_loc,
         solver=cfg.solver, cg_iters=cfg.cg_iters, cg_dtype=cfg.cg_dtype,
-        compute_dtype=cfg.compute_dtype,
+        compute_dtype=cfg.compute_dtype, cg_unroll=cfg.cg_unroll,
+        cg_precond=cfg.cg_precond,
     )
     if val_affine is None:
         fn = functools.partial(_solve_shard, **kwargs)
@@ -467,6 +542,17 @@ class LayoutCacheMiss(LookupError):
     """No cached layout for the key (caller falls back to the read path)."""
 
 
+@dataclasses.dataclass(frozen=True)
+class SideSpec:
+    """Array-free descriptor of one side's device layout (what a step
+    function needs to be rebuilt against already-placed arrays)."""
+
+    row_block: int
+    group_block: int
+    groups_per_shard: int
+    affine: Optional[tuple]
+
+
 class ALSTrainer:
     """Prepared ALS run: data binned + placed on device, steps compiled.
 
@@ -557,6 +643,13 @@ class ALSTrainer:
                     **user_side.meta("u_"), **item_side.meta("i_"),
                 })
 
+        # light layout descriptors only — the SideLayout objects pin
+        # hundreds of MB of host arrays and must not outlive the puts
+        # (experiment harnesses rebuild step fns against the same
+        # device arrays without re-binning)
+        self._sides = tuple(
+            SideSpec(s.row_block, s.group_block, s.groups_per_shard, s.affine)
+            for s in (user_side, item_side))
         self._g_users = user_side.groups_per_shard * n_shards
         self._g_items = item_side.groups_per_shard * n_shards
         # entries actually processed per half-step (all of them unless an
@@ -817,6 +910,7 @@ def als_grid_train(
             row_block=side.row_block, group_block=side.group_block,
             groups_loc=groups_loc, solver=cfg.solver, cg_iters=cfg.cg_iters,
             cg_dtype=cfg.cg_dtype, compute_dtype=cfg.compute_dtype,
+            cg_unroll=cfg.cg_unroll, cg_precond=cfg.cg_precond,
         )
 
         def one(Y, X_prev, reg, idx, val, mask, seg, counts):
