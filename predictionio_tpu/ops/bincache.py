@@ -30,8 +30,10 @@ import numpy as np
 
 log = logging.getLogger(__name__)
 
-_FORMAT_VERSION = 2  # bump when the stored layout shape changes
+_FORMAT_VERSION = 3  # bump when the stored layout shape changes
 # v2: value coding is affine (a, b in meta), no table array
+# v3: gather indexes stored as wire streams idx_lo (uint16) +
+#     optional idx_hi (uint8) instead of one int32 array (r5)
 
 
 def cache_dir() -> str:
