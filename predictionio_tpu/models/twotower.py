@@ -5,7 +5,7 @@ same model container / query surface (top-``num`` itemScores), so the
 recommendation engine can swap `"als"` for `"twotower"` — or run both
 and let Serving combine them, the reference's distinctive
 multi-algorithm contract (SURVEY.md §7 hard part (d), CreateServer
-serving combine :472–475). Compute core: ops.twotower (flax towers +
+serving combine :472–475). Compute core: ops.twotower (row-sparse towers +
 in-batch softmax under jit on the mesh).
 
 Scores are cosine similarities (towers L2-normalize), so multi-algo

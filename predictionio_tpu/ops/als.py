@@ -110,7 +110,8 @@ def _build_side(
 
 
 def _batched_cg(A, b, iters: int, x0=None, matvec_dtype=jnp.float32,
-                unroll: bool = False, precond: str = "none"):
+                unroll: bool = False, precond: str = "none",
+                active_steps=None):
     """Batched conjugate gradient for SPD K x K systems.
 
     TPU-shaped replacement for ``jnp.linalg.solve``: batched LU/Cholesky
@@ -201,23 +202,33 @@ def _batched_cg(A, b, iters: int, x0=None, matvec_dtype=jnp.float32,
     p = z
     rs = jnp.einsum("bi,bi->b", r, z)
 
-    def body(carry, _):
+    def body(carry, k):
         x, r, p, rs = carry
         Ap = matvec(p)
         alpha = rs / (jnp.einsum("bi,bi->b", p, Ap) + 1e-20)
-        x = x + alpha[:, None] * p
-        r = r - alpha[:, None] * Ap
-        z = prec(r)
-        rs_new = jnp.einsum("bi,bi->b", r, z)
-        p = z + (rs_new / (rs + 1e-20))[:, None] * p
-        return (x, r, p, rs_new), None
+        x1 = x + alpha[:, None] * p
+        r1 = r - alpha[:, None] * Ap
+        z = prec(r1)
+        rs1 = jnp.einsum("bi,bi->b", r1, z)
+        p1 = z + (rs1 / (rs + 1e-20))[:, None] * p
+        if active_steps is not None:
+            # per-candidate step budget (the vmapped grid axis): steps
+            # past a candidate's budget compute but FREEZE its state,
+            # so a grid member with cg_iters=4 finishes bit-identical
+            # to a sequential 4-step solve
+            on = k < active_steps
+            x1 = jnp.where(on, x1, x)
+            r1 = jnp.where(on, r1, r)
+            p1 = jnp.where(on, p1, p)
+            rs1 = jnp.where(on, rs1, rs)
+        return (x1, r1, p1, rs1), None
 
     carry = (x, r, p, rs)
     if unroll:
-        for _ in range(iters):
-            carry, _ = body(carry, None)
+        for k in range(iters):
+            carry, _ = body(carry, k)
     else:
-        carry, _ = jax.lax.scan(body, carry, None, length=iters)
+        carry, _ = jax.lax.scan(body, carry, jnp.arange(iters))
     return carry[0]
 
 
@@ -229,7 +240,7 @@ PAD_CODE = 255
 def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
                  alpha, row_block, group_block, groups_loc, solver, cg_iters,
                  cg_dtype, compute_dtype, cg_unroll=False, cg_precond="none",
-                 val_affine=None):
+                 cg_active=None, val_affine=None):
     """Solve all groups of one shard from segmented virtual rows.
 
     Three stages, all static-shape:
@@ -301,12 +312,13 @@ def _solve_shard(Y, X_prev, idx, val, mask, seg, counts, *, rank, reg, implicit,
                          implicit=implicit, group_block=group_block,
                          groups_loc=groups_loc, solver=solver,
                          cg_iters=cg_iters, cg_dtype=cg_dtype,
-                         cg_unroll=cg_unroll, cg_precond=cg_precond)
+                         cg_unroll=cg_unroll, cg_precond=cg_precond,
+                         cg_active=cg_active)
 
 
 def _solve_groups(Ar, br, X_prev, seg, counts, Yc, *, rank, reg, implicit,
                   group_block, groups_loc, solver, cg_iters, cg_dtype,
-                  cg_unroll=False, cg_precond="none"):
+                  cg_unroll=False, cg_precond="none", cg_active=None):
     """Stages 2+3: segment-sum row partials to groups, regularize, solve."""
     f32 = jnp.float32
     A = jax.ops.segment_sum(Ar, seg, num_segments=groups_loc,
@@ -337,7 +349,8 @@ def _solve_groups(Ar, br, X_prev, seg, counts, Yc, *, rank, reg, implicit,
             x = _batched_cg(A_b, b_b, cg_iters, x0=x0_b,
                             matvec_dtype=jnp.dtype(cg_dtype),
                             unroll=cg_unroll,
-                            precond=cg_precond)   # [B, K]
+                            precond=cg_precond,
+                            active_steps=cg_active)   # [B, K]
         else:
             x = jnp.linalg.solve(A_b, b_b[..., None])[..., 0]
         # groups with no ratings keep EXACT zero factors (the iterative
@@ -966,8 +979,11 @@ def als_grid_train(
     n_items: int,
     cfg: ALSConfig,
     regs: "np.ndarray | list",
+    alphas: "np.ndarray | list | None" = None,
+    iterations: "np.ndarray | list | None" = None,
+    cg_iters: "np.ndarray | list | None" = None,
 ) -> List[ALSFactors]:
-    """Train EVERY regularization grid point simultaneously via vmap.
+    """Train EVERY hyperparameter grid point simultaneously via vmap.
 
     The hyperparameter-tuning capability Spark never had (SURVEY.md
     §7.6): the segmented layout is built and placed once, the factor
@@ -979,10 +995,27 @@ def als_grid_train(
     Single-device (the grid axis occupies the batch dimension; shard the
     DATA instead when one model alone saturates a chip).
 
-    Returns one ALSFactors per reg, in order.
+    Beyond ``regs``, candidates may differ in any SHAPE-STABLE scalar
+    (VERDICT r4 item 6): ``alphas`` (implicit confidence) rides the
+    vmap like reg; ``iterations`` and ``cg_iters`` are per-candidate
+    step BUDGETS — the program runs to the max and freezes a
+    candidate's state once its budget is spent, so each grid member
+    finishes bit-identical to a sequential run at its own counts (the
+    spent compute for frozen lanes is the usual vmap-padding trade).
+
+    Returns one ALSFactors per candidate, in order.
     """
     regs = np.asarray(regs, np.float32)
     G = len(regs)
+    alphas = (np.full(G, cfg.alpha, np.float32) if alphas is None
+              else np.asarray(alphas, np.float32))
+    iters_arr = (np.full(G, cfg.iterations, np.int32) if iterations is None
+                 else np.asarray(iterations, np.int32))
+    cg_arr = (np.full(G, cfg.cg_iters, np.int32) if cg_iters is None
+              else np.asarray(cg_iters, np.int32))
+    assert len(alphas) == G and len(iters_arr) == G and len(cg_arr) == G
+    max_iters = int(iters_arr.max())
+    max_cg = int(cg_arr.max())
     u_idx, i_idx, vals = user_coo
     by_user = _build_side(u_idx, i_idx, vals, n_users, cfg, 1, None)
     by_item = _build_side(i_idx, u_idx, vals, n_items, cfg, 1, None)
@@ -991,19 +1024,21 @@ def als_grid_train(
 
     def step_fn(side, groups_loc):
         kwargs = dict(
-            rank=cfg.rank, implicit=cfg.implicit, alpha=cfg.alpha,
+            rank=cfg.rank, implicit=cfg.implicit,
             row_block=side.row_block, group_block=side.group_block,
-            groups_loc=groups_loc, solver=cfg.solver, cg_iters=cfg.cg_iters,
+            groups_loc=groups_loc, solver=cfg.solver, cg_iters=max_cg,
             cg_dtype=cfg.cg_dtype, compute_dtype=cfg.compute_dtype,
             cg_unroll=cfg.cg_unroll, cg_precond=cfg.cg_precond,
         )
 
-        def one(Y, X_prev, reg, idx, val, mask, seg, counts):
+        def one(Y, X_prev, reg, alpha, cg_n, idx, val, mask, seg, counts):
             return _solve_shard(Y, X_prev, idx, val, mask, seg, counts,
-                                reg=reg, **kwargs)
+                                reg=reg, alpha=alpha, cg_active=cg_n,
+                                **kwargs)
 
-        # grid axis on factors + reg; the data layout is shared (None)
-        return jax.vmap(one, in_axes=(0, 0, 0, None, None, None, None, None))
+        # grid axis on factors + scalars; the data layout is shared (None)
+        return jax.vmap(one, in_axes=(0, 0, 0, 0, 0,
+                                      None, None, None, None, None))
 
     user_step = step_fn(by_user, g_users)
     item_step = step_fn(by_item, g_items)
@@ -1013,6 +1048,9 @@ def als_grid_train(
     X = _init_factors(ku, g_users, n_users, cfg.rank, grid=G)
     Y = _init_factors(ki, g_items, n_items, cfg.rank, grid=G)
     regs_dev = jnp.asarray(regs)
+    alphas_dev = jnp.asarray(alphas)
+    cg_dev = jnp.asarray(cg_arr)
+    iters_dev = jnp.asarray(iters_arr)
     ud = tuple(jnp.asarray(a) for a in
                (by_user.idx, by_user.val, by_user.mask, by_user.seg, by_user.counts))
     it = tuple(jnp.asarray(a) for a in
@@ -1020,13 +1058,17 @@ def als_grid_train(
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def run(X, Y):
-        def body(carry, _):
+        def body(carry, t):
             X, Y = carry
-            X = user_step(Y, X, regs_dev, *ud)
-            Y = item_step(X, Y, regs_dev, *it)
-            return (X, Y), None
+            X1 = user_step(Y, X, regs_dev, alphas_dev, cg_dev, *ud)
+            Y1 = item_step(X1, Y, regs_dev, alphas_dev, cg_dev, *it)
+            # per-candidate iteration budget: past it, the candidate's
+            # factors freeze (bit-identical to a sequential run at its
+            # own iteration count)
+            on = (t < iters_dev)[:, None, None]
+            return (jnp.where(on, X1, X), jnp.where(on, Y1, Y)), None
 
-        (X, Y), _ = jax.lax.scan(body, (X, Y), None, length=cfg.iterations)
+        (X, Y), _ = jax.lax.scan(body, (X, Y), jnp.arange(max_iters))
         return X, Y
 
     X, Y = run(X, Y)

@@ -1,34 +1,51 @@
 """Two-tower neural retrieval trained with in-batch softmax on the mesh.
 
 The stretch model proving DASE extends past factorization to deep
-models (SURVEY.md §7.7): a flax user tower and item tower (id embedding
--> optional MLP -> L2-normalized vector) trained on positive
-(user, item) events with a symmetric in-batch sampled-softmax loss —
-the standard retrieval formulation. The reference has no neural models
-(Spark MLlib only), so the behavior contract is the recommendation
-template's (same query/result surface as ALS); the training loop is
-what a TPU-native framework adds.
+models (SURVEY.md §7.7): a user tower and item tower (id embedding ->
+optional MLP -> L2-normalized vector) trained on positive (user, item)
+events with a symmetric in-batch sampled-softmax loss — the standard
+retrieval formulation. The reference has no neural models (Spark MLlib
+only), so the behavior contract is the recommendation template's (same
+query/result surface as ALS); the training loop is what a TPU-native
+framework adds.
+
+r5 redesign — the loop is shaped by what actually binds at catalog
+scale (1M x 128 tables), measured for the BENCH twotower stage:
+
+  - ROW-SPARSE table updates. A flax ``nn.Embed`` under
+    ``value_and_grad`` materializes a DENSE [N, E] gradient and a dense
+    optimizer pass per step — GBs of HBM traffic for a batch that
+    touches 8k of 1M rows. Tables here are raw arrays, gathered rows
+    enter the loss directly, and the update is rowwise ADAGRAD (the
+    DLRM-standard embedding optimizer): one scalar accumulator per row,
+    scatter-add (duplicate-index-safe), donated buffers so XLA updates
+    in place. Dense MLP params (when ``hidden``/``embed_dim`` add any)
+    keep AdamW.
+  - WHOLE EPOCH under one jit: positives live on device; each epoch is
+    a single ``lax.scan`` over a device-computed permutation — one
+    dispatch per epoch instead of one per batch, so neither host Python
+    nor (on a tunneled chip) per-batch transfers gap the device.
+  - bf16 MATMULS, f32 everywhere it matters: tower compute and the
+    [B, B] logits einsum run in ``compute_dtype`` (bf16 = native MXU
+    input) with f32 accumulation; the L2 normalization, softmax/CE, and
+    all optimizer state stay f32.
 
 Mesh mapping:
-  - batch axis sharded over ``data`` (DP): each device computes tower
-    forward/backward on its batch shard; GSPMD inserts the gradient
-    all-reduce. The in-batch softmax needs every item vector in the
-    batch, so logits induce an all-gather over ``data`` — the TPU
-    analogue of the reference's Spark shuffle, riding ICI.
-  - optionally the embedding tables are row-sharded over ``model``
-    (TP) for catalogs too large to replicate; lookups then gather over
-    ICI (``shard_embeddings``).
-
-Everything under jit: fixed batch shapes (short tails padded with
-zero-weight rows), `lax`-free host loop driving compiled steps.
+  - the scan's batch axis is sharding-constrained over ``data`` (DP):
+    each device gathers and runs tower compute on its batch shard; the
+    in-batch softmax needs every item vector, so the logits einsum
+    induces an all-gather over ``data`` — the TPU analogue of the
+    reference's Spark shuffle, riding ICI.
+  - optionally the tables (and their accumulators) are row-sharded
+    over ``model`` (TP) for catalogs too large to replicate
+    (``shard_embeddings``); lookups then gather over ICI.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Tuple
 
-import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -42,31 +59,32 @@ class TwoTowerConfig:
     hidden: Tuple[int, ...] = ()       # MLP widths on top of the id embedding
     embed_dim: Optional[int] = None    # id-embedding width (default: dim)
     temperature: float = 0.07
-    learning_rate: float = 3e-3
-    weight_decay: float = 1e-6
+    learning_rate: float = 3e-3        # dense (AdamW) learning rate
+    weight_decay: float = 1e-6         # dense AdamW weight decay
+    table_learning_rate: Optional[float] = None  # rowwise-adagrad lr for
+                                                 # the id tables (default:
+                                                 # 10x learning_rate — the
+                                                 # usual embedding/dense
+                                                 # split; adagrad shrinks
+                                                 # its own effective rate)
     epochs: int = 5
     batch_size: int = 1024
     seed: int = 11
+    compute_dtype: str = "bfloat16"    # tower matmul input dtype (f32 accum)
+    loss_chunk: Optional[int] = 2048   # blockwise in-batch CE: compute the
+                                       # [B, B] logits in [B, chunk] column
+                                       # tiles under jax.checkpoint so the
+                                       # full matrix never hits HBM (the
+                                       # flash-attention trick applied to
+                                       # the softmax CE) — engages when
+                                       # batch_size >= 2*chunk; None =
+                                       # always dense. Measured r5: the
+                                       # dense loss made the step HBM-bound
+                                       # on B^2 mask/softmax passes (6.4 ms
+                                       # at B=8192 D=128, 2.1% MFU)
     shard_embeddings: bool = False     # row-shard tables over the "model" axis
     checkpoint_dir: Optional[str] = None  # mid-training checkpoint/resume
     checkpoint_every: int = 1             # epochs between checkpoints
-
-
-class Tower(nn.Module):
-    """Id embedding -> MLP -> L2-normalized vector on the MXU."""
-
-    n_ids: int
-    cfg: TwoTowerConfig
-
-    @nn.compact
-    def __call__(self, idx: jax.Array) -> jax.Array:
-        width = self.cfg.embed_dim or self.cfg.dim
-        x = nn.Embed(self.n_ids, width, dtype=jnp.float32)(idx)
-        for h in self.cfg.hidden:
-            x = nn.relu(nn.Dense(h)(x))
-        if self.cfg.hidden or width != self.cfg.dim:
-            x = nn.Dense(self.cfg.dim)(x)
-        return x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-8)
 
 
 @dataclasses.dataclass
@@ -76,20 +94,148 @@ class TwoTowerEmbeddings:
     losses: List[float]      # per-epoch mean loss
 
 
-def _param_shardings(params, mesh: Mesh, shard_embeddings: bool):
-    """Replicate everything except (optionally) embedding tables, which
-    row-shard over the ``model`` axis."""
+def _init_dense(key, widths, cfg: TwoTowerConfig):
+    """He-init MLP params for one tower's tail ([] when the tail is
+    pure normalization)."""
+    layers = []
+    for w_in, w_out in zip(widths[:-1], widths[1:]):
+        key, k = jax.random.split(key)
+        layers.append({
+            "w": jax.random.normal(k, (w_in, w_out), jnp.float32)
+            * np.sqrt(2.0 / w_in),
+            "b": jnp.zeros((w_out,), jnp.float32),
+        })
+    return layers
 
-    def spec(path, leaf):
-        if (
-            shard_embeddings
-            and mesh.shape.get("model", 1) > 1
-            and any(getattr(p, "key", None) == "embedding" for p in path)
-        ):
-            return NamedSharding(mesh, P("model", None))
-        return NamedSharding(mesh, P())
 
-    return jax.tree_util.tree_map_with_path(spec, params)
+def _tail_widths(cfg: TwoTowerConfig) -> List[int]:
+    width = cfg.embed_dim or cfg.dim
+    widths = [width, *cfg.hidden]
+    if cfg.hidden or width != cfg.dim:
+        widths.append(cfg.dim)
+    return widths
+
+
+def _apply_tail(dense, x, cfg: TwoTowerConfig):
+    """Gathered embedding rows -> L2-normalized tower output.
+
+    Matmuls run in ``compute_dtype`` with f32 accumulation (MXU native);
+    the final normalization is f32 (a bf16 norm would quantize the unit
+    sphere the dot-product scores live on)."""
+    cdt = jnp.dtype(cfg.compute_dtype)
+    h = x
+    for li, layer in enumerate(dense):
+        h = jnp.einsum("be,eh->bh", h.astype(cdt), layer["w"].astype(cdt),
+                       preferred_element_type=jnp.float32) + layer["b"]
+        if li < len(dense) - 1:
+            h = jax.nn.relu(h)
+    h = h.astype(jnp.float32)
+    return h / jnp.maximum(jnp.linalg.norm(h, axis=-1, keepdims=True), 1e-8)
+
+
+def _dense_softmax_ce(u, v, u_idx, i_idx, weight, temp, cdt):
+    """Reference dense form: materializes the [B, B] logits and masks.
+
+    Kept for small batches (the blockwise form needs B >= 2*chunk) and
+    as the numerical ground truth the blockwise path is tested against.
+    Masks in-batch false negatives: the same item (user->item
+    direction) or the same user (item->user) elsewhere in the batch,
+    and zero-weight padding rows whose placeholders would otherwise act
+    as real negatives."""
+    logits = jnp.einsum("bd,cd->bc", u.astype(cdt), v.astype(cdt),
+                        preferred_element_type=jnp.float32) / temp
+    B = logits.shape[0]
+    eye = jnp.eye(B, dtype=bool)
+    pad_col = (weight <= 0.0)[None, :]
+    dup_i = ((i_idx[None, :] == i_idx[:, None]) | pad_col) & ~eye
+    dup_u = ((u_idx[None, :] == u_idx[:, None]) | pad_col) & ~eye
+    labels = jnp.arange(B)
+    l_ui = optax.softmax_cross_entropy_with_integer_labels(
+        jnp.where(dup_i, -1e9, logits), labels)
+    l_iu = optax.softmax_cross_entropy_with_integer_labels(
+        jnp.where(dup_u, -1e9, logits.T), labels)
+    wsum = jnp.maximum(weight.sum(), 1e-8)
+    return jnp.sum(0.5 * (l_ui + l_iu) * weight) / wsum
+
+
+def _blockwise_softmax_ce(u, v, u_idx, i_idx, weight, temp, chunk, cdt):
+    """Blockwise symmetric in-batch softmax CE (the flash-attention
+    trick applied to the retrieval loss): logits are computed in
+    [B, chunk] column tiles inside ``jax.checkpoint``, so the full
+    [B, B] matrix and its masks NEVER materialize in HBM — the step
+    stays matmul-bound instead of elementwise-HBM-bound (measured r5:
+    6.4 ms -> see bench twotower stage at B=8192, D=128).
+
+    One pass over column tiles yields BOTH directions: each tile
+    contributes a partial row-LSE for user->item (combined across tiles
+    afterwards) and the COMPLETE column-LSE for its items' item->user
+    terms. Same masking semantics as ``_dense_softmax_ce`` (tested
+    equal); the -1e9 sentinel (not -inf) keeps all-banned tiles' grads
+    finite."""
+    B, _ = u.shape
+    S = B // chunk
+    rows = jnp.arange(B)
+    v_t = v.reshape(S, chunk, -1)
+    i_t = i_idx.reshape(S, chunk)
+    w_t = weight.reshape(S, chunk)
+    col_t = rows.reshape(S, chunk)
+    pad_row = (weight <= 0.0)[:, None]
+    wsum = jnp.maximum(weight.sum(), 1e-8)
+
+    def tile(u, vc, ic, wc, colc):
+        # the tile logits stay in compute_dtype (bf16): the matmul
+        # output is the tile's dominant HBM stream and the CE reads it
+        # several times; unit-sphere logits (|L| <= 1/temp ~ 14) lose
+        # ~3 decimal digits to bf16, well inside the loss's tolerance
+        # (the LSE terms are max-subtracted before exp). The diag/LSE
+        # accumulations below are f32.
+        Lc = jnp.einsum("bd,cd->bc", u.astype(cdt), vc.astype(cdt)) / temp
+        not_diag = colc[None, :] != rows[:, None]
+        # the f32 casts below fuse into the reductions (registers, not
+        # HBM): only the matmul output's cdt stream touches memory,
+        # while every accumulation runs f32
+        f32 = jnp.float32
+        # user->item: ban duplicate items + pad columns (never the diag)
+        ban_ui = ((ic[None, :] == i_idx[:, None])
+                  | (wc <= 0.0)[None, :]) & not_diag
+        lse_ui_c = jax.nn.logsumexp(
+            jnp.where(ban_ui, -1e9, Lc).astype(f32), axis=1)     # [B]
+        diag_c = jnp.sum(jnp.where(~not_diag, Lc, 0.0).astype(f32), axis=1)
+        # item->user, complete for this tile's columns: ban duplicate
+        # users + pad rows
+        uc = u_idx[colc]
+        ban_iu = ((u_idx[:, None] == uc[None, :]) | pad_row) & not_diag
+        lse_iu_c = jax.nn.logsumexp(
+            jnp.where(ban_iu, -1e9, Lc).astype(f32), axis=0)     # [C]
+        pos_c = jnp.sum(jnp.where(~not_diag, Lc, 0.0).astype(f32), axis=0)
+        iu_contrib = jnp.sum(wc * (lse_iu_c - pos_c))
+        return lse_ui_c, diag_c, iu_contrib
+
+    tile = jax.checkpoint(tile)
+
+    # lax.scan over tiles (NOT a static unroll: measured on-chip at
+    # B=8192/chunk=2048, the unrolled form was 10% slower per step and
+    # ~2.5x slower to compile)
+    def body(carry, xs):
+        lse_ui_c, diag_c, iu_contrib = tile(u, *xs)
+        return carry + iu_contrib, (lse_ui_c, diag_c)
+
+    iu_total, (lse_parts, diag_parts) = jax.lax.scan(
+        body, jnp.float32(0.0), (v_t, i_t, w_t, col_t))
+    l_ui = jax.nn.logsumexp(lse_parts, axis=0) - diag_parts.sum(axis=0)
+    return (0.5 * (jnp.sum(l_ui * weight) + iu_total)) / wsum
+
+
+def _rowwise_adagrad(table, acc, idx, grad, lr, eps=1e-8):
+    """DLRM-style sparse embedding update: one accumulator scalar per
+    row, scatter-add so duplicate in-batch indices accumulate correctly,
+    and (with the caller donating) XLA performs the scatters in place —
+    per-step traffic is O(batch x dim), never O(vocab x dim)."""
+    g2 = jnp.mean(grad * grad, axis=-1)              # [B]
+    acc = acc.at[idx].add(g2)
+    scale = lr / jnp.sqrt(acc[idx] + eps)            # read after add
+    table = table.at[idx].add(-scale[:, None] * grad)
+    return table, acc
 
 
 class TwoTowerTrainer:
@@ -97,7 +243,7 @@ class TwoTowerTrainer:
 
     Mirrors ALSTrainer's shape: one-time costs (param init, device
     placement, compile) in the constructor, `run()` drives compiled
-    steps, `embeddings()` materializes the serving tables.
+    epochs, `embeddings()` materializes the serving tables.
     """
 
     def __init__(
@@ -112,37 +258,63 @@ class TwoTowerTrainer:
         self.cfg = cfg
         self.mesh = mesh
         self.n_users, self.n_items = n_users, n_items
-        self._u = np.asarray(u_idx, dtype=np.int32)
-        self._i = np.asarray(i_idx, dtype=np.int32)
-        self._w = (np.ones(len(self._u), np.float32) if w is None
-                   else np.asarray(w, dtype=np.float32))
+        u = np.asarray(u_idx, dtype=np.int32)
+        i = np.asarray(i_idx, dtype=np.int32)
+        w = (np.ones(len(u), np.float32) if w is None
+             else np.asarray(w, dtype=np.float32))
+        self.n_pos = len(u)
 
         n_data = mesh.shape.get("data", 1) if mesh is not None else 1
-        # fixed step shape: full batches only, tails padded via zero weight
-        self.batch = max(cfg.batch_size - cfg.batch_size % max(n_data, 1), n_data)
+        # fixed step shape: full batches only, tails padded via a dummy
+        # zero-weight row appended at index n_pos
+        self.batch = max(cfg.batch_size - cfg.batch_size % max(n_data, 1),
+                         n_data)
+        self.steps_per_epoch = max(1, -(-self.n_pos // self.batch))
 
-        self.user_tower = Tower(n_users, cfg)
-        self.item_tower = Tower(n_items, cfg)
-        k0, k1 = jax.random.split(jax.random.PRNGKey(cfg.seed))
-        probe = jnp.zeros((1,), jnp.int32)
-        params = {
-            "user": self.user_tower.init(k0, probe),
-            "item": self.item_tower.init(k1, probe),
+        # the dataset lives on device for the whole run (one transfer);
+        # index n_pos is the padding row. Replicated explicitly under a
+        # mesh so the epoch jit sees consistent placement.
+        def _put_data(a):
+            if mesh is not None:
+                return jax.device_put(a, NamedSharding(mesh, P()))
+            return jnp.asarray(a)
+
+        self._u = _put_data(np.concatenate([u, np.zeros(1, np.int32)]))
+        self._i = _put_data(np.concatenate([i, np.zeros(1, np.int32)]))
+        self._w = _put_data(np.concatenate([w, np.zeros(1, np.float32)]))
+
+        width = cfg.embed_dim or cfg.dim
+        k0, k1, k2, k3 = jax.random.split(jax.random.PRNGKey(cfg.seed), 4)
+        scale = 1.0 / np.sqrt(width)
+        tables = {
+            "user": jax.random.normal(k0, (n_users, width), jnp.float32) * scale,
+            "item": jax.random.normal(k1, (n_items, width), jnp.float32) * scale,
         }
-        self._tx = optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay)
-        opt_state = self._tx.init(params)
+        acc = {
+            "user": jnp.zeros((n_users,), jnp.float32),
+            "item": jnp.zeros((n_items,), jnp.float32),
+        }
+        widths = _tail_widths(cfg)
+        dense = {"user": _init_dense(k2, widths, cfg),
+                 "item": _init_dense(k3, widths, cfg)}
+        self._tx = optax.adamw(cfg.learning_rate,
+                               weight_decay=cfg.weight_decay)
+        opt_state = self._tx.init(dense)
+
         if mesh is not None:
-            pshard = _param_shardings(params, mesh, cfg.shard_embeddings)
-            params = jax.device_put(params, pshard)
-            opt_state = jax.device_put(
-                opt_state, _param_shardings(opt_state, mesh, cfg.shard_embeddings)
-            )
-            self._batch_sharding = NamedSharding(mesh, P("data"))
-        else:
-            self._batch_sharding = None
-        self._params, self._opt_state = params, opt_state
-        self._step = jax.jit(self._make_step(), donate_argnums=(0, 1))
-        self._epoch_rng = np.random.default_rng(cfg.seed)
+            if cfg.shard_embeddings and mesh.shape.get("model", 1) > 1:
+                tshard = NamedSharding(mesh, P("model", None))
+                ashard = NamedSharding(mesh, P("model"))
+            else:
+                tshard = NamedSharding(mesh, P())
+                ashard = NamedSharding(mesh, P())
+            rep = NamedSharding(mesh, P())
+            tables = {k: jax.device_put(v, tshard) for k, v in tables.items()}
+            acc = {k: jax.device_put(v, ashard) for k, v in acc.items()}
+            dense = jax.device_put(dense, rep)
+            opt_state = jax.device_put(opt_state, rep)
+        self._state = (tables, acc, dense, opt_state)
+        self._epoch_fn = self._make_epoch()
         self._epochs_done = 0
         self._losses: List[float] = []
 
@@ -156,9 +328,8 @@ class TwoTowerTrainer:
             )
 
             fp = train_fingerprint(
-                cfg, n_users, n_items, len(self._u),
-                self._u[:4096], self._u[-4096:],
-                self._i[:4096], self._w[:4096],
+                cfg, n_users, n_items, self.n_pos,
+                u[:4096], u[-4096:], i[:4096], w[:4096],
             )
             self._ckpt = TrainCheckpointer(cfg.checkpoint_dir,
                                            every=cfg.checkpoint_every,
@@ -166,107 +337,144 @@ class TwoTowerTrainer:
             restored = self._ckpt.restore()
             if restored is not None:
                 epoch, state = restored
-                params, opt_state = state["params"], state["opt_state"]
+                tables, acc, dense, opt_state = (
+                    state["tables"], state["acc"], state["dense"],
+                    state["opt_state"])
                 if mesh is not None:
-                    params = jax.device_put(
-                        params,
-                        _param_shardings(params, mesh, cfg.shard_embeddings))
-                    opt_state = jax.device_put(
-                        opt_state,
-                        _param_shardings(opt_state, mesh, cfg.shard_embeddings))
-                self._params, self._opt_state = params, opt_state
-                self._epoch_rng.bit_generator.state = state["rng_state"]
+                    tables = {k: jax.device_put(v, tshard)
+                              for k, v in tables.items()}
+                    acc = {k: jax.device_put(v, ashard)
+                           for k, v in acc.items()}
+                    dense = jax.device_put(dense, rep)
+                    opt_state = jax.device_put(opt_state, rep)
+                self._state = (tables, acc, dense, opt_state)
                 self._epochs_done = epoch
                 self._losses = list(state["losses"])
 
-    def _make_step(self):
-        temp = self.cfg.temperature
-        user_apply, item_apply = self.user_tower.apply, self.item_tower.apply
+    # -- loss ---------------------------------------------------------------
+
+    def _loss_from_rows(self, ue, ve, dense, u_idx, i_idx, weight):
+        cfg = self.cfg
+        u = _apply_tail(dense["user"], ue, cfg)         # [B, D] f32 unit
+        v = _apply_tail(dense["item"], ve, cfg)
+        B = u.shape[0]
+        chunk = cfg.loss_chunk
+        if chunk and B >= 2 * chunk and B % chunk == 0:
+            return _blockwise_softmax_ce(
+                u, v, u_idx, i_idx, weight, cfg.temperature, chunk,
+                jnp.dtype(cfg.compute_dtype))
+        return _dense_softmax_ce(
+            u, v, u_idx, i_idx, weight, cfg.temperature,
+            jnp.dtype(cfg.compute_dtype))
+
+    # -- epoch program ------------------------------------------------------
+
+    def _make_epoch(self):
+        cfg = self.cfg
         tx = self._tx
+        B = self.batch
+        S = self.steps_per_epoch
+        n = self.n_pos
+        table_lr = (cfg.table_learning_rate
+                    if cfg.table_learning_rate is not None
+                    else 10.0 * cfg.learning_rate)
+        mesh = self.mesh
+        dp = mesh is not None and mesh.shape.get("data", 1) > 1
+        loss_from_rows = self._loss_from_rows
 
-        def loss_fn(params, u_idx, i_idx, weight):
-            u = user_apply(params["user"], u_idx)           # [B, D]
-            v = item_apply(params["item"], i_idx)           # [B, D]
-            logits = (u @ v.T) / temp                       # [B, B] MXU
-            # mask in-batch false negatives: the same item (for the
-            # user->item direction) or the same user (item->user)
-            # elsewhere in the batch, and zero-weight padding rows whose
-            # (u0, i0) placeholders would otherwise act as real negatives
-            B = logits.shape[0]
-            eye = jnp.eye(B, dtype=bool)
-            pad_col = (weight <= 0.0)[None, :]
-            dup_i = ((i_idx[None, :] == i_idx[:, None]) | pad_col) & ~eye
-            dup_u = ((u_idx[None, :] == u_idx[:, None]) | pad_col) & ~eye
-            labels = jnp.arange(B)
-            l_ui = optax.softmax_cross_entropy_with_integer_labels(
-                jnp.where(dup_i, -1e9, logits), labels)
-            l_iu = optax.softmax_cross_entropy_with_integer_labels(
-                jnp.where(dup_u, -1e9, logits.T), labels)
-            wsum = jnp.maximum(weight.sum(), 1e-8)
-            return jnp.sum(0.5 * (l_ui + l_iu) * weight) / wsum
+        def step(carry, idx):
+            tables, acc, dense, opt_state = carry
+            u_idx = self._u[idx]
+            i_idx = self._i[idx]
+            w = self._w[idx]
+            ue = tables["user"][u_idx]                  # [B, E] gather
+            ve = tables["item"][i_idx]
+            loss, (gu, gv, gd) = jax.value_and_grad(
+                loss_from_rows, argnums=(0, 1, 2),
+            )(ue, ve, dense, u_idx, i_idx, w)
+            tables = dict(tables)
+            acc = dict(acc)
+            tables["user"], acc["user"] = _rowwise_adagrad(
+                tables["user"], acc["user"], u_idx, gu, table_lr)
+            tables["item"], acc["item"] = _rowwise_adagrad(
+                tables["item"], acc["item"], i_idx, gv, table_lr)
+            if any(len(v) for v in dense.values()):
+                updates, opt_state = tx.update(gd, opt_state, dense)
+                dense = optax.apply_updates(dense, updates)
+            return (tables, acc, dense, opt_state), loss
 
-        def step(params, opt_state, u_idx, i_idx, weight):
-            loss, grads = jax.value_and_grad(loss_fn)(params, u_idx, i_idx, weight)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, loss
+        def epoch(tables, acc, dense, opt_state, key):
+            perm = jax.random.permutation(key, n)
+            order = jnp.concatenate(
+                [perm.astype(jnp.int32),
+                 jnp.full((S * B - n,), n, jnp.int32)]).reshape(S, B)
+            if dp:
+                order = jax.lax.with_sharding_constraint(
+                    order, NamedSharding(mesh, P(None, "data")))
+            (tables, acc, dense, opt_state), losses = jax.lax.scan(
+                step, (tables, acc, dense, opt_state), order)
+            return tables, acc, dense, opt_state, losses.mean()
 
-        return step
-
-    def _batches(self):
-        n = len(self._u)
-        order = self._epoch_rng.permutation(n)
-        for s in range(0, n, self.batch):
-            sel = order[s:s + self.batch]
-            pad = self.batch - len(sel)
-            u, i, w = self._u[sel], self._i[sel], self._w[sel]
-            if pad:
-                u = np.concatenate([u, np.zeros(pad, np.int32)])
-                i = np.concatenate([i, np.zeros(pad, np.int32)])
-                w = np.concatenate([w, np.zeros(pad, np.float32)])
-            yield u, i, w
+        return jax.jit(epoch, donate_argnums=(0, 1, 2, 3))
 
     def run(self, epochs: Optional[int] = None) -> List[float]:
         """Train up to ``epochs`` TOTAL epochs (resume-aware: epochs
-        already completed by a restored checkpoint are not repeated)."""
+        already completed by a restored checkpoint are not repeated).
+        One device dispatch per epoch; the shuffle key derives from
+        (seed, epoch index) so a resumed run replays the same order."""
         target = epochs if epochs is not None else self.cfg.epochs
+        base = jax.random.PRNGKey(self.cfg.seed + 1)
         while self._epochs_done < target:
-            total, batches = 0.0, 0
-            for u, i, w in self._batches():
-                args = (jnp.asarray(u), jnp.asarray(i), jnp.asarray(w))
-                if self._batch_sharding is not None:
-                    args = tuple(jax.device_put(a, self._batch_sharding) for a in args)
-                self._params, self._opt_state, loss = self._step(
-                    self._params, self._opt_state, *args
-                )
-                total += float(loss)
-                batches += 1
-            self._losses.append(total / max(batches, 1))
+            key = jax.random.fold_in(base, self._epochs_done)
+            *state, mean_loss = self._epoch_fn(*self._state, key)
+            self._state = tuple(state)
+            self._losses.append(float(mean_loss))
             self._epochs_done += 1
             if self._ckpt is not None:
+                tables, acc, dense, opt_state = self._state
                 self._ckpt.maybe_save(self._epochs_done, {
-                    "params": self._params,
-                    "opt_state": self._opt_state,
-                    "rng_state": self._epoch_rng.bit_generator.state,
-                    "losses": list(self._losses),
+                    "tables": tables, "acc": acc, "dense": dense,
+                    "opt_state": opt_state, "losses": list(self._losses),
                 })
         return list(self._losses)
 
-    def _all_vecs(self, tower: Tower, side: str, n: int) -> np.ndarray:
-        apply = jax.jit(tower.apply)
+    # -- serving tables -----------------------------------------------------
+
+    def _all_vecs(self, side: str, n: int) -> np.ndarray:
+        tables, _, dense, _ = self._state
+        cfg = self.cfg
+
+        @jax.jit
+        def fwd(table_chunk, dense_side):
+            return _apply_tail(dense_side, table_chunk, cfg)
+
         chunk = 8192
-        out = np.empty((n, self.cfg.dim), np.float32)
+        out = np.empty((n, cfg.dim), np.float32)
         for s in range(0, n, chunk):
-            idx = jnp.arange(s, min(s + chunk, n), dtype=jnp.int32)
-            out[s:s + len(idx)] = np.asarray(apply(self._params[side], idx))
+            e = min(s + chunk, n)
+            out[s:e] = np.asarray(fwd(tables[side][s:e], dense[side]))
         return out
 
     def embeddings(self, losses: Optional[List[float]] = None) -> TwoTowerEmbeddings:
         return TwoTowerEmbeddings(
-            user_vecs=self._all_vecs(self.user_tower, "user", self.n_users),
-            item_vecs=self._all_vecs(self.item_tower, "item", self.n_items),
+            user_vecs=self._all_vecs("user", self.n_users),
+            item_vecs=self._all_vecs("item", self.n_items),
             losses=losses or [],
         )
+
+    # -- bench hooks --------------------------------------------------------
+
+    def matmul_flops_per_step(self) -> float:
+        """Analytic matmul FLOPs per training step (fwd + bwd): the
+        [B, B] logits einsum and its two rank-D backward products, plus
+        the tail MLP matmuls — the basis the bench's MFU cross-checks
+        against the xplane trace's XLA cost-model count."""
+        B, D = self.batch, self.cfg.dim
+        flops = 3 * 2.0 * B * B * D          # logits fwd + dL/du + dL/dv
+        widths = _tail_widths(self.cfg)
+        per_row = sum(2.0 * a * b for a, b in zip(widths[:-1], widths[1:]))
+        flops += 2 * 3 * per_row * B         # two towers, fwd+bwd(x2)
+        return flops
 
 
 def twotower_train(

@@ -127,6 +127,14 @@ class _ScanRegistry:
                 pass
         return scan is not None
 
+    def live_count(self) -> int:
+        """Spools currently held on disk (reaps expired ones first) —
+        the observability hook a soak test needs to PROVE the TTL
+        reaper fires instead of spool files accumulating forever."""
+        with self._lock:
+            self._reap_locked()
+            return len(self._scans)
+
     def _reap_locked(self) -> None:
         now = time.monotonic()
         for sid in [s for s, v in self._scans.items()
@@ -637,6 +645,7 @@ class StorageServer(HTTPServerBase):
             "columnar_scans": scans,
             "columnar_scan_count": totals["scans"],
             "columnar_rows_served": totals["rows"],
+            "live_scan_spools": self.scans.live_count(),
         }
 
     def stop(self) -> None:

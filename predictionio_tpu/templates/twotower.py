@@ -2,7 +2,7 @@
 
 Same data contract as the recommendation template (rate/buy events,
 ref: examples/scala-parallel-recommendation DataSource.scala:31), with
-the flax two-tower retrieval model in the Algorithm slot instead of
+the two-tower retrieval model in the Algorithm slot instead of
 ALS. `twotower_hybrid_engine` runs BOTH algorithms and averages their
 scores at serve time — exercising the reference's multi-algorithm
 Serving contract (CreateServer.scala:472–475) with a deep + linear

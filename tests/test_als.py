@@ -360,3 +360,58 @@ def test_grid_train_vmapped_matches_sequential():
         grid_rmse = predict_rmse(factors, coo)
         solo_rmse = predict_rmse(solo, coo)
         assert abs(grid_rmse - solo_rmse) < 0.05, (reg, grid_rmse, solo_rmse)
+
+
+def test_grid_train_multi_scalar_matches_sequential():
+    """VERDICT r4 item 6: candidates differing in reg AND iteration
+    budget AND cg budget ride ONE vmapped dispatch — each candidate's
+    factors match its own dedicated sequential run (the run-to-max +
+    freeze masking must be numerically faithful, not approximate)."""
+    import dataclasses
+
+    from predictionio_tpu.ops.als import als_grid_train
+
+    rng = np.random.default_rng(11)
+    n, n_users, n_items = 8000, 120, 40
+    coo = (rng.integers(0, n_users, n), rng.integers(0, n_items, n),
+           (1.0 + rng.integers(0, 9, n) * 0.5).astype(np.float32))
+    cfg = ALSConfig(rank=4, iterations=4, reg=0.1, block_size=32,
+                    compute_dtype="float32", cg_dtype="float32")
+    regs = [0.05, 0.1, 0.5]
+    iters = [2, 4, 3]
+    cgs = [6, 4, 6]
+    out = als_grid_train(coo, n_users, n_items, cfg, regs=regs,
+                        iterations=iters, cg_iters=cgs)
+    assert len(out) == 3
+    for g, (reg, it, cg) in enumerate(zip(regs, iters, cgs)):
+        solo = als_train(coo, n_users, n_items, dataclasses.replace(
+            cfg, reg=reg, iterations=it, cg_iters=cg))
+        np.testing.assert_allclose(
+            out[g].user_factors, solo.user_factors, rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(
+            out[g].item_factors, solo.item_factors, rtol=2e-4, atol=2e-4)
+
+
+def test_grid_train_implicit_alpha_axis():
+    """The implicit-feedback confidence scale rides the grid too."""
+    import dataclasses
+
+    from predictionio_tpu.ops.als import als_grid_train
+
+    rng = np.random.default_rng(3)
+    n, n_users, n_items = 5000, 80, 30
+    coo = (rng.integers(0, n_users, n), rng.integers(0, n_items, n),
+           rng.integers(1, 6, n).astype(np.float32))
+    cfg = ALSConfig(rank=4, iterations=3, reg=0.1, block_size=32,
+                    implicit=True, compute_dtype="float32",
+                    cg_dtype="float32")
+    alphas = [0.5, 2.0, 8.0]
+    out = als_grid_train(coo, n_users, n_items, cfg,
+                        regs=[0.1] * 3, alphas=alphas)
+    for g, alpha in enumerate(alphas):
+        solo = als_train(coo, n_users, n_items,
+                         dataclasses.replace(cfg, alpha=alpha))
+        # vmapped YtY/einsum reduce order differs slightly from the
+        # sequential program: tolerance, not exactness, is the contract
+        np.testing.assert_allclose(
+            out[g].user_factors, solo.user_factors, rtol=6e-4, atol=6e-4)
