@@ -415,3 +415,23 @@ def test_grid_train_implicit_alpha_axis():
         # sequential program: tolerance, not exactness, is the contract
         np.testing.assert_allclose(
             out[g].user_factors, solo.user_factors, rtol=6e-4, atol=6e-4)
+
+
+def test_map_batch_matches_default():
+    """map_batch (lax.map batch_size) is a measured-rejected perf knob
+    kept for re-measurement; its vmapped path must stay numerically
+    equal to the default, including a batch that does not divide the
+    block count."""
+    import dataclasses
+
+    coo = (np.array([0, 1, 2, 3, 1, 2]), np.array([0, 1, 0, 1, 0, 1]),
+           np.array([1.0, 2.0, 3.0, 4.0, 5.0, 1.5], np.float32))
+    cfg = ALSConfig(rank=4, iterations=2, reg=0.1, block_size=8,
+                    compute_dtype="float32", cg_dtype="float32")
+    base = als_train(coo, 5, 2, cfg)
+    for mb in (2, 3):
+        f = als_train(coo, 5, 2, dataclasses.replace(cfg, map_batch=mb))
+        np.testing.assert_allclose(f.user_factors, base.user_factors,
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(f.item_factors, base.item_factors,
+                                   rtol=1e-5, atol=1e-5)
