@@ -159,6 +159,19 @@ def _dense_softmax_ce(u, v, u_idx, i_idx, weight, temp, cdt):
 
 
 def _blockwise_softmax_ce(u, v, u_idx, i_idx, weight, temp, chunk, cdt):
+    """Dispatch: hand-written VJP by default (fewer backward passes —
+    the saved LSEs make the softmax reconstruction one fused pass per
+    tile, skipping autodiff's scan-reversal and logsumexp-grad
+    plumbing); the checkpoint-autodiff form below remains as
+    ``_blockwise_softmax_ce_autodiff`` and the equivalence tests pin
+    the two to each other and to the dense reference."""
+    fn = _make_blockwise_ce_vjp(u_idx, i_idx, weight, temp, chunk, cdt,
+                                u.shape[0])
+    return fn(u, v)
+
+
+def _blockwise_softmax_ce_autodiff(u, v, u_idx, i_idx, weight, temp, chunk,
+                                   cdt):
     """Blockwise symmetric in-batch softmax CE (the flash-attention
     trick applied to the retrieval loss): logits are computed in
     [B, chunk] column tiles inside ``jax.checkpoint``, so the full
@@ -188,26 +201,12 @@ def _blockwise_softmax_ce(u, v, u_idx, i_idx, weight, temp, chunk, cdt):
         # several times; unit-sphere logits (|L| <= 1/temp ~ 14) lose
         # ~3 decimal digits to bf16, well inside the loss's tolerance
         # (the LSE terms are max-subtracted before exp). The diag/LSE
-        # accumulations below are f32.
+        # accumulations (inside _tile_stats) are f32.
         Lc = jnp.einsum("bd,cd->bc", u.astype(cdt), vc.astype(cdt)) / temp
-        not_diag = colc[None, :] != rows[:, None]
-        # the f32 casts below fuse into the reductions (registers, not
-        # HBM): only the matmul output's cdt stream touches memory,
-        # while every accumulation runs f32
-        f32 = jnp.float32
-        # user->item: ban duplicate items + pad columns (never the diag)
-        ban_ui = ((ic[None, :] == i_idx[:, None])
-                  | (wc <= 0.0)[None, :]) & not_diag
-        lse_ui_c = jax.nn.logsumexp(
-            jnp.where(ban_ui, -1e9, Lc).astype(f32), axis=1)     # [B]
-        diag_c = jnp.sum(jnp.where(~not_diag, Lc, 0.0).astype(f32), axis=1)
-        # item->user, complete for this tile's columns: ban duplicate
-        # users + pad rows
-        uc = u_idx[colc]
-        ban_iu = ((u_idx[:, None] == uc[None, :]) | pad_row) & not_diag
-        lse_iu_c = jax.nn.logsumexp(
-            jnp.where(ban_iu, -1e9, Lc).astype(f32), axis=0)     # [C]
-        pos_c = jnp.sum(jnp.where(~not_diag, Lc, 0.0).astype(f32), axis=0)
+        not_diag, ban_ui, ban_iu = _tile_masks(
+            rows, u_idx, i_idx, pad_row, ic, wc, colc, u_idx[colc])
+        lse_ui_c, diag_c, lse_iu_c, pos_c = _tile_stats(
+            Lc, not_diag, ban_ui, ban_iu)
         iu_contrib = jnp.sum(wc * (lse_iu_c - pos_c))
         return lse_ui_c, diag_c, iu_contrib
 
@@ -224,6 +223,125 @@ def _blockwise_softmax_ce(u, v, u_idx, i_idx, weight, temp, chunk, cdt):
         body, jnp.float32(0.0), (v_t, i_t, w_t, col_t))
     l_ui = jax.nn.logsumexp(lse_parts, axis=0) - diag_parts.sum(axis=0)
     return (0.5 * (jnp.sum(l_ui * weight) + iu_total)) / wsum
+
+
+def _tile_masks(rows, u_idx, i_idx, pad_row, ic, wc, colc, uc):
+    """The ONE place the in-batch false-negative banning semantics
+    live for the blockwise forms (the dense reference states them
+    independently and the equivalence tests pin all three): ban the
+    same item elsewhere in the batch (user->item), the same user
+    (item->user), and zero-weight padding rows/columns — never the
+    diagonal."""
+    not_diag = colc[None, :] != rows[:, None]
+    ban_ui = ((ic[None, :] == i_idx[:, None])
+              | (wc <= 0.0)[None, :]) & not_diag
+    ban_iu = ((u_idx[:, None] == uc[None, :]) | pad_row) & not_diag
+    return not_diag, ban_ui, ban_iu
+
+
+def _tile_stats(Lc, not_diag, ban_ui, ban_iu):
+    """Per-tile LSE/diag reductions shared by both blockwise forms.
+    The f32 casts fuse into the reductions (registers, not HBM): only
+    the matmul output's cdt stream touches memory."""
+    f32 = jnp.float32
+    lse_ui_c = jax.nn.logsumexp(
+        jnp.where(ban_ui, -1e9, Lc).astype(f32), axis=1)      # [B]
+    diag_c = jnp.sum(jnp.where(~not_diag, Lc, 0.0).astype(f32), axis=1)
+    lse_iu_c = jax.nn.logsumexp(
+        jnp.where(ban_iu, -1e9, Lc).astype(f32), axis=0)      # [C]
+    pos_c = jnp.sum(jnp.where(~not_diag, Lc, 0.0).astype(f32), axis=0)
+    return lse_ui_c, diag_c, lse_iu_c, pos_c
+
+
+def _make_blockwise_ce_vjp(u_idx, i_idx, weight, temp, chunk, cdt, B):
+    """Blockwise CE with a HAND-WRITTEN VJP.
+
+    Forward matches ``_blockwise_softmax_ce_autodiff`` (tested equal);
+    backward uses the saved row/column LSEs directly:
+
+        dLoss/dL[b,j] = [w_b (p_ui - δ) + w_j (p_iu - δ)] / (2·Σw)
+        p_ui[b,j] = exp(L[b,j] - lse_ui[b])   (0 where banned)
+        p_iu[b,j] = exp(L[b,j] - lse_iu[j])   (0 where banned)
+
+    so the softmax reconstruction is ONE fused exp/where pass per tile
+    feeding two grad matmuls — no autodiff scan-reversal, no
+    logsumexp-grad max-pass recompute. Only (u, v) residuals plus two
+    [B] LSE vectors are saved."""
+    S = B // chunk
+    rows = jnp.arange(B)
+    i_t = i_idx.reshape(S, chunk)
+    w_t = weight.reshape(S, chunk)
+    col_t = rows.reshape(S, chunk)
+    uc_t = u_idx.reshape(S, chunk)
+    pad_row = (weight <= 0.0)[:, None]
+    wsum = jnp.maximum(weight.sum(), 1e-8)
+    f32 = jnp.float32
+
+    def masks(ic, wc, colc, uc):
+        return _tile_masks(rows, u_idx, i_idx, pad_row, ic, wc, colc, uc)
+
+    def _fwd_parts(u, v):
+        v_t = v.reshape(S, chunk, -1)
+
+        def body(iu_acc, xs):
+            vc, ic, wc, colc, uc = xs
+            Lc = jnp.einsum("bd,cd->bc", u.astype(cdt),
+                            vc.astype(cdt)) / temp
+            not_diag, ban_ui, ban_iu = masks(ic, wc, colc, uc)
+            lse_c, diag_c, lse_iu_c, pos_c = _tile_stats(
+                Lc, not_diag, ban_ui, ban_iu)
+            iu_acc = iu_acc + jnp.sum(wc * (lse_iu_c - pos_c))
+            return iu_acc, (lse_c, diag_c, lse_iu_c)
+
+        iu_total, (lse_parts, diag_parts, lse_iu_parts) = jax.lax.scan(
+            body, jnp.float32(0.0), (v_t, i_t, w_t, col_t, uc_t))
+        lse_ui = jax.nn.logsumexp(lse_parts, axis=0)          # [B]
+        l_ui = lse_ui - diag_parts.sum(axis=0)
+        loss = 0.5 * (jnp.sum(l_ui * weight) + iu_total) / wsum
+        return loss, lse_ui, lse_iu_parts.reshape(B)
+
+    @jax.custom_vjp
+    def ce(u, v):
+        return _fwd_parts(u, v)[0]
+
+    def fwd(u, v):
+        loss, lse_ui, lse_iu = _fwd_parts(u, v)
+        return loss, (u, v, lse_ui, lse_iu)
+
+    def bwd(res, ct):
+        u, v, lse_ui, lse_iu = res
+        v_t = v.reshape(S, chunk, -1)
+        lse_iu_t = lse_iu.reshape(S, chunk)
+        scale = ct / (2.0 * wsum * temp)
+
+        def body(du, xs):
+            vc, ic, wc, colc, uc, lse_iu_c = xs
+            # recompute the tile logits EXACTLY as fwd did (cdt divide
+            # BEFORE the f32 cast): under bf16 a different rounding
+            # here would reconstruct probabilities inconsistent with
+            # the saved LSEs — a systematic grad bias (r5 review)
+            Lc = (jnp.einsum("bd,cd->bc", u.astype(cdt),
+                             vc.astype(cdt)) / temp).astype(f32)
+            not_diag, ban_ui, ban_iu = masks(ic, wc, colc, uc)
+            p_ui = jnp.where(ban_ui, 0.0, jnp.exp(Lc - lse_ui[:, None]))
+            p_iu = jnp.where(ban_iu, 0.0, jnp.exp(Lc - lse_iu_c[None, :]))
+            isdiag = (~not_diag).astype(f32)
+            coef = (weight[:, None] * (p_ui - isdiag)
+                    + wc[None, :] * (p_iu - isdiag)) * scale
+            cc = coef.astype(cdt)
+            du = du + jnp.einsum("bc,cd->bd", cc, vc.astype(cdt),
+                                 preferred_element_type=f32)
+            dvc = jnp.einsum("bc,bd->cd", cc, u.astype(cdt),
+                             preferred_element_type=f32)
+            return du, dvc
+
+        du, dv_t = jax.lax.scan(
+            body, jnp.zeros_like(u),
+            (v_t, i_t, w_t, col_t, uc_t, lse_iu_t))
+        return du, dv_t.reshape(B, -1)
+
+    ce.defvjp(fwd, bwd)
+    return ce
 
 
 def _rowwise_adagrad(table, acc, idx, grad, lr, eps=1e-8):
