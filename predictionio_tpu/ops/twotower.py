@@ -183,8 +183,11 @@ def _blockwise_softmax_ce_autodiff(u, v, u_idx, i_idx, weight, temp, chunk,
     contributes a partial row-LSE for user->item (combined across tiles
     afterwards) and the COMPLETE column-LSE for its items' item->user
     terms. Same masking semantics as ``_dense_softmax_ce`` (tested
-    equal); the -1e9 sentinel (not -inf) keeps all-banned tiles' grads
-    finite."""
+    equal). With the default temperature the direct-exp one-pass LSE
+    runs (see _tile_stats — banned entries contribute exp=0, all-banned
+    tile parts go -inf and the cross-tile combine absorbs them); the
+    1/temp > _DIRECT_EXP_MAX_INV_TEMP fallback uses a -1e9 sentinel
+    (not -inf) so all-banned tiles' grads stay finite under autodiff."""
     B, _ = u.shape
     S = B // chunk
     rows = jnp.arange(B)
@@ -194,19 +197,19 @@ def _blockwise_softmax_ce_autodiff(u, v, u_idx, i_idx, weight, temp, chunk,
     col_t = rows.reshape(S, chunk)
     pad_row = (weight <= 0.0)[:, None]
     wsum = jnp.maximum(weight.sum(), 1e-8)
+    direct_exp = (1.0 / temp) <= _DIRECT_EXP_MAX_INV_TEMP
 
     def tile(u, vc, ic, wc, colc):
         # the tile logits stay in compute_dtype (bf16): the matmul
         # output is the tile's dominant HBM stream and the CE reads it
         # several times; unit-sphere logits (|L| <= 1/temp ~ 14) lose
-        # ~3 decimal digits to bf16, well inside the loss's tolerance
-        # (the LSE terms are max-subtracted before exp). The diag/LSE
-        # accumulations (inside _tile_stats) are f32.
+        # ~3 decimal digits to bf16, well inside the loss's tolerance.
+        # The diag/LSE accumulations (inside _tile_stats) are f32.
         Lc = jnp.einsum("bd,cd->bc", u.astype(cdt), vc.astype(cdt)) / temp
         not_diag, ban_ui, ban_iu = _tile_masks(
             rows, u_idx, i_idx, pad_row, ic, wc, colc, u_idx[colc])
         lse_ui_c, diag_c, lse_iu_c, pos_c = _tile_stats(
-            Lc, not_diag, ban_ui, ban_iu)
+            Lc, not_diag, ban_ui, ban_iu, direct_exp)
         iu_contrib = jnp.sum(wc * (lse_iu_c - pos_c))
         return lse_ui_c, diag_c, iu_contrib
 
@@ -239,16 +242,37 @@ def _tile_masks(rows, u_idx, i_idx, pad_row, ic, wc, colc, uc):
     return not_diag, ban_ui, ban_iu
 
 
-def _tile_stats(Lc, not_diag, ban_ui, ban_iu):
+#: direct exp-sum-log is safe while |logit| <= 1/temp stays under this.
+#: f32 overflows at exp(~88.7) and the reduction sums up to B terms, so
+#: the bound needs ln(B) headroom: 70 + ln(2^24) ~ 86.6 keeps the SUM
+#: finite for any batch this module could run. Tower outputs are
+#: L2-normalized, so the logit bound itself is STRUCTURAL.
+_DIRECT_EXP_MAX_INV_TEMP = 70.0
+
+
+def _tile_stats(Lc, not_diag, ban_ui, ban_iu, direct_exp):
     """Per-tile LSE/diag reductions shared by both blockwise forms.
     The f32 casts fuse into the reductions (registers, not HBM): only
-    the matmul output's cdt stream touches memory."""
+    the matmul output's cdt stream touches memory.
+
+    ``direct_exp`` (on whenever 1/temp <= _DIRECT_EXP_MAX_INV_TEMP):
+    unit-sphere logits are bounded by 1/temp, so exp cannot overflow
+    f32 and the LSEs compute as log(sum(exp(L))) in ONE pass — no
+    max-subtraction reduction. Banned entries contribute exp=0; a tile
+    whose row/column is fully banned yields -inf, which the cross-tile
+    logsumexp combine absorbs (the diagonal is never banned, so every
+    row/column has a finite part somewhere)."""
     f32 = jnp.float32
-    lse_ui_c = jax.nn.logsumexp(
-        jnp.where(ban_ui, -1e9, Lc).astype(f32), axis=1)      # [B]
+    if direct_exp:
+        e = jnp.exp(Lc.astype(f32))
+        lse_ui_c = jnp.log(jnp.sum(jnp.where(ban_ui, 0.0, e), axis=1))
+        lse_iu_c = jnp.log(jnp.sum(jnp.where(ban_iu, 0.0, e), axis=0))
+    else:
+        lse_ui_c = jax.nn.logsumexp(
+            jnp.where(ban_ui, -1e9, Lc).astype(f32), axis=1)  # [B]
+        lse_iu_c = jax.nn.logsumexp(
+            jnp.where(ban_iu, -1e9, Lc).astype(f32), axis=0)  # [C]
     diag_c = jnp.sum(jnp.where(~not_diag, Lc, 0.0).astype(f32), axis=1)
-    lse_iu_c = jax.nn.logsumexp(
-        jnp.where(ban_iu, -1e9, Lc).astype(f32), axis=0)      # [C]
     pos_c = jnp.sum(jnp.where(~not_diag, Lc, 0.0).astype(f32), axis=0)
     return lse_ui_c, diag_c, lse_iu_c, pos_c
 
@@ -276,6 +300,7 @@ def _make_blockwise_ce_vjp(u_idx, i_idx, weight, temp, chunk, cdt, B):
     pad_row = (weight <= 0.0)[:, None]
     wsum = jnp.maximum(weight.sum(), 1e-8)
     f32 = jnp.float32
+    direct_exp = (1.0 / temp) <= _DIRECT_EXP_MAX_INV_TEMP
 
     def masks(ic, wc, colc, uc):
         return _tile_masks(rows, u_idx, i_idx, pad_row, ic, wc, colc, uc)
@@ -289,7 +314,7 @@ def _make_blockwise_ce_vjp(u_idx, i_idx, weight, temp, chunk, cdt, B):
                             vc.astype(cdt)) / temp
             not_diag, ban_ui, ban_iu = masks(ic, wc, colc, uc)
             lse_c, diag_c, lse_iu_c, pos_c = _tile_stats(
-                Lc, not_diag, ban_ui, ban_iu)
+                Lc, not_diag, ban_ui, ban_iu, direct_exp)
             iu_acc = iu_acc + jnp.sum(wc * (lse_iu_c - pos_c))
             return iu_acc, (lse_c, diag_c, lse_iu_c)
 
