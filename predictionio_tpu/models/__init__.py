@@ -10,7 +10,7 @@ a DASE Algorithm wrapper:
   ecommerce      — ALS + business-rule serving filters
                    (ref: scala-parallel-ecommercerecommendation)
   markov         — top-N transition chains (ref: e2/.../MarkovChain.scala)
-  two_tower      — flax neural recommender (stretch config in BASELINE.json)
+  two_tower      — neural retrieval recommender (stretch config in BASELINE.json)
 """
 
 from typing import Any, Callable, List, Sequence, Tuple
