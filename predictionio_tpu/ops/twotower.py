@@ -384,8 +384,17 @@ def _rowwise_adagrad(table, acc, idx, grad, lr, eps=1e-8):
       - argsort + ``indices_are_sorted=True`` (the "sorted fast path"
         theory): step 4.16 -> 6.37 ms — the sorted lowering plus the
         [B, E] gather-reorder is 2.6x SLOWER than the plain unsorted
-        scatter at these shapes.
-    The unsorted duplicate-safe scatter-add stands."""
+        scatter at these shapes;
+      - FUSING the accumulator into the table as a 129th column (one
+        [N, E+1] scatter per side instead of table-scatter +
+        acc-scatter + acc-gather): step 2.90 -> 2.98 ms — the odd row
+        width breaks (8,128) tile alignment so each scattered row
+        spans two lane tiles (scatter fusions 0.62 -> 0.71 ms each),
+        while the dropped acc ops were nearly free (their rows are
+        scalar-thin; the scatter cost scales with aligned row tiles,
+        not a fixed per-row issue rate).
+    The unsorted duplicate-safe scatter-add on the [N, E] table
+    stands."""
     g2 = jnp.mean(grad * grad, axis=-1)              # [B]
     acc = acc.at[idx].add(g2)
     scale = lr / jnp.sqrt(acc[idx] + eps)            # read after add
