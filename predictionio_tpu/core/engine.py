@@ -64,7 +64,14 @@ def _declared_params_class(cls: type) -> Optional[Type[Params]]:
 
     try:
         hints = typing.get_type_hints(cls.__init__)
-    except Exception:
+    except Exception as e:
+        # get_type_hints eval()s forward refs, so a user component's
+        # annotations can raise anything; fall back to "no declared
+        # params class", but say so — a silent None here surfaces later
+        # as unvalidated params
+        log.warning("cannot resolve type hints on %s.__init__ (%s: %s); "
+                    "params dataclass not auto-detected",
+                    cls.__name__, type(e).__name__, e)
         return None
     ann = hints.get("params")
     return ann if isinstance(ann, type) else None

@@ -20,6 +20,7 @@ import abc
 import dataclasses
 import datetime as _dt
 import hashlib
+import logging
 import os
 import re
 import threading
@@ -39,6 +40,8 @@ from predictionio_tpu.data.metadata import (
     EvaluationInstance,
     Model,
 )
+
+log = logging.getLogger(__name__)
 
 #: sentinel distinguishing "don't filter" from "filter for None"
 #: (ref: PEvents.find targetEntityType: Option[Option[String]])
@@ -824,7 +827,9 @@ class Storage:
         for repo in REPOSITORIES:
             try:
                 results[repo] = self.client_for(repo).health_check()
-            except Exception:
+            except Exception as e:
+                log.warning("health check failed for %s: %s: %s",
+                            repo, type(e).__name__, e)
                 results[repo] = False
         return results
 
@@ -846,7 +851,9 @@ class Storage:
                               else {"": client.health_check()})
                     probed[id(client)] = cached
                 out[repo] = dict(cached)
-            except Exception:
+            except Exception as e:
+                log.warning("health detail probe failed for %s: %s: %s",
+                            repo, type(e).__name__, e)
                 out[repo] = {"": False}
         return out
 
@@ -880,7 +887,9 @@ class Storage:
                     "degraded": bool(serving) and not tiers["all_up"],
                     "endpoints": dict(tiers["endpoints"]),
                 }
-            except Exception:
+            except Exception as e:
+                log.warning("serving-status probe failed for %s: %s: %s",
+                            repo, type(e).__name__, e)
                 out[repo] = {"serving": False, "degraded": False,
                              "endpoints": {"": False}}
         return out

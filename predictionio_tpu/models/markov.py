@@ -48,7 +48,7 @@ class MarkovChainModel:
 
     def predict(self, current_state: Sequence[float]) -> List[float]:
         """Next-state probabilities (ref: MarkovChainModel.predict :72)."""
-        current = jnp.asarray(np.asarray(current_state, dtype=np.float32))
+        current = jnp.asarray(current_state, dtype=jnp.float32)
         if current.shape[0] != self.n_states:
             raise ValueError(
                 f"current_state has {current.shape[0]} entries, "

@@ -27,6 +27,13 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 
+#: The declared mesh axis names. graftlint JT05 parses this assignment
+#: statically: a PartitionSpec in ops/, parallel/ or templates/ naming
+#: an axis outside this tuple is flagged (the array would be silently
+#: replicated instead of sharded). Extend HERE when adding an axis.
+MESH_AXES: Tuple[str, ...] = ("data", "model")
+
+
 def local_device_count() -> int:
     return jax.local_device_count()
 
@@ -39,7 +46,8 @@ def create_mesh(
 
     Default: all devices on the ``data`` axis, ``model`` axis of 1 —
     pure DP, the layout matching the reference's Spark data parallelism
-    (SURVEY.md §2.9).
+    (SURVEY.md §2.9). Built-in code must stick to the ``MESH_AXES``
+    names; custom meshes (tests, experiments) may name axes freely.
     """
     # every training/serving path builds a mesh before compiling; hook
     # the persistent executable cache here so repeat programs (fixed

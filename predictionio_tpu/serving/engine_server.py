@@ -239,8 +239,12 @@ class MicroBatcher:
             results = self._run_batch([p.payload for p in batch])
             for p, r in zip(batch, results):
                 p.result = r
-        except BaseException:
+        except BaseException as e:
             # isolate the poison query: each waiter gets its own verdict
+            # (and the fallback costs a serial re-dispatch — worth a log)
+            log.warning("batch dispatch of %d queries failed (%s: %s); "
+                        "re-running individually to isolate the poison "
+                        "query", len(batch), type(e).__name__, e)
             for p in batch:
                 try:
                     p.result = self._run_one(p.payload)

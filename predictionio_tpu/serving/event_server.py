@@ -285,8 +285,11 @@ class _EventRequestHandler(JSONRequestHandler):
                 try:
                     decoded = base64.b64decode(header[6:]).decode()
                     access_key = decoded.split(":", 1)[0]
-                except Exception:
-                    pass
+                except (ValueError, UnicodeDecodeError) as e:
+                    # binascii.Error is a ValueError subclass; a garbled
+                    # header just means "no credentials" (401 follows),
+                    # but leave a trace for operators debugging clients
+                    log.warning("ignoring malformed Basic auth header: %s", e)
         channel = (params.get("channel") or [None])[0]
         return self.core.authenticate(access_key, channel)
 

@@ -483,6 +483,20 @@ def cmd_status(args) -> int:
     return 1
 
 
+def cmd_lint(args) -> int:
+    """graftlint: the JAX/TPU-aware static analysis over the tree
+    (rules JT01-JT06; tier-1 CI runs the same pass via tests/test_lint_clean.py)."""
+    from predictionio_tpu.tools.lint import run_cli
+
+    try:
+        return run_cli(args.paths, fmt=args.format, show_rules=args.list_rules)
+    except FileNotFoundError as e:
+        # exit 2, not 1: a bad path must stay distinguishable from
+        # "lint ran and found something" for CI wrappers
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+
 def cmd_template(args) -> int:
     if args.template_command == "list":
         for name, module in sorted(BUILTIN_TEMPLATES.items()):
@@ -675,6 +689,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("target")
     p.add_argument("args", nargs=argparse.REMAINDER)
     p.set_defaults(func=cmd_run)
+
+    p = sub.add_parser("lint", help="run graftlint (JAX/TPU-aware static "
+                                    "analysis, rules JT01-JT06) over the tree")
+    p.add_argument("paths", nargs="*", default=[],
+                   help="files/dirs (default: the installed package)")
+    p.add_argument("--format", choices=["human", "json"], default="human")
+    p.add_argument("--list-rules", action="store_true")
+    p.set_defaults(func=cmd_lint)
 
     p_t = sub.add_parser("template", help="list or scaffold templates")
     t_sub = p_t.add_subparsers(dest="template_command", required=True)

@@ -1,0 +1,42 @@
+"""graftlint — JAX/TPU-aware static analysis for the DASE pipeline.
+
+Ordinary linters can't see this framework's hazard class: traced-value
+host syncs (JT01), Python branches on tracers (JT02), low-precision
+accumulation (JT03, the bf16-Gramian bug class), swallowed exceptions on
+serving hot paths (JT04), undeclared mesh axes (JT05) and per-request
+blocking transfers in HTTP handlers (JT06).
+
+    python -m predictionio_tpu.tools.lint [paths] [--format json]
+    pio lint [paths]
+    bin/lint
+
+Suppress a reviewed finding with a justified comment:
+
+    ...  # graftlint: disable=JT01 — one-time warm-up, not a hot path
+"""
+
+from __future__ import annotations
+
+from predictionio_tpu.tools.lint.engine import (
+    Finding,
+    Rule,
+    RULES,
+    lint_file,
+    lint_paths,
+    main,
+    register,
+    run_cli,
+)
+from predictionio_tpu.tools.lint import rules  # noqa: F401 — registers JT01-JT06
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "RULES",
+    "lint_file",
+    "lint_paths",
+    "main",
+    "register",
+    "run_cli",
+    "rules",
+]

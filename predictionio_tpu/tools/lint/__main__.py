@@ -1,0 +1,8 @@
+"""``python -m predictionio_tpu.tools.lint`` entry point."""
+
+import sys
+
+from predictionio_tpu.tools.lint.engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

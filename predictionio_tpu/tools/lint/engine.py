@@ -1,0 +1,287 @@
+"""graftlint engine: rule registry, suppressions, file walking, output.
+
+The reference delegated correctness hazards to the JVM; the TPU rebuild
+has a hazard class of its own — traced-value host syncs, silent
+recompilation, low-precision accumulation, swallowed exceptions on
+serving hot paths — that generic linters cannot see. graftlint encodes
+those rules as AST passes over the tree (the analogue of DrJAX's
+statically-analyzable-primitives discipline, PAPERS.md).
+
+Suppression syntax (both require a one-line justification after the
+rule list — an unjustified suppression is itself a finding, GL00):
+
+    x = host_sync()  # graftlint: disable=JT01 — warm-up path, pre-trace
+    # graftlint: disable-file=JT04 — probe loop, degradation is the signal
+
+Run as ``python -m predictionio_tpu.tools.lint [paths]`` or
+``pio lint``; exits 0 on a clean tree, 1 when findings remain.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+#: ``# graftlint: disable=JT01,JT03 — justification`` (line scope) or
+#: ``# graftlint: disable-file=JT04 — justification`` (file scope).
+SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*(?P<scope>disable|disable-file)="
+    r"(?P<rules>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)(?P<rest>.*)"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+@dataclasses.dataclass
+class FileContext:
+    """Everything a rule gets to look at for one file."""
+
+    path: str      # path as given on the command line (for messages)
+    abspath: str   # absolute, POSIX-separated (rules match on fragments)
+    tree: ast.AST
+    source: str
+    lines: List[str]
+
+
+class Rule:
+    """A single static-analysis pass.
+
+    Subclasses set ``id`` (``JTxx``), ``name`` and ``rationale`` and
+    implement ``check``; ``applies_to`` restricts a rule to the layers
+    where its hazard lives (e.g. JT04 only audits serving hot paths).
+    """
+
+    id: str = ""
+    name: str = ""
+    rationale: str = ""
+
+    def applies_to(self, abspath: str) -> bool:
+        return True
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+#: rule id -> instance, in registration (= documentation) order.
+RULES: Dict[str, Rule] = {}
+
+
+def register(cls: type) -> type:
+    rule = cls()
+    if not rule.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if rule.id in RULES:
+        raise ValueError(f"duplicate rule id {rule.id}")
+    RULES[rule.id] = rule
+    return cls
+
+
+# -- suppressions --------------------------------------------------------------
+
+@dataclasses.dataclass
+class Suppressions:
+    file_rules: Set[str]
+    line_rules: Dict[int, Set[str]]
+    unjustified: List[Tuple[int, str]]  # (line, directive text)
+
+    def hides(self, finding: Finding) -> bool:
+        if finding.rule == "GL00":
+            return False  # the justification requirement is not itself
+            # suppressible — otherwise `disable=all` with no reason
+            # would hide its own GL00 and defeat the gate
+        for rules in (self.file_rules, self.line_rules.get(finding.line, set())):
+            if finding.rule in rules or "all" in rules:
+                return True
+        return False
+
+
+def _iter_comments(source: str, lines: Sequence[str]):
+    """(line, text) for every COMMENT token; falls back to a raw line
+    scan when tokenization fails (malformed source still gets GL01 from
+    the parse step — suppressions just degrade to line matching)."""
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        for i, line in enumerate(lines, start=1):
+            yield i, line
+
+
+def parse_suppressions(source: str, lines: Sequence[str]) -> Suppressions:
+    """Directives are honored only in real comments — a suppression
+    example quoted in a docstring or string literal is inert."""
+    sup = Suppressions(file_rules=set(), line_rules={}, unjustified=[])
+    for i, text in _iter_comments(source, lines):
+        m = SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group("rules").split(",")}
+        # the justification is whatever follows the rule list, minus
+        # separator punctuation — it must contain actual words
+        rest = m.group("rest").strip().lstrip("—–-:,. ").strip()
+        if not re.search(r"\w", rest):
+            sup.unjustified.append((i, m.group(0).strip()))
+        if m.group("scope") == "disable-file":
+            sup.file_rules.update(rules)
+        else:
+            sup.line_rules.setdefault(i, set()).update(rules)
+    return sup
+
+
+# -- driver --------------------------------------------------------------------
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+        elif os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith(".")
+                )
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+        else:
+            raise FileNotFoundError(f"no such file or directory: {path}")
+
+
+def lint_file(path: str, rules: Optional[Iterable[Rule]] = None) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    abspath = os.path.abspath(path).replace(os.sep, "/")
+    lines = source.splitlines()
+    sup = parse_suppressions(source, lines)
+    findings: List[Finding] = [
+        Finding("GL00", path, line, 0,
+                f"suppression without justification: {text!r} — say why "
+                "the hazard does not apply here")
+        for line, text in sup.unjustified
+    ]
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("GL01", path, e.lineno or 1, e.offset or 0,
+                        f"syntax error: {e.msg}")]
+    ctx = FileContext(path=path, abspath=abspath, tree=tree,
+                      source=source, lines=lines)
+    for rule in (rules if rules is not None else RULES.values()):
+        if rule.applies_to(abspath):
+            findings.extend(rule.check(ctx))
+    # dedupe: overlapping walks (e.g. a jit fn nested in a jit fn) may
+    # report one site twice; Finding is frozen/hashable
+    kept = list(dict.fromkeys(f for f in findings if not sup.hides(f)))
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return kept
+
+
+def lint_paths(paths: Sequence[str],
+               rules: Optional[Iterable[Rule]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(lint_file(path, rules=rules))
+    return findings
+
+
+# -- output --------------------------------------------------------------------
+
+def format_human(findings: Sequence[Finding], n_files: int) -> str:
+    out = [str(f) for f in findings]
+    out.append(
+        f"graftlint: {len(findings)} finding(s) in {n_files} file(s) scanned"
+        if findings else f"graftlint: clean ({n_files} file(s) scanned)"
+    )
+    return "\n".join(out)
+
+
+def format_json(findings: Sequence[Finding], n_files: int) -> str:
+    return json.dumps(
+        {"files_scanned": n_files,
+         "findings": [f.to_dict() for f in findings]},
+        indent=2, sort_keys=True,
+    )
+
+
+def list_rules() -> str:
+    out = []
+    for rule in RULES.values():
+        out.append(f"{rule.id}  {rule.name}")
+        out.append(f"      {rule.rationale}")
+    return "\n".join(out)
+
+
+def default_paths() -> List[str]:
+    """The installed package directory — `pio lint` / `bin/lint` with no
+    args must work from any cwd, not just the repo root."""
+    here = os.path.abspath(__file__)  # .../predictionio_tpu/tools/lint/engine.py
+    return [os.path.dirname(os.path.dirname(os.path.dirname(here)))]
+
+
+def run_cli(paths: Sequence[str], fmt: str = "human",
+            show_rules: bool = False, out=None) -> int:
+    out = out if out is not None else sys.stdout
+    # rule modules self-register on import; imported here (not at module
+    # top) so `engine` stays import-cycle-free for the rules themselves
+    from predictionio_tpu.tools.lint import rules as _rules  # noqa: F401
+
+    if show_rules:
+        print(list_rules(), file=out)
+        return 0
+    if not paths:
+        paths = default_paths()
+    files = list(iter_python_files(paths))
+    findings: List[Finding] = []
+    for path in files:
+        findings.extend(lint_file(path))
+    formatter = format_json if fmt == "json" else format_human
+    print(formatter(findings, len(files)), file=out)
+    return 1 if findings else 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m predictionio_tpu.tools.lint",
+        description="graftlint — JAX/TPU-aware static analysis "
+                    "(rules JT01-JT06; see --list-rules)",
+    )
+    parser.add_argument("paths", nargs="*", default=[],
+                        help="files or directories to lint (default: the "
+                             "installed predictionio_tpu package)")
+    parser.add_argument("--format", choices=["human", "json"], default="human")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="describe every registered rule and exit")
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return run_cli(args.paths, fmt=args.format, show_rules=args.list_rules)
+    except FileNotFoundError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2

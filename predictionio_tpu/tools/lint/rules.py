@@ -1,0 +1,615 @@
+"""graftlint rules JT01-JT06: the TPU hazards this codebase has hit.
+
+Each rule encodes a failure class with a concrete precedent in this
+tree's history (the bf16-Gramian divergence behind JT03 is recorded in
+git: "Record bf16-Gramian rejection: Zipf groups break bf16
+accumulation"). Rules are deliberately conservative AST passes — no
+imports are executed, no type inference beyond local single-file
+dataflow — so a finding is cheap to verify and a suppression comment
+documents a reviewed exception.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from predictionio_tpu.tools.lint.engine import (
+    FileContext,
+    Finding,
+    Rule,
+    register,
+)
+
+# -- shared AST helpers --------------------------------------------------------
+
+#: module spellings accepted for host numpy / device jax.numpy
+_NP_MODULES = ("np", "numpy", "onp")
+_JNP_MODULES = ("jnp", "jax.numpy")
+
+#: attribute reads that are static under trace (shape metadata, not data)
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "weak_type", "aval"}
+
+_LOW_PREC_NAMES = {"bfloat16", "float16", "bf16", "f16"}
+_F32_NAMES = {"float32", "float64", "f32", "f64"}
+
+
+def dotted(node: ast.AST) -> str:
+    """``jax.numpy.sum`` for an Attribute/Name chain, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_callable(node: ast.AST) -> bool:
+    d = dotted(node)
+    return d in {"jit", "pjit"} or d.endswith(".jit") or d.endswith(".pjit")
+
+
+def _const_strs(node: ast.AST) -> List[str]:
+    """String constants in a literal or literal tuple/list."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in node.elts:
+            out.extend(_const_strs(elt))
+        return out
+    return []
+
+
+def _const_ints(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[int] = []
+        for elt in node.elts:
+            out.extend(_const_ints(elt))
+        return out
+    return []
+
+
+def _jit_static_params(dec: ast.AST, fn: ast.FunctionDef) -> Optional[Set[str]]:
+    """If ``dec`` marks ``fn`` as jit-compiled, the static param names.
+
+    Recognizes ``@jax.jit`` / ``@jit`` / ``@pjit`` and the
+    ``@(functools.)partial(jax.jit, static_arg...=...)`` idiom used
+    throughout ops/ and models/. Returns None when not a jit decorator.
+    """
+    if _is_jit_callable(dec):
+        return set()
+    if not isinstance(dec, ast.Call):
+        return None
+    d = dotted(dec.func)
+    inner = dec.args[0] if (
+        d in {"partial", "functools.partial"} and dec.args
+    ) else None
+    if inner is None or not _is_jit_callable(inner):
+        return None
+    static: Set[str] = set()
+    pos_params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for kw in dec.keywords:
+        if kw.arg == "static_argnames":
+            static.update(_const_strs(kw.value))
+        elif kw.arg == "static_argnums":
+            for i in _const_ints(kw.value):
+                if 0 <= i < len(pos_params):
+                    static.add(pos_params[i])
+    return static
+
+
+def iter_jit_functions(
+    tree: ast.AST,
+) -> Iterator[Tuple[ast.FunctionDef, Set[str], Set[str]]]:
+    """Yield (function, traced-params, static-params) per jit'd def."""
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            static = _jit_static_params(dec, node)
+            if static is None:
+                continue
+            params = {
+                a.arg
+                for a in (node.args.posonlyargs + node.args.args
+                          + node.args.kwonlyargs)
+            }
+            yield node, params - static, static
+            break
+
+
+def _walk_body(fn: ast.FunctionDef) -> Iterator[ast.AST]:
+    """Walk a function's body, skipping its decorators and signature."""
+    for stmt in fn.body:
+        yield from ast.walk(stmt)
+
+
+def _parent_map(root: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _is_staticish(node: ast.AST, static_names: Set[str] = frozenset()) -> bool:
+    """True when an expression reads only trace-time-static values
+    (shapes, dims, len(), declared-static jit params) — safe to feed to
+    float()/int() under jit."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in static_names
+    if isinstance(node, ast.Attribute):
+        return node.attr in _STATIC_ATTRS
+    if isinstance(node, ast.Subscript):
+        return _is_staticish(node.value, static_names)
+    if isinstance(node, ast.Call):
+        return dotted(node.func) == "len"
+    if isinstance(node, ast.BinOp):
+        return (_is_staticish(node.left, static_names)
+                and _is_staticish(node.right, static_names))
+    if isinstance(node, ast.UnaryOp):
+        return _is_staticish(node.operand, static_names)
+    return False
+
+
+def _is_low_prec_dtype(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in _LOW_PREC_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _LOW_PREC_NAMES
+    return False
+
+
+def _is_f32_dtype(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in _F32_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _F32_NAMES
+    return False
+
+
+def _is_low_prec_cast(node: ast.AST) -> bool:
+    """``x.astype(jnp.bfloat16)``, ``jnp.asarray(x, dtype='bfloat16')``,
+    ``jnp.bfloat16(x)`` — an expression that demotes data below f32."""
+    if not isinstance(node, ast.Call):
+        return False
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "astype":
+        return bool(node.args) and _is_low_prec_dtype(node.args[0])
+    d = dotted(node.func)
+    tail = d.rsplit(".", 1)[-1]
+    if tail in _LOW_PREC_NAMES:
+        return True
+    if tail in {"asarray", "array", "full", "zeros", "ones"}:
+        return any(
+            kw.arg == "dtype" and _is_low_prec_dtype(kw.value)
+            for kw in node.keywords
+        )
+    return False
+
+
+def _contains_low_prec(node: ast.AST, tainted: Set[str]) -> bool:
+    for sub in ast.walk(node):
+        if _is_low_prec_cast(sub):
+            return True
+        if (isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)
+                and sub.id in tainted):
+            return True
+    return False
+
+
+# -- JT01 ----------------------------------------------------------------------
+
+@register
+class HostSyncInJit(Rule):
+    id = "JT01"
+    name = "host-sync-in-jit"
+    rationale = (
+        "float()/int()/bool()/.item()/np.asarray() on a traced value "
+        "forces a device->host sync (or a ConcretizationTypeError) "
+        "inside a jit trace; redundant asarray chains pay an extra host "
+        "copy on the serving path."
+    )
+
+    _HOST_CASTS = {"float", "int", "bool", "complex"}
+    _NP_PULLS = {f"{m}.{fn}" for m in _NP_MODULES for fn in ("asarray", "array")}
+    _ASARRAYS = _NP_PULLS | {f"{m}.asarray" for m in _JNP_MODULES} | {
+        f"{m}.array" for m in _JNP_MODULES
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        in_jit: Set[ast.AST] = set()
+        for fn, _traced, static in iter_jit_functions(ctx.tree):
+            for node in _walk_body(fn):
+                in_jit.add(node)
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                if d in self._HOST_CASTS and node.args and not _is_staticish(
+                    node.args[0], static
+                ):
+                    yield Finding(
+                        self.id, ctx.path, node.lineno, node.col_offset,
+                        f"{d}() on a (possibly traced) value inside a "
+                        "jit-compiled function blocks the trace with a "
+                        "host sync; compute in-graph or hoist out of jit",
+                    )
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item"
+                        and not _is_staticish(node.func.value, static)):
+                    yield Finding(
+                        self.id, ctx.path, node.lineno, node.col_offset,
+                        ".item() inside a jit-compiled function forces a "
+                        "device->host transfer per call; return the array "
+                        "and pull the scalar outside jit",
+                    )
+                elif d in self._NP_PULLS and not (
+                    node.args and _is_staticish(node.args[0], static)
+                ):
+                    yield Finding(
+                        self.id, ctx.path, node.lineno, node.col_offset,
+                        f"{d}() inside a jit-compiled function "
+                        "materializes on host mid-trace; use jnp and keep "
+                        "the value on device",
+                    )
+        # redundant double conversion anywhere (the serving-path cost):
+        # asarray(asarray(x)) round-trips through a host buffer that a
+        # single asarray(x, dtype=...) never allocates
+        for node in ast.walk(ctx.tree):
+            if node in in_jit or not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d in self._ASARRAYS and node.args and isinstance(
+                node.args[0], ast.Call
+            ):
+                inner = dotted(node.args[0].func)
+                if inner in self._ASARRAYS:
+                    yield Finding(
+                        self.id, ctx.path, node.lineno, node.col_offset,
+                        f"redundant double conversion {d}({inner}(...)): "
+                        "collapse to one asarray(..., dtype=...) call and "
+                        "skip the intermediate host copy",
+                    )
+
+
+# -- JT02 ----------------------------------------------------------------------
+
+@register
+class PythonBranchOnTracer(Rule):
+    id = "JT02"
+    name = "python-branch-on-tracer"
+    rationale = (
+        "Python if/while on a traced argument inside jit either raises "
+        "ConcretizationTypeError or, via static_argnums misuse, triggers "
+        "silent per-value recompilation; use lax.cond/select or declare "
+        "the argument static."
+    )
+
+    _SAFE_CALLS = {"len", "isinstance", "hasattr", "getattr", "type"}
+
+    def _exposed_name(self, test: ast.AST, traced: Set[str]) -> Optional[str]:
+        parents = _parent_map(test)
+        for node in ast.walk(test):
+            if not (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in traced):
+                continue
+            parent = parents.get(node)
+            if isinstance(parent, ast.Attribute) and (
+                parent.attr in _STATIC_ATTRS
+            ):
+                continue  # x.shape[0] > 2 — static under trace
+            if isinstance(parent, ast.Call) and node in parent.args and (
+                dotted(parent.func) in self._SAFE_CALLS
+            ):
+                continue  # len(x) — static under trace
+            return node.id
+        return None
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn, traced, _static in iter_jit_functions(ctx.tree):
+            if not traced:
+                continue
+            for node in _walk_body(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                name = self._exposed_name(node.test, traced)
+                if name is not None:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield Finding(
+                        self.id, ctx.path, node.lineno, node.col_offset,
+                        f"Python `{kind}` on traced argument `{name}` "
+                        f"inside jit-compiled `{fn.name}`; use "
+                        "jax.lax.cond/select/while_loop or mark the "
+                        "argument static",
+                    )
+
+
+# -- JT03 ----------------------------------------------------------------------
+
+@register
+class LowPrecisionAccumulation(Rule):
+    id = "JT03"
+    name = "low-precision-accumulation"
+    rationale = (
+        "Reducing bf16/f16-cast operands without an f32 accumulator "
+        "(preferred_element_type / dtype=float32) silently loses mass "
+        "once partial sums exceed the mantissa — the bf16-Gramian "
+        "divergence on Zipf-distributed groups recorded in git history."
+    )
+
+    _REDUCERS = {"sum", "mean", "matmul", "dot", "einsum", "tensordot",
+                 "vdot", "inner", "segment_sum"}
+
+    def _has_f32_accumulator(self, call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "preferred_element_type":
+                return True
+            if kw.arg == "dtype" and _is_f32_dtype(kw.value):
+                return True
+        return False
+
+    def _operands(self, call: ast.Call) -> List[ast.AST]:
+        ops = list(call.args)
+        if isinstance(call.func, ast.Attribute):
+            ops.append(call.func.value)  # x.astype(bf16).sum() method form
+        return ops
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # file-local dataflow: names ever assigned from a low-precision
+        # cast are tainted (no reassignment clearing — a linter
+        # over-approximates; suppress with justification where reviewed)
+        tainted: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and _is_low_prec_cast(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        tainted.add(tgt.id)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                    and _is_low_prec_cast(node.value):
+                if isinstance(node.target, ast.Name):
+                    tainted.add(node.target.id)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                tail = dotted(node.func).rsplit(".", 1)[-1] or (
+                    node.func.attr if isinstance(node.func, ast.Attribute)
+                    else ""
+                )
+                if tail not in self._REDUCERS:
+                    continue
+                if self._has_f32_accumulator(node):
+                    continue
+                if any(_contains_low_prec(op, tainted)
+                       for op in self._operands(node)):
+                    yield Finding(
+                        self.id, ctx.path, node.lineno, node.col_offset,
+                        f"{tail}() over bf16/f16-cast operands without an "
+                        "f32 accumulator; pass "
+                        "preferred_element_type=jnp.float32 (matmul/dot/"
+                        "einsum) or dtype=jnp.float32 (sum/segment_sum)",
+                    )
+            elif isinstance(node, ast.BinOp) and isinstance(
+                node.op, ast.MatMult
+            ):
+                if _contains_low_prec(node.left, tainted) or (
+                    _contains_low_prec(node.right, tainted)
+                ):
+                    yield Finding(
+                        self.id, ctx.path, node.lineno, node.col_offset,
+                        "`@` matmul over bf16/f16-cast operands "
+                        "accumulates in low precision; use jnp.matmul(..., "
+                        "preferred_element_type=jnp.float32)",
+                    )
+
+
+# -- JT04 ----------------------------------------------------------------------
+
+@register
+class SilentBroadExcept(Rule):
+    id = "JT04"
+    name = "silent-broad-except"
+    rationale = (
+        "`except Exception` that neither logs nor re-raises turns "
+        "serving/storage/workflow failures into silent data loss; the "
+        "operator's first symptom is wrong predictions, not an error."
+    )
+
+    _LOG_ATTRS = {"debug", "info", "warning", "warn", "error", "exception",
+                  "critical", "log"}
+
+    def applies_to(self, abspath: str) -> bool:
+        return ("/serving/" in abspath or "/workflow/" in abspath
+                or abspath.endswith("/data/storage.py"))
+
+    def _is_broad(self, handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:
+            return True
+        types = (handler.type.elts if isinstance(handler.type, ast.Tuple)
+                 else [handler.type])
+        return any(
+            dotted(t).rsplit(".", 1)[-1] in {"Exception", "BaseException"}
+            for t in types
+        )
+
+    def _handles(self, handler: ast.ExceptHandler) -> bool:
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ) and node.func.attr in self._LOG_ATTRS:
+                return True
+            # relaying counts: `except ... as e` whose body READS e
+            # (p.error = e, self._send(500, str(e))) surfaces the error
+            # to a caller/client instead of discarding it
+            if handler.name and isinstance(node, ast.Name) and (
+                node.id == handler.name
+                and isinstance(node.ctx, ast.Load)
+            ):
+                return True
+        return False
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if self._is_broad(handler) and not self._handles(handler):
+                    yield Finding(
+                        self.id, ctx.path, handler.lineno, handler.col_offset,
+                        "broad except swallows the error without logging "
+                        "or re-raising; log at warning level with context "
+                        "or narrow the exception type",
+                    )
+
+
+# -- JT05 ----------------------------------------------------------------------
+
+@register
+class MeshAxisConsistency(Rule):
+    id = "JT05"
+    name = "mesh-axis-consistency"
+    rationale = (
+        "A PartitionSpec axis name that parallel/mesh.py never declares "
+        "shards nothing: XLA replicates the array and the intended "
+        "parallelism silently degrades to a full copy per device."
+    )
+
+    _FALLBACK_AXES = ("data", "model")
+
+    def __init__(self) -> None:
+        self._axes_cache: Dict[str, Tuple[str, ...]] = {}
+
+    def applies_to(self, abspath: str) -> bool:
+        return any(seg in abspath
+                   for seg in ("/ops/", "/parallel/", "/templates/"))
+
+    def _declared_axes(self, abspath: str) -> Tuple[str, ...]:
+        """MESH_AXES from the nearest parallel/mesh.py up the tree."""
+        d = os.path.dirname(abspath)
+        seen: List[str] = []
+        for _ in range(8):
+            if d in self._axes_cache:
+                axes = self._axes_cache[d]
+                for s in seen:
+                    self._axes_cache[s] = axes
+                return axes
+            seen.append(d)
+            mesh_py = os.path.join(d, "parallel", "mesh.py")
+            if os.path.isfile(mesh_py):
+                axes = self._parse_axes(mesh_py)
+                for s in seen:
+                    self._axes_cache[s] = axes
+                return axes
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+        for s in seen:
+            self._axes_cache[s] = self._FALLBACK_AXES
+        return self._FALLBACK_AXES
+
+    def _parse_axes(self, mesh_py: str) -> Tuple[str, ...]:
+        try:
+            with open(mesh_py, "r", encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=mesh_py)
+        except (OSError, SyntaxError):
+            return self._FALLBACK_AXES
+        for node in ast.walk(tree):
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            for tgt in targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "MESH_AXES":
+                    axes = tuple(_const_strs(value))
+                    if axes:
+                        return axes
+        return self._FALLBACK_AXES
+
+    def _spec_aliases(self, tree: ast.AST) -> Set[str]:
+        aliases: Set[str] = {"PartitionSpec"}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    if a.name == "PartitionSpec":
+                        aliases.add(a.asname or a.name)
+        return aliases
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        axes = self._declared_axes(ctx.abspath)
+        aliases = self._spec_aliases(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if not (d in aliases or d.endswith(".PartitionSpec")):
+                continue
+            for arg in node.args:
+                for name in _const_strs(arg):
+                    if name not in axes:
+                        yield Finding(
+                            self.id, ctx.path, node.lineno, node.col_offset,
+                            f"PartitionSpec axis {name!r} is not declared "
+                            f"by parallel/mesh.py (declared: "
+                            f"{', '.join(axes)}); the array would be "
+                            "silently replicated",
+                        )
+
+
+# -- JT06 ----------------------------------------------------------------------
+
+@register
+class BlockingTransferInHandler(Rule):
+    id = "JT06"
+    name = "blocking-transfer-in-handler"
+    rationale = (
+        "A per-request block_until_ready/device_get/np.asarray inside an "
+        "HTTP handler serializes the device behind one connection; route "
+        "device work through the micro-batcher (Deployment.query_batch) "
+        "so concurrent requests share one dispatch."
+    )
+
+    _BLOCKING_ATTRS = {"block_until_ready", "device_get", "copy_to_host_async"}
+    _BLOCKING_CALLS = {f"{m}.{fn}" for m in _NP_MODULES
+                       for fn in ("asarray", "array")}
+
+    def applies_to(self, abspath: str) -> bool:
+        return "/serving/" in abspath and abspath.endswith("_server.py")
+
+    def _handler_classes(self, tree: ast.AST) -> Iterator[ast.ClassDef]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and (
+                "Handler" in node.name
+                or any("Handler" in dotted(b) for b in node.bases)
+            ):
+                yield node
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for cls in self._handler_classes(ctx.tree):
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                attr = (node.func.attr
+                        if isinstance(node.func, ast.Attribute) else "")
+                if attr in self._BLOCKING_ATTRS or d in self._BLOCKING_CALLS \
+                        or d.endswith(".device_get"):
+                    what = attr or d
+                    yield Finding(
+                        self.id, ctx.path, node.lineno, node.col_offset,
+                        f"blocking transfer {what}() inside request "
+                        f"handler {cls.name}; per-request host syncs "
+                        "serialize the device — go through the "
+                        "micro-batched query path",
+                    )

@@ -1,0 +1,434 @@
+"""graftlint unit tests: per-rule positive/negative fixtures.
+
+Each rule JT01-JT06 gets at least one fixture that MUST fire and one
+that MUST stay silent, written as real (parseable) source so the rules
+are exercised end-to-end through lint_file, including suppression
+handling. Nothing here imports jax — graftlint is pure AST.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from predictionio_tpu.tools.lint import RULES, lint_file, lint_paths
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+PACKAGE = REPO_ROOT / "predictionio_tpu"
+
+
+def lint_src(tmp_path: Path, src: str, relpath: str = "mod.py"):
+    """Write ``src`` under tmp_path at ``relpath`` and lint that file."""
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(src))
+    return lint_file(str(path))
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+# -- engine behavior -----------------------------------------------------------
+
+def test_all_six_rules_registered():
+    assert {"JT01", "JT02", "JT03", "JT04", "JT05", "JT06"} <= set(RULES)
+
+
+def test_syntax_error_is_reported_not_raised(tmp_path):
+    findings = lint_src(tmp_path, "def broken(:\n")
+    assert rule_ids(findings) == ["GL01"]
+
+
+def test_line_suppression_with_justification(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return float(x)  # graftlint: disable=JT01 — fixture: reviewed host sync
+    """)
+    assert findings == []
+
+
+def test_file_suppression(tmp_path):
+    findings = lint_src(tmp_path, """\
+        # graftlint: disable-file=JT04 — fixture: probe loop, degradation is the signal
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """, relpath="serving/probe.py")
+    assert findings == []
+
+
+def test_unjustified_suppression_is_gl00(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)  # graftlint: disable=JT01
+    """)
+    # the JT01 is suppressed, but the bare suppression itself is flagged
+    assert rule_ids(findings) == ["GL00"]
+
+
+def test_gl00_is_not_suppressible(tmp_path):
+    # disable=all hides the JT01 but can NOT hide its own GL00 — an
+    # unjustified blanket suppression must never pass the gate
+    findings = lint_src(tmp_path, """\
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)  # graftlint: disable=all
+    """)
+    assert rule_ids(findings) == ["GL00"]
+
+
+def test_suppression_inside_docstring_is_inert(tmp_path):
+    findings = lint_src(tmp_path, '''\
+        """Docs quoting the syntax:
+
+            x = 1  # graftlint: disable-file=JT01 — example only
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)
+    ''')
+    assert rule_ids(findings) == ["JT01"]
+
+
+# -- JT01 host-sync-in-jit -----------------------------------------------------
+
+def test_jt01_positive_host_casts_in_jit(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            a = float(x)
+            b = x.item()
+            c = np.asarray(x)
+            return a, b, c
+    """)
+    assert rule_ids(findings) == ["JT01", "JT01", "JT01"]
+
+
+def test_jt01_positive_partial_jit(tmp_path):
+    findings = lint_src(tmp_path, """\
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("n",))
+        def f(x, n):
+            return int(x)
+    """)
+    assert rule_ids(findings) == ["JT01"]
+
+
+def test_jt01_positive_double_conversion_outside_jit(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import jax.numpy as jnp
+        import numpy as np
+
+        def predict(xs):
+            return jnp.asarray(np.asarray(xs, dtype=np.float32))
+    """)
+    assert rule_ids(findings) == ["JT01"]
+    assert "redundant double conversion" in findings[0].message
+
+
+def test_jt01_negative(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            n = int(x.shape[0])      # static shape metadata: fine
+            return jnp.sum(x) / n
+
+        def host_side(x):
+            return float(np.asarray(x)[0])   # not under jit: fine
+    """)
+    assert findings == []
+
+
+def test_jt01_negative_static_param_casts(tmp_path):
+    findings = lint_src(tmp_path, """\
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("n",))
+        def f(x, n):
+            return x * float(n)   # n is a concrete Python value at trace
+    """)
+    assert findings == []
+
+
+# -- JT02 python-branch-on-tracer ---------------------------------------------
+
+def test_jt02_positive_if_and_while(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            while x < 0:
+                x = x + 1
+            return -x
+    """)
+    assert rule_ids(findings) == ["JT02", "JT02"]
+    assert "`x`" in findings[0].message
+
+
+def test_jt02_negative_static_and_shape_branches(tmp_path):
+    findings = lint_src(tmp_path, """\
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("mode",))
+        def f(x, mode):
+            if mode == "train":          # static arg: fine
+                x = x * 2
+            if x.shape[0] > 2:           # shape metadata: fine
+                x = x[:2]
+            if len(x) > 4:               # len() is static under trace
+                x = x[:4]
+            return x
+
+        def g(x):
+            if x > 0:                    # not under jit: fine
+                return x
+            return -x
+    """)
+    assert findings == []
+
+
+# -- JT03 low-precision-accumulation ------------------------------------------
+
+def test_jt03_positive_direct_and_tainted(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import jax.numpy as jnp
+
+        def gramian(x, w):
+            s = jnp.sum(x.astype(jnp.bfloat16), axis=0)
+            xb = x.astype(jnp.bfloat16)
+            g = jnp.matmul(xb, w)
+            h = xb @ w
+            return s, g, h
+    """)
+    assert rule_ids(findings) == ["JT03", "JT03", "JT03"]
+
+
+def test_jt03_negative_f32_accumulators(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import jax.numpy as jnp
+
+        def gramian(x, w, compute_dtype):
+            s = jnp.sum(x.astype(jnp.bfloat16), axis=0, dtype=jnp.float32)
+            xb = x.astype(jnp.bfloat16)
+            g = jnp.matmul(xb, w, preferred_element_type=jnp.float32)
+            e = jnp.einsum("ij,jk->ik", xb, w,
+                           preferred_element_type=jnp.float32)
+            xv = x.astype(compute_dtype)   # dynamic dtype: not flagged
+            return s, g, e, jnp.sum(xv), jnp.sum(x)
+    """)
+    assert findings == []
+
+
+# -- JT04 silent-broad-except --------------------------------------------------
+
+def test_jt04_positive_in_scoped_paths(tmp_path):
+    src = """\
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """
+    for rel in ("serving/foo.py", "workflow/bar.py", "data/storage.py"):
+        findings = lint_src(tmp_path, src, relpath=rel)
+        assert rule_ids(findings) == ["JT04"], rel
+
+
+def test_jt04_negative(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def logs():
+            try:
+                g()
+            except Exception:
+                log.exception("g failed")
+
+        def reraises():
+            try:
+                g()
+            except Exception:
+                raise
+
+        def relays(p):
+            try:
+                g()
+            except Exception as e:   # relayed to the caller, not silent
+                p.error = e
+
+        def narrow():
+            try:
+                g()
+            except ValueError:       # narrowed type: out of JT04 scope
+                pass
+    """, relpath="serving/ok.py")
+    assert findings == []
+
+
+def test_jt04_silent_outside_scoped_paths_is_fine(tmp_path):
+    findings = lint_src(tmp_path, """\
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """, relpath="ops/kernel_helpers.py")
+    assert findings == []
+
+
+# -- JT05 mesh-axis-consistency ------------------------------------------------
+
+MESH_PY = """\
+    MESH_AXES = ("data", "model")
+"""
+
+
+def test_jt05_positive_undeclared_axis(tmp_path):
+    (tmp_path / "pkg" / "parallel").mkdir(parents=True)
+    (tmp_path / "pkg" / "parallel" / "mesh.py").write_text(
+        textwrap.dedent(MESH_PY))
+    findings = lint_src(tmp_path, """\
+        from jax.sharding import PartitionSpec as P
+
+        SPEC = P("batch", None)
+    """, relpath="pkg/ops/kernel.py")
+    assert rule_ids(findings) == ["JT05"]
+    assert "'batch'" in findings[0].message
+
+
+def test_jt05_negative_declared_axes(tmp_path):
+    (tmp_path / "pkg" / "parallel").mkdir(parents=True)
+    (tmp_path / "pkg" / "parallel" / "mesh.py").write_text(
+        textwrap.dedent(MESH_PY))
+    findings = lint_src(tmp_path, """\
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        SPEC = P("data", None)
+        REP = P()
+        NESTED = P(("data", "model"), None)
+
+        def dynamic(mesh):
+            return P(mesh.axis_names[0])   # non-literal: not checked
+    """, relpath="pkg/ops/kernel.py")
+    assert findings == []
+
+
+def test_jt05_reads_custom_mesh_axes(tmp_path):
+    (tmp_path / "pkg" / "parallel").mkdir(parents=True)
+    (tmp_path / "pkg" / "parallel" / "mesh.py").write_text(
+        'MESH_AXES = ("stage", "expert")\n')
+    findings = lint_src(tmp_path, """\
+        from jax.sharding import PartitionSpec as P
+
+        A = P("stage")
+        B = P("data")
+    """, relpath="pkg/templates/moe.py")
+    assert rule_ids(findings) == ["JT05"]
+    assert "'data'" in findings[0].message
+
+
+# -- JT06 blocking-transfer-in-handler ----------------------------------------
+
+def test_jt06_positive_blocking_in_handler(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import numpy as np
+
+        class _QueryRequestHandler:
+            def do_POST(self):
+                result = self.model.predict(self.payload)
+                result.block_until_ready()
+                self._send(200, np.asarray(result).tolist())
+    """, relpath="serving/query_server.py")
+    assert rule_ids(findings) == ["JT06", "JT06"]
+
+
+def test_jt06_negative(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import numpy as np
+
+        class _QueryRequestHandler:
+            def do_POST(self):
+                # device work routed through the micro-batcher
+                self._send(200, self.server_ref.query(self.payload))
+
+        class BatchWorker:            # not a handler class
+            def drain(self, result):
+                result.block_until_ready()
+    """, relpath="serving/query_server.py")
+    assert findings == []
+
+
+def test_jt06_only_applies_to_server_modules(tmp_path):
+    findings = lint_src(tmp_path, """\
+        class _Handler:
+            def do_GET(self, x):
+                x.block_until_ready()
+    """, relpath="ops/not_a_server.py")
+    assert findings == []
+
+
+# -- the committed tree is clean ----------------------------------------------
+
+def test_self_check_committed_tree_is_clean():
+    """`python -m predictionio_tpu.tools.lint predictionio_tpu/` exits 0."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "predictionio_tpu.tools.lint",
+         str(PACKAGE)],
+        cwd=str(REPO_ROOT),
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, f"\n{proc.stdout}\n{proc.stderr}"
+    assert "clean" in proc.stdout
+
+
+def test_json_output_shape(tmp_path):
+    import json
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "predictionio_tpu.tools.lint",
+         "--format", "json", str(PACKAGE / "models")],
+        cwd=str(REPO_ROOT),
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    payload = json.loads(proc.stdout)
+    assert payload["findings"] == []
+    assert payload["files_scanned"] > 0

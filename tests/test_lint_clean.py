@@ -1,0 +1,26 @@
+"""Tier-1 gate: the committed tree carries zero unsuppressed graftlint
+findings.
+
+This is the CI wiring for graftlint (mirrors `bin/lint`): any JT01-JT06
+finding — or an unjustified suppression (GL00) — fails the tier-1 run
+with the exact file:line so the offending change is one click away.
+Uses the in-process API (no subprocess) to stay cheap; graftlint never
+imports jax, so this collects and runs in milliseconds.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from predictionio_tpu.tools.lint import lint_paths
+
+PACKAGE = Path(__file__).resolve().parents[1] / "predictionio_tpu"
+
+
+def test_tree_has_no_unsuppressed_findings():
+    findings = lint_paths([str(PACKAGE)])
+    assert not findings, (
+        f"{len(findings)} graftlint finding(s) — fix them or suppress "
+        "with a justified `# graftlint: disable=RULE — why` comment:\n"
+        + "\n".join(str(f) for f in findings)
+    )

@@ -178,6 +178,34 @@ class TestCLI:
         variant = json.load(open(f"{tdir}/engine.json"))
         assert variant["engineFactory"].endswith("vanilla_engine")
 
+    def test_lint_command(self, tmp_path, capsys):
+        # clean file -> exit 0 with the summary line
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        assert cli_main(["lint", str(clean)]) == 0
+        assert "clean" in capsys.readouterr().out
+        # a jit'd host sync -> exit 1, finding on stdout
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text(
+            "import jax\n\n@jax.jit\ndef f(x):\n    return float(x)\n"
+        )
+        assert cli_main(["lint", str(dirty)]) == 1
+        out = capsys.readouterr().out
+        assert "JT01" in out and "dirty.py" in out
+        assert cli_main(["lint", "--list-rules"]) == 0
+        assert "JT06" in capsys.readouterr().out
+        # bad path -> exit 2, distinguishable from "findings found" (1)
+        assert cli_main(["lint", str(tmp_path / "missing")]) == 2
+        # no args -> lints the installed package from any cwd
+        import os
+        old = os.getcwd()
+        os.chdir(str(tmp_path))
+        try:
+            assert cli_main(["lint"]) == 0
+        finally:
+            os.chdir(old)
+        assert "clean" in capsys.readouterr().out
+
     def test_run_command(self, memory_storage, tmp_path, capsys):
         # dotted callable: gets passthrough argv, return value is exit code
         import tests.test_tools as me
