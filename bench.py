@@ -78,6 +78,17 @@ If ANY gate fails (relative RMSE, absolute RMSE band, serving p50,
 
 Scale knobs via env: PIO_BENCH_USERS/ITEMS/RATINGS/RANK/ITERS (the
 absolute RMSE band only applies at the default knobs).
+
+Telemetry (obs/): the measurements this script reports map onto the
+framework's metric names, so a dashboard and a bench run agree on
+vocabulary — serving latency is `pio_serving_request_seconds{engine=}`
+(the engine server records it for every driven query), ingest and
+device-transfer byte counts are `pio_transfer_bytes_total{direction=}`,
+train-stage wall times are `pio_train_seconds{engine=}` /
+`pio_train_step_seconds`, and the cold-vs-warm compile story is
+`pio_jax_compile_cache_total{result=}` + `pio_jax_compile_seconds{phase=}`.
+All are live in-process during a run (`bin/pio metrics` dumps them; the
+serve stage's server also exposes `GET /metrics` over HTTP).
 """
 
 import argparse

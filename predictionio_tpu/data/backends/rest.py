@@ -40,8 +40,24 @@ from predictionio_tpu.data.metadata import (
     Model,
 )
 from predictionio_tpu.data import storage as S
+from predictionio_tpu.obs import trace
 
 log = logging.getLogger(__name__)
+
+
+def _span_name(path: str) -> str:
+    """Bounded span/metric name for a storage-server route:
+    /storage/events/find -> storage.find, /storage/meta/apps/get ->
+    storage.meta.apps.get, /storage/models/<id> -> storage.models."""
+    parts = path.split("?", 1)[0].strip("/").split("/")
+    if len(parts) >= 3 and parts[1] == "events":
+        name = parts[2] if not parts[2].startswith("scan") else "scan"
+        return f"storage.{name}"
+    if len(parts) >= 4 and parts[1] == "meta":
+        return f"storage.meta.{parts[2]}.{parts[3]}"
+    if len(parts) >= 2 and parts[1] == "models":
+        return "storage.models"
+    return "storage.request"
 
 
 class _Transport:
@@ -70,6 +86,11 @@ class _Transport:
         )
         if self.auth_key:
             req.add_header("X-PIO-Storage-Key", self.auth_key)
+        trace_id = trace.current_trace_id()
+        if trace_id:
+            # propagate the serving request's trace id so the storage
+            # server's span records join the same chain
+            req.add_header(trace.TRACE_HEADER, trace_id)
         return req
 
     def _error(self, path: str, e: urllib.error.HTTPError) -> S.StorageError:
@@ -114,6 +135,12 @@ class _Transport:
         raise StorageUnavailableError, after bounded retries when
         ``idempotent``."""
         attempts = 1 + (self.retries if idempotent else 0)
+        with trace.span(_span_name(path), endpoint=self.base_url):
+            return self._request_attempts(
+                attempts, path, body, method, content_type, timeout)
+
+    def _request_attempts(self, attempts, path, body, method, content_type,
+                          timeout):
         last: Optional[S.StorageError] = None
         for attempt in range(attempts):
             if attempt:
@@ -344,18 +371,19 @@ class RestEventStore(S.EventStore):
             payload["placement_count"] = int(placement_count)
         # a read: on a mid-stream connection drop, retry the whole scan
         last = None
-        for attempt in range(1 + self._t.retries):
-            if attempt:
-                self._t._sleep_backoff(attempt - 1)
-            try:
-                return [
-                    Event.from_dict(json.loads(line))
-                    for line in self._t.stream_lines(
-                        "/storage/events/find", payload)
-                ]
-            except S.StorageUnavailableError as e:
-                last = e
-        raise last
+        with trace.span("storage.find", endpoint=self._t.base_url):
+            for attempt in range(1 + self._t.retries):
+                if attempt:
+                    self._t._sleep_backoff(attempt - 1)
+                try:
+                    return [
+                        Event.from_dict(json.loads(line))
+                        for line in self._t.stream_lines(
+                            "/storage/events/find", payload)
+                    ]
+                except S.StorageUnavailableError as e:
+                    last = e
+            raise last
 
     def find_columnar(
         self,

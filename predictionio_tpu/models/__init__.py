@@ -17,6 +17,8 @@ from typing import Any, Callable, List, Sequence, Tuple
 
 import numpy as np
 
+from predictionio_tpu.obs import jaxmon
+
 
 def batch_predict_dense(
     model: Any,
@@ -30,5 +32,7 @@ def batch_predict_dense(
     if not queries:
         return []
     feats = np.array([q["features"] for _, q in queries], dtype=np.float32)
+    jaxmon.record_transfer(feats.nbytes, "h2d")
     preds = model.predict_batch(feats)
+    jaxmon.record_transfer(getattr(preds, "nbytes", None), "d2h")
     return [(i, wrap(p)) for (i, _q), p in zip(queries, preds)]

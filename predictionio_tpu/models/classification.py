@@ -180,7 +180,9 @@ def train_logistic_regression(
         nll = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
         return nll + p.l2 * (params["w"] ** 2).sum()
 
-    @jax.jit
+    # donate params/opt_state: the loop rebinds both every iteration,
+    # so without donation the old and new copies coexist (JT07)
+    @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state):
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = tx.update(grads, opt_state, params)

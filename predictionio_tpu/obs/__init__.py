@@ -1,0 +1,40 @@
+"""obs: the unified telemetry subsystem.
+
+Three pillars, one registry:
+
+  obs.metrics  — thread-safe Counter/Gauge/Histogram with labels in a
+                 process-global Registry, Prometheus text exposition
+                 (served at ``GET /metrics`` by every HTTP server via
+                 serving/http.py, dumped by ``pio metrics``)
+  obs.trace    — trace ids + spans with ``X-PIO-Trace-Id`` propagation
+                 (engine server -> rest storage client -> storage
+                 server), structured JSON-line span records
+  obs.jaxmon   — JAX runtime bridge: compile-cache hit/miss, compile
+                 wall time, transfer bytes, train-step timing, device
+                 memory gauges
+
+Import cost is stdlib-only; jax is touched lazily inside jaxmon.
+"""
+
+from predictionio_tpu.obs import jaxmon, metrics, trace
+from predictionio_tpu.obs.metrics import (
+    CONTENT_TYPE,
+    REGISTRY,
+    counter,
+    gauge,
+    histogram,
+)
+from predictionio_tpu.obs.trace import TRACE_HEADER, span
+
+__all__ = [
+    "CONTENT_TYPE",
+    "REGISTRY",
+    "TRACE_HEADER",
+    "counter",
+    "gauge",
+    "histogram",
+    "jaxmon",
+    "metrics",
+    "span",
+    "trace",
+]

@@ -1,0 +1,158 @@
+"""JAX runtime instrumentation: compile cache, compile time, transfers,
+train steps, device memory.
+
+The TPU economics the metrics must surface (SURVEY.md §3.1): XLA
+compile time is the job-startup tax, the persistent compile cache
+(parallel/compile_cache.py) is what waives it, and host<->device
+transfer bytes are the serving path's hidden cost. jax.monitoring
+already emits the compile/cache events; ``install()`` bridges them into
+the obs registry so they show up on every server's ``/metrics``:
+
+  pio_jax_compile_cache_total{result="hit"|"miss"}  persistent-cache outcome
+  pio_jax_compile_seconds_bucket{phase=...}         trace/lower/backend compile
+  pio_transfer_bytes_total{direction="h2d"|"d2h"}   explicit hot-path counts
+  pio_train_step_seconds_bucket                     per-train-step wall time
+  pio_train_seconds_bucket{engine=...}              whole-train wall time
+  pio_device_memory_bytes{device,kind}              allocator stats per device
+
+``install()`` never imports jax at module import time and never raises:
+observability must not change whether training runs.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from predictionio_tpu.obs import metrics
+
+log = logging.getLogger(__name__)
+
+COMPILE_CACHE_TOTAL = metrics.counter(
+    "pio_jax_compile_cache_total",
+    "Persistent XLA compile-cache lookups by outcome",
+    ("result",),
+)
+
+#: compile phases run 0.1s..minutes; coarser buckets than serving latency
+_COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                    30.0, 60.0, 120.0, 300.0)
+
+COMPILE_SECONDS = metrics.histogram(
+    "pio_jax_compile_seconds",
+    "XLA compilation phase wall time (jaxpr trace / lowering / backend)",
+    ("phase",),
+    buckets=_COMPILE_BUCKETS,
+)
+
+TRANSFER_BYTES = metrics.counter(
+    "pio_transfer_bytes_total",
+    "Host<->device bytes moved on instrumented hot paths",
+    ("direction",),
+)
+
+TRAIN_STEP_SECONDS = metrics.histogram(
+    "pio_train_step_seconds",
+    "Per-train-step wall time (dispatch + device compute)",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0),
+)
+
+TRAIN_SECONDS = metrics.histogram(
+    "pio_train_seconds",
+    "Whole engine.train wall time per training run",
+    ("engine",),
+    buckets=(0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0, 300.0, 600.0,
+             1800.0, 3600.0),
+)
+
+DEVICE_MEMORY_BYTES = metrics.gauge(
+    "pio_device_memory_bytes",
+    "Per-device allocator stats (bytes_in_use / peak_bytes_in_use / "
+    "bytes_limit) where the backend reports them",
+    ("device", "kind"),
+)
+
+#: jax.monitoring event keys -> our series (jax 0.4.x names; unknown
+#: keys are ignored so a jax upgrade degrades to missing points, never
+#: an error)
+_CACHE_EVENTS = {
+    "/jax/compilation_cache/cache_hits": "hit",
+    "/jax/compilation_cache/cache_misses": "miss",
+}
+_COMPILE_DURATION_PHASES = {
+    "/jax/core/compile/jaxpr_trace_duration": "trace",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "lower",
+    "/jax/core/compile/backend_compile_duration": "backend_compile",
+}
+
+_installed = False
+
+
+def _on_event(event: str, **kwargs) -> None:
+    result = _CACHE_EVENTS.get(event)
+    if result is not None:
+        COMPILE_CACHE_TOTAL.labels(result).inc()
+
+
+def _on_event_duration(event: str, duration_secs: float, **kwargs) -> None:
+    phase = _COMPILE_DURATION_PHASES.get(event)
+    if phase is not None:
+        COMPILE_SECONDS.labels(phase).observe(duration_secs)
+
+
+def install() -> bool:
+    """Register the jax.monitoring bridge once per process.
+
+    Returns True when listening (idempotent), False when jax (or its
+    monitoring module) is unavailable — the metrics then simply stay at
+    zero."""
+    global _installed
+    if _installed:
+        return True
+    try:
+        from jax import monitoring
+    except Exception as e:  # noqa: BLE001 — observability is optional
+        log.warning("jax.monitoring unavailable, compile metrics off: %s", e)
+        return False
+    monitoring.register_event_listener(_on_event)
+    monitoring.register_event_duration_secs_listener(_on_event_duration)
+    _installed = True
+    return True
+
+
+def record_transfer(nbytes: Optional[int], direction: str) -> None:
+    """Count one host<->device transfer (direction: 'h2d' | 'd2h')."""
+    if nbytes:
+        TRANSFER_BYTES.labels(direction).inc(int(nbytes))
+
+
+def observe_train_step(seconds: float) -> None:
+    TRAIN_STEP_SECONDS.observe(seconds)
+
+
+def update_device_memory_gauges() -> int:
+    """Refresh pio_device_memory_bytes from each local device's
+    ``memory_stats()``; returns the number of devices reporting. CPU
+    backends often report nothing — that is a 0, not an error."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception as e:  # noqa: BLE001 — never fail the caller
+        log.debug("device memory gauges unavailable: %s", e)
+        return 0
+    reported = 0
+    for dev in devices:
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:  # noqa: BLE001 — per-device best effort
+            continue
+        picked = False
+        for kind in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if kind in stats:
+                DEVICE_MEMORY_BYTES.labels(str(dev.id), kind).set(
+                    float(stats[kind]))
+                picked = True
+        reported += int(picked)
+    return reported

@@ -1,0 +1,205 @@
+"""Request tracing: trace ids, spans, structured per-span records.
+
+One slow query needs decomposing — was it serving (queue + dispatch),
+the storage round-trip, or device compute? The reference has nothing
+here (its answer is the Spark UI, which never sees the serving path).
+This module is a deliberately small tracer:
+
+  - a trace id is minted at the edge (the shared HTTP handler,
+    serving/http.py) or accepted from the ``X-PIO-Trace-Id`` request
+    header, and propagated to downstream storage-server calls by the
+    ``rest`` backend client (data/backends/rest.py)
+  - ``span("storage.find")`` wraps a unit of work; on exit a structured
+    record {trace, span, parent, name, start_unix, duration_ms, ...}
+    is appended to an in-process ring buffer, optionally mirrored as a
+    JSON line to the file named by ``PIO_TRACE_LOG``, and counted in
+    the ``pio_trace_spans_total{name=...}`` metric
+  - context travels in a contextvar; spans nest (parent ids) within a
+    thread, and ``current_context()``/``activate_context()`` hand the
+    trace across explicit thread hops (the serving micro-batcher)
+
+Spans only record while a trace is active — background work that no
+request asked about stays silent, so the ring buffer and trace log hold
+request-shaped evidence, not noise.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import contextvars
+import json
+import logging
+import os
+import re
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, NamedTuple, Optional
+
+from predictionio_tpu.obs import metrics
+
+log = logging.getLogger(__name__)
+
+#: propagation header, engine server -> storage client -> storage server
+TRACE_HEADER = "X-PIO-Trace-Id"
+
+#: ids we mint are 32-hex; inbound ids must at least be id-SHAPED (hex
+#: + hyphens, bounded length) — anything else is discarded and re-minted
+#: at the edge, so untrusted header bytes never reach response headers,
+#: downstream requests or the span log
+_TRACE_ID_RE = re.compile(r"^[0-9a-fA-F-]{8,64}$")
+
+
+def valid_trace_id(value: str) -> bool:
+    return bool(value and _TRACE_ID_RE.match(value))
+
+#: ring buffer size: enough for a test run or a quick operator look-back
+RECENT_LIMIT = 4096
+
+_SPANS_TOTAL = metrics.counter(
+    "pio_trace_spans_total",
+    "Spans recorded, by span name",
+    ("name",),
+)
+
+
+class SpanContext(NamedTuple):
+    """Immutable (trace id, active span id) — safe to hand across threads."""
+
+    trace_id: str
+    span_id: Optional[str]
+
+
+_ctx: "contextvars.ContextVar[Optional[SpanContext]]" = contextvars.ContextVar(
+    "pio_trace_ctx", default=None
+)
+
+_recent: "collections.deque[Dict[str, Any]]" = collections.deque(
+    maxlen=RECENT_LIMIT
+)
+_emit_lock = threading.Lock()
+
+# the PIO_TRACE_LOG sink keeps one append-mode handle (re-opened only
+# when the env var changes): per-span open()/close() under a lock shared
+# by every handler thread would serialize the serving hot path on
+# filesystem syscalls
+_log_lock = threading.Lock()
+_log_file = None
+_log_path: Optional[str] = None
+_log_failed_path: Optional[str] = None
+
+
+def _write_log_line(line: str) -> None:
+    global _log_file, _log_path, _log_failed_path
+    path = os.environ.get("PIO_TRACE_LOG")
+    if not path or path == _log_failed_path:
+        # a sink that failed once stays off (until the env var changes):
+        # warning + failed syscall per span would flood a serving host
+        return
+    try:
+        with _log_lock:
+            if path != _log_path:
+                if _log_file is not None:
+                    _log_file.close()
+                _log_file = open(path, "a", encoding="utf-8")
+                _log_path = path
+            _log_file.write(line + "\n")
+            _log_file.flush()
+    except OSError as e:
+        _log_failed_path = path
+        log.warning("trace log %s unwritable, span sink disabled: %s",
+                    path, e)
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def _new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def current_context() -> Optional[SpanContext]:
+    return _ctx.get()
+
+
+def current_trace_id() -> Optional[str]:
+    ctx = _ctx.get()
+    return ctx.trace_id if ctx else None
+
+
+def activate(trace_id: str, span_id: Optional[str] = None):
+    """Install a trace context; returns a token for ``deactivate``."""
+    return _ctx.set(SpanContext(trace_id=trace_id, span_id=span_id))
+
+
+def activate_context(ctx: SpanContext):
+    return _ctx.set(ctx)
+
+
+def deactivate(token) -> None:
+    _ctx.reset(token)
+
+
+def _emit(record: Dict[str, Any]) -> None:
+    _SPANS_TOTAL.labels(record["name"]).inc()
+    with _emit_lock:
+        _recent.append(record)
+    if os.environ.get("PIO_TRACE_LOG"):
+        _write_log_line(json.dumps(record, sort_keys=True))
+
+
+def recent_spans(n: Optional[int] = None,
+                 trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """The last ``n`` span records (optionally one trace's), oldest
+    first — the in-process view tests and `pio`-side tooling read."""
+    with _emit_lock:
+        records = list(_recent)
+    if trace_id is not None:
+        records = [r for r in records if r["trace"] == trace_id]
+    return records if n is None else records[-n:]
+
+
+def clear_recent() -> None:
+    with _emit_lock:
+        _recent.clear()
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs: Any):
+    """Record one unit of work under the active trace.
+
+    No active trace -> no-op (zero allocation beyond the context var
+    read), so library code can span unconditionally. Attributes must be
+    JSON-serializable scalars; the span record is emitted on exit even
+    when the body raises (the error is noted, then propagates)."""
+    parent = _ctx.get()
+    if parent is None:
+        yield None
+        return
+    span_id = _new_span_id()
+    token = _ctx.set(SpanContext(trace_id=parent.trace_id, span_id=span_id))
+    start_unix = time.time()
+    t0 = time.perf_counter()
+    error: Optional[str] = None
+    try:
+        yield span_id
+    except BaseException as e:
+        error = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        _ctx.reset(token)
+        record: Dict[str, Any] = {
+            "trace": parent.trace_id,
+            "span": span_id,
+            "parent": parent.span_id,
+            "name": name,
+            "start_unix": round(start_unix, 6),
+            "duration_ms": round((time.perf_counter() - t0) * 1e3, 3),
+        }
+        if error is not None:
+            record["error"] = error
+        if attrs:
+            record.update(attrs)
+        _emit(record)

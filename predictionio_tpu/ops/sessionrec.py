@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Dict, List, Optional, Tuple
 
 import flax.linen as nn
@@ -270,10 +271,13 @@ class SessionRecTrainer:
         already completed by a restored checkpoint are not repeated)."""
         target = epochs if epochs is not None else self.cfg.epochs
         rng = self._rng
+        from predictionio_tpu.obs import jaxmon
+
         while self._epochs_done < target:
             order = self._shuffle.permutation(self._train_rows)
             total, batches = 0.0, 0
             for s in range(0, len(order), self.batch):
+                t_step = time.perf_counter()
                 sel = order[s:s + self.batch]
                 if len(sel) < self.batch:   # fixed shape: wrap the tail
                     sel = np.concatenate(
@@ -281,6 +285,7 @@ class SessionRecTrainer:
                     ) if len(order) >= self.batch else np.resize(sel, self.batch)
                 seq = jnp.asarray(self.inputs[sel])
                 tgt = jnp.asarray(self.targets[sel])
+                jaxmon.record_transfer(seq.nbytes + tgt.nbytes, "h2d")
                 if self._batch_sharding is not None:
                     seq = jax.device_put(seq, self._batch_sharding)
                     tgt = jax.device_put(tgt, self._batch_sharding)
@@ -290,6 +295,9 @@ class SessionRecTrainer:
                 )
                 total += float(loss)
                 batches += 1
+                # float(loss) above synced the device, so this is the
+                # true step wall time (h2d + dispatch + compute)
+                jaxmon.observe_train_step(time.perf_counter() - t_step)
             self._losses.append(total / max(batches, 1))
             self._epochs_done += 1
             self._rng = rng

@@ -30,6 +30,8 @@ import logging
 import os
 from typing import Optional
 
+from predictionio_tpu.obs import jaxmon
+
 log = logging.getLogger(__name__)
 
 _enabled_dir: Optional[str] = None
@@ -48,6 +50,11 @@ def enable_persistent_cache(cache_dir: Optional[str] = None) -> Optional[str]:
     without a writable home, just slower).
     """
     global _enabled_dir
+    # hit/miss counters + compile-time histograms (obs/jaxmon.py) come
+    # up with the cache: every train/deploy/reload path funnels through
+    # here, and the counters are wanted even when the cache dir is
+    # disabled (all-miss is exactly the signal an operator needs)
+    jaxmon.install()
     if os.environ.get("PIO_COMPILE_CACHE", "1") == "0":
         return None
     if _enabled_dir is not None:

@@ -39,6 +39,7 @@ from urllib.parse import urlparse
 
 from predictionio_tpu.core.engine import Engine
 from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.obs import metrics, trace
 from predictionio_tpu.parallel.mesh import MeshContext
 from predictionio_tpu.serving.http import HTTPServerBase, JSONRequestHandler
 from predictionio_tpu.workflow.deploy import Deployment, prepare_deploy
@@ -48,32 +49,56 @@ log = logging.getLogger(__name__)
 DEFAULT_PORT = 8000  # ref: CreateServer.scala:83
 UTC = _dt.timezone.utc
 
+#: the one serving-latency series (obs tentpole): the status page's
+#: count/avg/p50/p99 and the /metrics histogram read the SAME child, so
+#: a dashboard and the operator landing page can never disagree
+_SERVING_SECONDS = metrics.histogram(
+    "pio_serving_request_seconds",
+    "End-to-end serve time per query (queue wait + dispatch), recorded "
+    "inside the engine server",
+    ("engine",),
+)
+
 
 class ServingStats:
     """Request bookkeeping (ref: CreateServer.scala:552-559).
 
-    Beyond the reference's count/average, a bounded window of recent
-    per-request serving times (queue wait + dispatch, measured INSIDE
-    the server) feeds p50/p99 in the status JSON — the server's own
-    latency contribution, unpolluted by client-side CPU contention on
-    shared hosts."""
+    Counts, totals and percentiles live in the shared
+    ``pio_serving_request_seconds{engine=...}`` histogram — the status
+    page and ``GET /metrics`` report from one source of truth. A
+    bounded window of raw per-request times is kept alongside for
+    ``recent()`` (bench.py reads exact server-side samples; histogram
+    buckets would quantize them)."""
 
     WINDOW = 8192
 
-    def __init__(self):
+    def __init__(self, engine_id: str = "default"):
         import collections
 
         self._lock = threading.Lock()
-        self.request_count = 0
-        self.total_serving_sec = 0.0
+        # a new ServingStats means a new server for this engine: its
+        # series restarts from zero (same as a process restart would).
+        # Last-created-wins: if an OLDER in-process server for the same
+        # engine_id is still alive, it keeps recording into an orphaned
+        # child that /metrics no longer renders — two live servers for
+        # one engine id have no per-server answer on a shared registry
+        _SERVING_SECONDS.remove(engine_id)
+        self._hist = _SERVING_SECONDS.labels(engine_id)
         self.last_serving_sec = 0.0
         self.start_time = _dt.datetime.now(tz=UTC)
         self._window: collections.deque = collections.deque(maxlen=self.WINDOW)
 
+    @property
+    def request_count(self) -> int:
+        return self._hist.count
+
+    @property
+    def total_serving_sec(self) -> float:
+        return self._hist.sum
+
     def record(self, seconds: float) -> None:
+        self._hist.observe(seconds)
         with self._lock:
-            self.request_count += 1
-            self.total_serving_sec += seconds
             self.last_serving_sec = seconds
             self._window.append(seconds)
 
@@ -84,24 +109,21 @@ class ServingStats:
         return out if n is None else out[-n:]
 
     def snapshot(self) -> dict:
-        with self._lock:
-            avg = self.total_serving_sec / self.request_count if self.request_count else 0.0
-            window = sorted(self._window)
-        pct = (lambda q: window[min(len(window) - 1, int(len(window) * q))]
-               if window else 0.0)
+        count, total = self._hist.snapshot()
         return {
             "startTime": self.start_time.isoformat(),
-            "requestCount": self.request_count,
-            "avgServingSec": avg,
+            "requestCount": count,
+            "avgServingSec": total / count if count else 0.0,
             "lastServingSec": self.last_serving_sec,
-            "p50ServingSec": pct(0.50),
-            "p99ServingSec": pct(0.99),
+            # bucket-interpolated, the PromQL histogram_quantile estimate
+            "p50ServingSec": self._hist.quantile(0.50),
+            "p99ServingSec": self._hist.quantile(0.99),
         }
 
 
 class _Pending:
     __slots__ = ("payload", "event", "result", "error", "abandoned",
-                 "t_submit")
+                 "t_submit", "trace_ctx")
 
     def __init__(self, payload):
         self.payload = payload
@@ -110,6 +132,11 @@ class _Pending:
         self.error: Optional[BaseException] = None
         self.abandoned = False  # submitter timed out; skip device work
         self.t_submit = time.perf_counter()
+        # the submitting handler thread's trace context: contextvars do
+        # not cross the hop to the batcher worker, so it rides along and
+        # is re-activated around a lone dispatch (a >1 batch spans many
+        # traces at once and runs untraced — documented limitation)
+        self.trace_ctx = trace.current_context()
 
 
 class MicroBatcher:
@@ -228,10 +255,16 @@ class MicroBatcher:
         t_start = time.perf_counter()
         if len(batch) == 1:
             p = batch[0]
+            token = (trace.activate_context(p.trace_ctx)
+                     if p.trace_ctx is not None else None)
             try:
-                p.result = self._run_one(p.payload)
+                with trace.span("serve.dispatch", batch_size=1):
+                    p.result = self._run_one(p.payload)
             except BaseException as e:  # noqa: BLE001 — relayed to caller
                 p.error = e
+            finally:
+                if token is not None:
+                    trace.deactivate(token)
             self._record_splits(batch, t_start)
             p.event.set()
             return
@@ -297,7 +330,7 @@ class EngineServer(HTTPServerBase):
         self.feedback_url = feedback_url
         self.feedback_access_key = feedback_access_key
         self.log_url = log_url
-        self.stats = ServingStats()
+        self.stats = ServingStats(engine_id)
         self._deployment_lock = threading.Lock()
         self.deployment: Deployment = self._load_latest()
         self._batcher: Optional[MicroBatcher] = (
@@ -365,10 +398,11 @@ class EngineServer(HTTPServerBase):
 
     def query(self, payload: Any) -> Any:
         t0 = time.perf_counter()
-        if self._batcher is not None:
-            result = self._batcher.submit(payload)
-        else:
-            result = self._query_now(payload)
+        with trace.span("serve.query", engine=self.engine_id):
+            if self._batcher is not None:
+                result = self._batcher.submit(payload)
+            else:
+                result = self._query_now(payload)
         elapsed = time.perf_counter() - t0
         self.stats.record(elapsed)
         if self.feedback_url and self.feedback_access_key:

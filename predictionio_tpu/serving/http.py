@@ -8,14 +8,117 @@ stdlib ThreadingHTTPServer).
 
 from __future__ import annotations
 
+import functools
 import json
 import logging
+import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
+from urllib.parse import urlparse
+
+from predictionio_tpu.obs import metrics, trace
 
 log = logging.getLogger(__name__)
+
+# -- built-in request telemetry (tentpole: every server inherits these) -------
+
+_REQUESTS_TOTAL = metrics.counter(
+    "pio_http_requests_total",
+    "HTTP requests answered, by server, method, route and status",
+    ("server", "method", "route", "status"),
+)
+_REQUEST_SECONDS = metrics.histogram(
+    "pio_http_request_duration_seconds",
+    "HTTP request handling wall time (request parsed -> response written)",
+    ("server", "method", "route"),
+)
+_IN_FLIGHT = metrics.gauge(
+    "pio_http_requests_in_flight",
+    "Requests currently being handled, by server",
+    ("server",),
+)
+
+#: path segments that are data ids (event/model/scan ids, uuid hexes):
+#: collapsed to ":id" so metric label cardinality stays bounded
+_ID_SEGMENT = re.compile(r"^[0-9a-fA-F-]{16,}$")
+
+#: hard cap on distinct route labels per process: the real servers have
+#: ~25 routes; beyond this, new paths (scanners probing random 404s)
+#: collapse to ":other" instead of growing the registry forever
+_MAX_ROUTES = 64
+_routes_seen: set = set()
+
+
+def metrics_route(path: str) -> str:
+    """A bounded-cardinality route label for a request path."""
+    out = []
+    for seg in path.split("/"):
+        if not seg:
+            continue
+        stem, dot, ext = seg.rpartition(".")
+        base = stem if dot else seg
+        if _ID_SEGMENT.match(base) or len(base) > 48:
+            seg = ":id" + (dot + ext if dot else "")
+        out.append(seg)
+    route = "/" + "/".join(out)
+    if route in _routes_seen:
+        return route
+    if len(_routes_seen) < _MAX_ROUTES:  # benign race: cap is approximate
+        _routes_seen.add(route)
+        return route
+    return ":other"
+
+
+def _instrument(fn):
+    """Wrap a do_METHOD handler: serve the shared ``GET /metrics`` route,
+    activate the request's trace context (minting or accepting an
+    ``X-PIO-Trace-Id``), and record the built-in request metrics. Applied
+    once to every handler subclass via ``__init_subclass__`` — servers
+    inherit all of it without touching their routing code."""
+    if getattr(fn, "_pio_instrumented", False):
+        return fn
+
+    @functools.wraps(fn)
+    def wrapper(self):
+        path = urlparse(self.path).path
+        server = self.server_version.split("/", 1)[0]
+        if self.command == "GET" and path == "/metrics":
+            # exposition endpoint: before any per-server auth (a scraper
+            # holds no storage keys) and outside its own request count
+            self._send(200, metrics.REGISTRY.render(),
+                       content_type=metrics.CONTENT_TYPE)
+            return
+        # the inbound id is untrusted: anything not id-shaped (header
+        # injection attempts, oversized strings) is re-minted, never
+        # echoed into response headers or span logs
+        raw_id = self.headers.get(trace.TRACE_HEADER, "")
+        trace_id = raw_id if trace.valid_trace_id(raw_id) else (
+            trace.new_trace_id())
+        token = trace.activate(trace_id)
+        inflight = _IN_FLIGHT.labels(server)
+        inflight.inc()
+        t0 = time.perf_counter()
+        name = server.lower()
+        name = name.removeprefix("pio") or name
+        try:
+            with trace.span(f"http.{name}", method=self.command,
+                            route=metrics_route(path)):
+                fn(self)
+        finally:
+            inflight.dec()
+            trace.deactivate(token)
+            status = getattr(self, "_metrics_status", None)
+            if status is not None:
+                route = metrics_route(path)
+                _REQUESTS_TOTAL.labels(server, self.command, route,
+                                       str(status)).inc()
+                _REQUEST_SECONDS.labels(server, self.command, route).observe(
+                    time.perf_counter() - t0)
+
+    wrapper._pio_instrumented = True
+    return wrapper
 
 
 class JSONRequestHandler(BaseHTTPRequestHandler):
@@ -35,6 +138,18 @@ class JSONRequestHandler(BaseHTTPRequestHandler):
     # (BASELINE north-star: p50 < 10ms)
     disable_nagle_algorithm = True
 
+    def __init_subclass__(cls, **kwargs):
+        # telemetry is attached HERE, once: any subclass's do_* routing
+        # methods are wrapped with the /metrics route, trace-context
+        # activation and request metrics — the event server, engine
+        # server, storage server, dashboard and admin API inherit the
+        # whole observability surface without per-server wiring
+        super().__init_subclass__(**kwargs)
+        for mname in ("do_GET", "do_POST", "do_PUT", "do_DELETE"):
+            fn = cls.__dict__.get(mname)
+            if fn is not None:
+                setattr(cls, mname, _instrument(fn))
+
     def log_message(self, fmt, *args):
         log.debug("%s: " + fmt, self.server_version, *args)
 
@@ -45,7 +160,15 @@ class JSONRequestHandler(BaseHTTPRequestHandler):
         # leave a stale True that makes the NEXT request's drain guard
         # skip an unread body and desynchronize the connection
         self._body_consumed = False
+        self._metrics_status = None  # captured by send_response
         super().handle_one_request()
+
+    def send_response(self, code, message=None):
+        # every response path (including streamed NDJSON/scan bodies
+        # that never go through _send) funnels through here — the one
+        # place the final status is always known for request metrics
+        self._metrics_status = code
+        super().send_response(code, message)
 
     def _send(self, status: int, body: Any,
               content_type: str = "application/json; charset=UTF-8",
@@ -79,6 +202,10 @@ class JSONRequestHandler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
+        trace_id = trace.current_trace_id()
+        if trace_id:
+            # echo the request's trace id so clients can join their logs
+            self.send_header(trace.TRACE_HEADER, trace_id)
         if self.close_connection:
             self.send_header("Connection", "close")
         for name, value in (extra_headers or {}).items():
@@ -94,6 +221,13 @@ class JSONRequestHandler(BaseHTTPRequestHandler):
     def _read_json(self) -> Any:
         """Parsed JSON body; raises json.JSONDecodeError."""
         return json.loads(self._read_body() or b"{}")
+
+    def _do_get_fallback(self):
+        self._send(404, {"message": "Not Found"})
+
+    # servers that define no do_GET of their own still expose /metrics
+    # (served by the _instrument wrapper) and 404 everything else
+    do_GET = _instrument(_do_get_fallback)
 
 
 class _ThreadingHTTPServer(ThreadingHTTPServer):

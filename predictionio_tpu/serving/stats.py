@@ -33,20 +33,28 @@ class Stats:
         )
         self.start_time = _dt.datetime.now(tz=UTC)
 
+    def _prune_locked(self) -> _dt.datetime:
+        """Drop buckets older than the previous hour (hourly cutoff,
+        ref: StatsActor bookkeeping); returns the cutoff. Caller holds
+        the lock."""
+        cutoff = _hour_bucket() - _dt.timedelta(hours=1)
+        for old in [b for b in self._buckets if b < cutoff]:
+            del self._buckets[old]
+        return cutoff
+
     def update(self, app_id: int, status: int, event: str, entity_type: str) -> None:
         with self._lock:
-            bucket = _hour_bucket()
-            self._buckets[bucket][int(app_id)][(status, event, entity_type)] += 1
-            # drop buckets older than the previous hour (hourly cutoff,
-            # ref: StatsActor bookkeeping)
-            cutoff = bucket - _dt.timedelta(hours=1)
-            for old in [b for b in self._buckets if b < cutoff]:
-                del self._buckets[old]
+            self._buckets[_hour_bucket()][int(app_id)][
+                (status, event, entity_type)] += 1
+            self._prune_locked()
 
     def report(self, app_id: int) -> dict:
         """Previous + current hour counts for one app (ref: /stats.json)."""
         with self._lock:
-            cutoff = _hour_bucket() - _dt.timedelta(hours=1)
+            # prune here too: update() only runs when events arrive, so
+            # on a quiet app stale hours would otherwise sit in memory
+            # (and one filter bug away from being reported) indefinitely
+            cutoff = self._prune_locked()
             out = []
             for bucket in sorted(b for b in self._buckets if b >= cutoff):
                 counts = self._buckets[bucket].get(int(app_id), {})

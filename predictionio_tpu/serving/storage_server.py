@@ -240,6 +240,7 @@ def _encode_result(value: Any, kind: str) -> Any:
 class StorageRequestHandler(JSONRequestHandler):
     """Dispatch /storage/* to the wrapped Storage's DAOs."""
 
+    server_version = "PIOStorageServer/0.1"
 
     # -- auth ---------------------------------------------------------------
     def _authorized(self) -> bool:

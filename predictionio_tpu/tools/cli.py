@@ -483,9 +483,29 @@ def cmd_status(args) -> int:
     return 1
 
 
+def cmd_metrics(args) -> int:
+    """Dump telemetry in Prometheus text format (obs subsystem): from a
+    running server's ``GET /metrics`` when --url is given (every PIO
+    server exposes it), otherwise the in-process registry — useful after
+    an in-process `pio train` to read compile-cache and train timings."""
+    if args.url:
+        import urllib.request
+
+        url = args.url.rstrip("/")
+        if not url.endswith("/metrics"):
+            url += "/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            sys.stdout.write(resp.read().decode())
+        return 0
+    from predictionio_tpu.obs.metrics import REGISTRY
+
+    sys.stdout.write(REGISTRY.render())
+    return 0
+
+
 def cmd_lint(args) -> int:
     """graftlint: the JAX/TPU-aware static analysis over the tree
-    (rules JT01-JT06; tier-1 CI runs the same pass via tests/test_lint_clean.py)."""
+    (rules JT01-JT07; tier-1 CI runs the same pass via tests/test_lint_clean.py)."""
     from predictionio_tpu.tools.lint import run_cli
 
     try:
@@ -690,8 +710,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("args", nargs=argparse.REMAINDER)
     p.set_defaults(func=cmd_run)
 
+    p = sub.add_parser(
+        "metrics",
+        help="dump Prometheus metrics (from a server's /metrics with "
+             "--url, else the in-process registry)",
+    )
+    p.add_argument("--url", default=None,
+                   help="base URL of any PIO server, e.g. "
+                        "http://127.0.0.1:8000")
+    p.set_defaults(func=cmd_metrics)
+
     p = sub.add_parser("lint", help="run graftlint (JAX/TPU-aware static "
-                                    "analysis, rules JT01-JT06) over the tree")
+                                    "analysis, rules JT01-JT07) over the tree")
     p.add_argument("paths", nargs="*", default=[],
                    help="files/dirs (default: the installed package)")
     p.add_argument("--format", choices=["human", "json"], default="human")

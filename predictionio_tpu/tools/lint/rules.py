@@ -1,4 +1,4 @@
-"""graftlint rules JT01-JT06: the TPU hazards this codebase has hit.
+"""graftlint rules JT01-JT07: the TPU hazards this codebase has hit.
 
 Each rule encodes a failure class with a concrete precedent in this
 tree's history (the bf16-Gramian divergence behind JT03 is recorded in
@@ -613,3 +613,91 @@ class BlockingTransferInHandler(Rule):
                         "serialize the device — go through the "
                         "micro-batched query path",
                     )
+
+
+# -- JT07 ----------------------------------------------------------------------
+
+@register
+class MissingBufferDonation(Rule):
+    id = "JT07"
+    name = "missing-buffer-donation"
+    rationale = (
+        "A jit'd step called as `params, ... = step(params, ...)` without "
+        "donate_argnums/donate_argnames keeps the old AND new buffers "
+        "live across the call — the rebound arrays' peak HBM doubles; "
+        "donate the rebound arguments."
+    )
+
+    _DONATE_KWARGS = {"donate_argnums", "donate_argnames"}
+
+    def _jit_call_donates(self, call: ast.Call) -> Optional[bool]:
+        """For ``jax.jit(f, ...)`` / ``partial(jax.jit, ...)`` calls:
+        whether donation is declared; None when not a jit call."""
+        if not isinstance(call, ast.Call):
+            return None
+        if _is_jit_callable(call.func):
+            return any(kw.arg in self._DONATE_KWARGS for kw in call.keywords)
+        d = dotted(call.func)
+        if d in {"partial", "functools.partial"} and call.args and (
+            _is_jit_callable(call.args[0])
+        ):
+            return any(kw.arg in self._DONATE_KWARGS for kw in call.keywords)
+        return None
+
+    def _jit_targets(self, tree: ast.AST) -> Dict[str, bool]:
+        """Dotted callee name -> donation declared, for every jit'd
+        function visible file-locally: decorated defs and
+        ``x = jax.jit(f, ...)`` bindings (incl. ``self._step = ...``)."""
+        donates: Dict[str, bool] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _is_jit_callable(dec):
+                        donates[node.name] = False      # bare @jax.jit
+                    elif isinstance(dec, ast.Call):
+                        # @partial(jax.jit, ...) / @jax.jit(...) forms
+                        declared = self._jit_call_donates(dec)
+                        if declared is not None:
+                            donates[node.name] = declared
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                declared = self._jit_call_donates(node.value)
+                if declared is None:
+                    continue
+                for tgt in node.targets:
+                    name = dotted(tgt)
+                    if name:
+                        donates[name] = declared
+        return donates
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        donates = self._jit_targets(ctx.tree)
+        if not donates:
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            callee = dotted(node.value.func)
+            if donates.get(callee, True):
+                continue  # not a known jit target, or donation declared
+            passed = {dotted(a) for a in node.value.args} | {
+                dotted(kw.value) for kw in node.value.keywords
+            }
+            passed.discard("")
+            rebound: Set[str] = set()
+            for tgt in node.targets:
+                elts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) \
+                    else [tgt]
+                rebound.update(dotted(t) for t in elts)
+            overlap = sorted(rebound & passed)
+            if overlap:
+                yield Finding(
+                    self.id, ctx.path, node.lineno, node.col_offset,
+                    f"jit'd `{callee}` rebinds its own argument(s) "
+                    f"{', '.join(overlap)} without buffer donation — old "
+                    "and new buffers coexist, doubling their peak HBM; "
+                    "declare donate_argnums/donate_argnames for the "
+                    "rebound arguments",
+                )

@@ -25,6 +25,7 @@ from predictionio_tpu.core.params import EngineParams, params_to_dict
 from predictionio_tpu.core.persistent_model import PersistentModel, manifest_for
 from predictionio_tpu.data.metadata import EngineInstance, Model
 from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.obs import jaxmon
 from predictionio_tpu.parallel.mesh import MeshContext
 from predictionio_tpu.workflow.config import WorkflowParams
 
@@ -160,8 +161,18 @@ def run_train(
         instance.status = "TRAINING"
         if writer:
             storage.engine_instances().update(instance)
+        import time as _time
+
+        t_train = _time.perf_counter()
         with _maybe_profile(instance.id):
             result: TrainResult = engine.train(ctx, engine_params, wp)
+        # whole-train wall time + post-train device memory (the peak a
+        # donation/HBM regression would move) on /metrics and `pio
+        # metrics`; step-level timing comes from the training loops
+        # themselves via jaxmon.observe_train_step
+        jaxmon.TRAIN_SECONDS.labels(engine_id).observe(
+            _time.perf_counter() - t_train)
+        jaxmon.update_device_memory_gauges()
         if result.stopped_after:
             # debug interruption (ref: Engine.scala:624-648): no model persisted
             instance.status = "COMPLETED"

@@ -35,8 +35,9 @@ def rule_ids(findings):
 
 # -- engine behavior -----------------------------------------------------------
 
-def test_all_six_rules_registered():
-    assert {"JT01", "JT02", "JT03", "JT04", "JT05", "JT06"} <= set(RULES)
+def test_all_rules_registered():
+    assert {"JT01", "JT02", "JT03", "JT04", "JT05", "JT06",
+            "JT07"} <= set(RULES)
 
 
 def test_syntax_error_is_reported_not_raised(tmp_path):
@@ -399,6 +400,66 @@ def test_jt06_only_applies_to_server_modules(tmp_path):
             def do_GET(self, x):
                 x.block_until_ready()
     """, relpath="ops/not_a_server.py")
+    assert findings == []
+
+
+# -- JT07 missing-buffer-donation ---------------------------------------------
+
+def test_jt07_positive_decorated_step(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import jax
+
+        @jax.jit
+        def train_step(params, opt_state, batch):
+            return params, opt_state, 0.0
+
+        def loop(params, opt_state, batches):
+            for b in batches:
+                params, opt_state, loss = train_step(params, opt_state, b)
+            return params
+    """)
+    assert rule_ids(findings) == ["JT07"]
+    assert "opt_state, params" in findings[0].message
+
+
+def test_jt07_positive_jit_assignment_and_attribute_target(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import jax
+
+        class Trainer:
+            def __init__(self, step_fn):
+                self._step = jax.jit(step_fn)
+
+            def run(self, batch):
+                self.params, loss = self._step(self.params, batch)
+                return loss
+    """)
+    assert rule_ids(findings) == ["JT07"]
+    assert "`self._step`" in findings[0].message
+
+
+def test_jt07_negative_donated_and_unrelated(tmp_path):
+    findings = lint_src(tmp_path, """\
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def train_step(params, opt_state, batch):
+            return params, opt_state, 0.0
+
+        @jax.jit
+        def score(params, batch):
+            return 0.0
+
+        def loop(params, opt_state, batches):
+            stepper = jax.jit(lambda p, b: p, donate_argnames=("p",))
+            for b in batches:
+                params, opt_state, loss = train_step(params, opt_state, b)
+                params = stepper(params, b)
+                loss = score(params, b)          # no rebind of an arg
+                other = not_jitted(params, b)    # unknown callee: silent
+            return params
+    """)
     assert findings == []
 
 
