@@ -164,6 +164,12 @@ def _bench_cfg():
 #: template's full-data key
 _HOLD_TAG = "|hold5pct"
 
+#: generous bound on the transfer-watcher join: the worst observed
+#: driver weather moved ~220 MB at ~2 MB/s (~110 s); 1800 s only fires
+#: on a genuine wire hang, which must become a diagnosable error rather
+#: than a silently wedged bench process
+TRANSFER_JOIN_TIMEOUT_SEC = 1800.0
+
 
 def _transfer_and_compile(detail, trainer, iterations, n_read):
     """Shared tail of both stages: transfer and compile OVERLAPPED
@@ -181,6 +187,7 @@ def _transfer_and_compile(detail, trainer, iterations, n_read):
 
     t_enter = time.perf_counter()
     wire = {}
+    comp = {}
 
     def watch():
         try:
@@ -188,10 +195,44 @@ def _transfer_and_compile(detail, trainer, iterations, n_read):
         except Exception as e:  # noqa: BLE001 — surfaced after join
             wire["error"] = e
 
+    def compile_run():
+        # on its own thread: compile()'s warm-up ends in a blocking
+        # scalar pull on the SAME arrays still crossing the wire, so a
+        # genuine tunnel hang would wedge the main thread before any
+        # join-with-timeout ran — the deadline below must cover BOTH
+        # sides of the overlap to ever fire (r6 advisor finding)
+        try:
+            trainer.compile()
+        except Exception as e:  # noqa: BLE001 — surfaced after join
+            comp["error"] = e
+
     th = threading.Thread(target=watch, daemon=True)
+    tc = threading.Thread(target=compile_run, daemon=True)
     th.start()
-    trainer.compile()   # host compile overlaps the transfer; its
-    th.join()           # warm-up run blocks on the data
+    tc.start()   # host compile overlaps the transfer
+    deadline = t_enter + TRANSFER_JOIN_TIMEOUT_SEC
+    for t in (th, tc):
+        t.join(timeout=max(0.0, deadline - time.perf_counter()))
+    if th.is_alive() or tc.is_alive():
+        pending = [side for side, t in (("wire (async puts never "
+                                         "completed)", th),
+                                        ("compile+warmup (blocks on the "
+                                         "transferred data)", tc))
+                   if t.is_alive()]
+        # a side that DIED with an error is often the root cause of the
+        # other side's hang (a dropped tunnel fails the watcher fast,
+        # then the warm-up waits forever on data that will never land):
+        # surface it in the same message
+        died = "; ".join(
+            f"{side} already failed: {d['error']!r}"
+            for side, d in (("wire", wire), ("compile", comp))
+            if "error" in d)
+        raise RuntimeError(
+            "transfer/compile overlap still pending after "
+            f"{TRANSFER_JOIN_TIMEOUT_SEC:.0f}s — side(s): "
+            + "; ".join(pending) + (f" [{died}]" if died else ""))
+    if "error" in comp:
+        raise RuntimeError("host compile failed") from comp["error"]
     if "error" in wire:
         raise RuntimeError("device transfer failed") from wire["error"]
     overlap_wall = time.perf_counter() - t_enter
@@ -988,6 +1029,10 @@ def stage_twotower(base_dir, out_path):
     t0 = time.perf_counter()
     trainer = TwoTowerTrainer((uu, ii, None), tt_ids, tt_ids, cfg)
     detail["init_sec"] = round(time.perf_counter() - t0, 2)
+    # which loss/update paths produced these numbers (ops/pallas vs
+    # XLA): a step-time comparison across rounds is meaningless
+    # without it — PIO_TT_FLASH_CE / PIO_TT_EMBED_UPDATE A/B from env
+    detail["kernels"] = trainer.kernel_plan
     steps = trainer.steps_per_epoch
     detail["steps_per_epoch"] = steps
 

@@ -44,6 +44,11 @@ class TwoTowerParams(Params):
     shard_embeddings: bool = False
     checkpoint_dir: Optional[str] = None   # mid-training checkpoint/resume
     checkpoint_every: int = 1
+    flash_ce_kernel: str = "auto"          # ops/pallas flash-CE kernel:
+    embed_update_kernel: str = "off"       # "auto" | "on" | "off" (see
+                                           # TwoTowerConfig; env overrides
+                                           # PIO_TT_FLASH_CE /
+                                           # PIO_TT_EMBED_UPDATE)
 
 
 class TwoTowerModel(ALSModel):
@@ -79,6 +84,8 @@ class TwoTowerAlgorithm(Algorithm):
             shard_embeddings=p.shard_embeddings,
             checkpoint_dir=p.checkpoint_dir,
             checkpoint_every=p.checkpoint_every,
+            flash_ce_kernel=p.flash_ce_kernel,
+            embed_update_kernel=p.embed_update_kernel,
         )
         trainer = TwoTowerTrainer(
             (u, i, r if p.weight_by_rating else None),

@@ -14,6 +14,7 @@ the obs registry so they show up on every server's ``/metrics``:
   pio_train_step_seconds_bucket                     per-train-step wall time
   pio_train_seconds_bucket{engine=...}              whole-train wall time
   pio_device_memory_bytes{device,kind}              allocator stats per device
+  pio_pallas_kernel_enabled{kernel=}                Pallas vs XLA path choice
 
 ``install()`` never imports jax at module import time and never raises:
 observability must not change whether training runs.
@@ -73,6 +74,13 @@ DEVICE_MEMORY_BYTES = metrics.gauge(
     ("device", "kind"),
 )
 
+PALLAS_KERNEL_ENABLED = metrics.gauge(
+    "pio_pallas_kernel_enabled",
+    "Whether a Pallas kernel path (ops/pallas/) is engaged for the "
+    "current trainer (1) or its XLA fallback is active (0)",
+    ("kernel",),
+)
+
 #: jax.monitoring event keys -> our series (jax 0.4.x names; unknown
 #: keys are ignored so a jax upgrade degrades to missing points, never
 #: an error)
@@ -119,6 +127,16 @@ def install() -> bool:
     monitoring.register_event_duration_secs_listener(_on_event_duration)
     _installed = True
     return True
+
+
+def record_kernel_plan(plan: dict) -> None:
+    """Export a trainer's kernel-selection decision (ops/pallas/) so a
+    bench capture or dashboard always says which path produced its
+    numbers — a step-time comparison across runs is meaningless without
+    it."""
+    for kernel in ("flash_ce", "embed_update"):
+        if kernel in plan:
+            PALLAS_KERNEL_ENABLED.labels(kernel).set(float(bool(plan[kernel])))
 
 
 def record_transfer(nbytes: Optional[int], direction: str) -> None:

@@ -1032,7 +1032,16 @@ def als_grid_train(
                  else np.asarray(iterations, np.int32))
     cg_arr = (np.full(G, cfg.cg_iters, np.int32) if cg_iters is None
               else np.asarray(cg_iters, np.int32))
-    assert len(alphas) == G and len(iters_arr) == G and len(cg_arr) == G
+    # a real error, not an assert (same rationale as _split_idx): under
+    # python -O a silently shorter list would vmap over garbage scalars
+    # and train wrong candidates without a symptom
+    for name, arr in (("alphas", alphas), ("iterations", iters_arr),
+                      ("cg_iters", cg_arr)):
+        if len(arr) != G:
+            raise ValueError(
+                f"als_grid_train: `{name}` has {len(arr)} entries but "
+                f"`regs` defines {G} grid candidates — every "
+                "per-candidate list must match len(regs)")
     max_iters = int(iters_arr.max())
     max_cg = int(cg_arr.max())
     u_idx, i_idx, vals = user_coo

@@ -1,0 +1,125 @@
+"""Pallas TPU kernels for the framework's measured hot loops.
+
+Why a kernel subsystem exists (ROUND5.md §4): the two-tower stretch
+step is 90% NON-matmul device time — the blockwise-CE scan body's
+per-tile elementwise (56%) and the embedding scatter path (28+%) —
+while the matmul window itself already runs at ~45-57% of the v5e bf16
+peak. XLA fuses neither across its own loop/scatter boundaries; Pallas
+lets the elementwise CE ride in the matmul's shadow (``flash_ce``) and
+the table update run as one VMEM-resident gather→update→write pass
+(``embed_update``).
+
+Design contract shared by every kernel here:
+
+  - the XLA implementation REMAINS the reference and the fallback; a
+    kernel is selected per-trainer by :func:`decide` (config flag +
+    env override + eligibility), never unconditionally;
+  - kernels run under Pallas interpret mode on CPU, so tier-1
+    exercises fwd/bwd numerics with no TPU in the loop
+    (``PIO_PALLAS_INTERPRET=1`` forces it; a ``cpu`` jax backend
+    implies it);
+  - on a real TPU a kernel must pass a one-time :func:`probe` (tiny
+    compiled smoke call) before it is engaged — a Mosaic regression
+    degrades to the XLA path with a warning, never a failed train;
+  - equivalence tests pin each kernel to its XLA reference at <=1e-5
+    in f32 (tests/test_pallas_kernels.py).
+
+Env overrides (each beats the config flag, for bench A/B without code
+changes): ``PIO_TT_FLASH_CE``, ``PIO_TT_EMBED_UPDATE`` = ``on`` /
+``off`` / ``auto``; ``PIO_PALLAS_INTERPRET=1`` forces interpret mode.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, Dict, Tuple
+
+log = logging.getLogger(__name__)
+
+_TRUTHY = {"1", "true", "yes", "on"}
+_FALSY = {"0", "false", "no", "off"}
+
+
+def interpret_mode() -> bool:
+    """Whether kernels should run under the Pallas interpreter.
+
+    ``PIO_PALLAS_INTERPRET`` wins when set; otherwise a non-TPU jax
+    backend implies interpret (there is no Mosaic compiler to target).
+    """
+    env = os.environ.get("PIO_PALLAS_INTERPRET")
+    if env is not None:
+        return env.strip().lower() in _TRUTHY
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def resolve_flag(config_value: str, env_name: str) -> str:
+    """Normalize a kernel flag to ``on`` / ``off`` / ``auto``; the env
+    variable (bench A/B switch) overrides the config value. An
+    unrecognized value falls back to ``auto`` WITH a warning — a typo'd
+    ``PIO_TT_EMBED_UPDATE=onn`` during an on-chip A/B must not silently
+    measure the fallback arm twice."""
+    value = os.environ.get(env_name, config_value)
+    value = str(value).strip().lower()
+    if value in _TRUTHY:
+        return "on"
+    if value in _FALSY:
+        return "off"
+    if value != "auto":
+        log.warning("unrecognized kernel flag %r (config %r / env %s); "
+                    "treating as 'auto' — valid values: on/off/auto",
+                    value, config_value, env_name)
+    return "auto"
+
+
+def decide(
+    config_value: str,
+    env_name: str,
+    *,
+    eligible: bool,
+    ineligible_reason: str,
+    auto_default: bool,
+) -> Tuple[bool, str]:
+    """One kernel's engage decision -> (engaged, reason).
+
+    ``on``   engage whenever eligible (interpret mode included — how
+             CPU tier-1 exercises the kernels);
+    ``off``  never;
+    ``auto`` engage when eligible AND ``auto_default`` — the caller
+             passes True only on a real TPU backend, so interpret mode
+             is never silently slower for CPU users.
+    """
+    flag = resolve_flag(config_value, env_name)
+    if flag == "off":
+        return False, "disabled by flag"
+    if not eligible:
+        return False, ineligible_reason
+    if flag == "on":
+        return True, "forced on"
+    if auto_default:
+        return True, "auto (tpu backend)"
+    return False, "auto defaults off on non-TPU backends (set the flag " \
+                  "to 'on' to run under the interpreter)"
+
+
+_probe_cache: Dict[str, bool] = {}
+
+
+def probe(name: str, smoke: Callable[[], None]) -> bool:
+    """Run a kernel's tiny smoke call once per process; a failure
+    (Mosaic lowering, API drift, OOM) disables the kernel with a
+    warning instead of failing the train that wanted it."""
+    cached = _probe_cache.get(name)
+    if cached is not None:
+        return cached
+    try:
+        smoke()
+        ok = True
+    except Exception as e:  # noqa: BLE001 — any failure means "use the XLA fallback", logged below
+        log.warning("pallas kernel %r failed its smoke probe; falling "
+                    "back to the XLA path: %s: %s", name, type(e).__name__, e)
+        ok = False
+    _probe_cache[name] = ok
+    return ok

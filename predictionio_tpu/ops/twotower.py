@@ -30,6 +30,14 @@ scale (1M x 128 tables), measured for the BENCH twotower stage:
     input) with f32 accumulation; the L2 normalization, softmax/CE, and
     all optimizer state stay f32.
 
+Kernel layer (r6): on single-device runs the blockwise-CE scan body
+and (opt-in) the table update can be replaced by Pallas kernels from
+``ops/pallas/`` — the fused flash-CE ``custom_vjp`` pair and the fused
+embedding-update pass — selected per-trainer by ``_plan_kernels`` with
+the XLA forms below remaining the reference and the fallback
+(equivalence pinned by tests/test_pallas_kernels.py; flags in
+``TwoTowerConfig``; interpret mode covers them on CPU tier-1).
+
 Mesh mapping:
   - the scan's batch axis is sharding-constrained over ``data`` (DP):
     each device gathers and runs tower compute on its batch shard; the
@@ -44,6 +52,7 @@ Mesh mapping:
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import List, Optional, Tuple
 
 import jax
@@ -51,6 +60,21 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.ops import pallas as _plk
+
+# the kernel modules import jax.experimental.pallas(+.tpu), which have
+# churned across jax 0.4.x: an import-time break there must degrade to
+# the XLA paths below (the subsystem's never-a-failed-train contract),
+# not kill every two-tower train — including ones that never asked for
+# a kernel. _plan_kernels surfaces the reason.
+try:
+    from predictionio_tpu.ops.pallas import embed_update as _pl_embed
+    from predictionio_tpu.ops.pallas import flash_ce as _pl_flash
+    _PALLAS_IMPORT_ERROR: Optional[str] = None
+except Exception as _e:  # noqa: BLE001 — experimental-API drift; reason is surfaced by _plan_kernels
+    _pl_embed = _pl_flash = None  # type: ignore[assignment]
+    _PALLAS_IMPORT_ERROR = f"{type(_e).__name__}: {_e}"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -85,6 +109,16 @@ class TwoTowerConfig:
     shard_embeddings: bool = False     # row-shard tables over the "model" axis
     checkpoint_dir: Optional[str] = None  # mid-training checkpoint/resume
     checkpoint_every: int = 1             # epochs between checkpoints
+    flash_ce_kernel: str = "auto"      # Pallas fused flash-CE loss kernel:
+                                       # "auto" (on for single-device TPU
+                                       # runs, XLA elsewhere) | "on" | "off";
+                                       # env PIO_TT_FLASH_CE overrides
+    embed_update_kernel: str = "off"   # Pallas fused table-update kernel:
+                                       # default OFF pending an on-chip win
+                                       # over the measured XLA scatter floor
+                                       # (ops/pallas/embed_update.py
+                                       # docstring); env PIO_TT_EMBED_UPDATE
+                                       # overrides
 
 
 @dataclasses.dataclass
@@ -290,7 +324,20 @@ def _make_blockwise_ce_vjp(u_idx, i_idx, weight, temp, chunk, cdt, B):
     so the softmax reconstruction is ONE fused exp/where pass per tile
     feeding two grad matmuls — no autodiff scan-reversal, no
     logsumexp-grad max-pass recompute. Only (u, v) residuals plus two
-    [B] LSE vectors are saved."""
+    [B] LSE vectors are saved.
+
+    ``u_idx``/``i_idx``/``weight`` are NON-DIFFERENTIABLE BY
+    CONSTRUCTION: they are closed over by this factory, not traced
+    arguments of the returned ``ce(u, v)``, and the custom_vjp
+    declares cotangents only for (u, v). Differentiating a surrounding
+    loss w.r.t. ``weight`` (weighted-loss tuning) does NOT silently
+    return zero grads — JAX raises ``UnexpectedTracerError`` on the
+    closed-over tracer. To make weights tunable, thread them as a real
+    argument with an explicit d(loss)/dw rule (the loss is linear in w:
+    dLoss/dw_b = [0.5*(l_ui[b] + l_iu[b]) - loss] / Sum_w), or use the
+    checkpoint-autodiff form, which differentiates anything. The same
+    contract holds for the Pallas flash-CE kernel
+    (ops/pallas/flash_ce.py), which mirrors this factory's closure."""
     S = B // chunk
     rows = jnp.arange(B)
     i_t = i_idx.reshape(S, chunk)
@@ -447,6 +494,8 @@ class TwoTowerTrainer:
         self._i = _put_data(np.concatenate([i, np.zeros(1, np.int32)]))
         self._w = _put_data(np.concatenate([w, np.zeros(1, np.float32)]))
 
+        self.kernel_plan = self._plan_kernels()
+
         width = cfg.embed_dim or cfg.dim
         k0, k1, k2, k3 = jax.random.split(jax.random.PRNGKey(cfg.seed), 4)
         scale = 1.0 / np.sqrt(width)
@@ -515,6 +564,73 @@ class TwoTowerTrainer:
                 self._epochs_done = epoch
                 self._losses = list(state["losses"])
 
+    # -- kernel selection ---------------------------------------------------
+
+    def _plan_kernels(self) -> dict:
+        """Decide, once per trainer, whether the Pallas kernels
+        (ops/pallas/) replace their XLA forms for this run.
+
+        Eligibility is per-kernel; both additionally require a
+        single-device run (``pallas_call`` does not partition under a
+        multi-device mesh) and — on a real TPU — a one-time compiled
+        smoke probe, so a Mosaic regression degrades to the XLA path
+        with a warning instead of failing the train. The decision dict
+        is exported (bench detail + ``pio_pallas_kernel_enabled``
+        metric) so a capture always says which path produced it."""
+        from predictionio_tpu.obs import jaxmon
+
+        cfg = self.cfg
+        interp = _plk.interpret_mode()
+        backend = jax.default_backend()
+        on_tpu = backend == "tpu"
+        single = self.mesh is None or self.mesh.size == 1
+        direct = (1.0 / cfg.temperature) <= _DIRECT_EXP_MAX_INV_TEMP
+        plan = {"interpret": interp, "backend": backend}
+
+        if _pl_flash is None:
+            why = f"pallas unavailable: {_PALLAS_IMPORT_ERROR}"
+            plan.update({"flash_ce": False, "flash_ce_reason": why,
+                         "embed_update": False, "embed_update_reason": why})
+            jaxmon.record_kernel_plan(plan)
+            return plan
+
+        elig_ce = single and direct and self.batch >= _pl_flash.MIN_BATCH
+        why_ce = ("multi-device mesh" if not single
+                  else "1/temp outside the direct-exp regime" if not direct
+                  else f"batch {self.batch} < {_pl_flash.MIN_BATCH}")
+        # probes run at the trainer's ACTUAL shapes (a tiny fixed-shape
+        # probe would pass while the real tiles hit a shape-dependent
+        # Mosaic/VMEM failure inside the first train step); the cache
+        # key carries the shapes for the same reason
+        B, D = self.batch, cfg.dim
+        width = cfg.embed_dim or cfg.dim
+        cdt = jnp.dtype(cfg.compute_dtype)
+        ce_on, ce_why = _plk.decide(
+            cfg.flash_ce_kernel, "PIO_TT_FLASH_CE",
+            eligible=elig_ce, ineligible_reason=why_ce,
+            auto_default=on_tpu)
+        if ce_on and not interp:
+            ce_on = _plk.probe(
+                f"flash_ce:{B}x{D}:{cdt}",
+                lambda: _pl_flash.smoke_at(B, D, cfg.temperature, cdt))
+            ce_why = ce_why if ce_on else "smoke probe failed (see log)"
+
+        emb_on, emb_why = _plk.decide(
+            cfg.embed_update_kernel, "PIO_TT_EMBED_UPDATE",
+            eligible=single, ineligible_reason="multi-device mesh",
+            auto_default=False)  # default-off: measured-rejection
+        #                          discipline, ops/pallas/embed_update.py
+        if emb_on and not interp:
+            emb_on = _plk.probe(
+                f"embed_update:{B}x{width}",
+                lambda: _pl_embed.smoke_at(B, width))
+            emb_why = emb_why if emb_on else "smoke probe failed (see log)"
+
+        plan.update({"flash_ce": ce_on, "flash_ce_reason": ce_why,
+                     "embed_update": emb_on, "embed_update_reason": emb_why})
+        jaxmon.record_kernel_plan(plan)
+        return plan
+
     # -- loss ---------------------------------------------------------------
 
     def _loss_from_rows(self, ue, ve, dense, u_idx, i_idx, weight):
@@ -522,6 +638,11 @@ class TwoTowerTrainer:
         u = _apply_tail(dense["user"], ue, cfg)         # [B, D] f32 unit
         v = _apply_tail(dense["item"], ve, cfg)
         B = u.shape[0]
+        if self.kernel_plan["flash_ce"]:
+            return _pl_flash.pallas_blockwise_ce(
+                u, v, u_idx, i_idx, weight, cfg.temperature,
+                jnp.dtype(cfg.compute_dtype),
+                interpret=self.kernel_plan["interpret"])
         chunk = cfg.loss_chunk
         if chunk and B >= 2 * chunk and B % chunk == 0:
             return _blockwise_softmax_ce(
@@ -545,6 +666,12 @@ class TwoTowerTrainer:
         mesh = self.mesh
         dp = mesh is not None and mesh.shape.get("data", 1) > 1
         loss_from_rows = self._loss_from_rows
+        if self.kernel_plan["embed_update"]:
+            row_update = functools.partial(
+                _pl_embed.pallas_rowwise_adagrad,
+                interpret=self.kernel_plan["interpret"])
+        else:
+            row_update = _rowwise_adagrad
 
         def step(carry, idx):
             tables, acc, dense, opt_state = carry
@@ -558,9 +685,9 @@ class TwoTowerTrainer:
             )(ue, ve, dense, u_idx, i_idx, w)
             tables = dict(tables)
             acc = dict(acc)
-            tables["user"], acc["user"] = _rowwise_adagrad(
+            tables["user"], acc["user"] = row_update(
                 tables["user"], acc["user"], u_idx, gu, table_lr)
-            tables["item"], acc["item"] = _rowwise_adagrad(
+            tables["item"], acc["item"] = row_update(
                 tables["item"], acc["item"], i_idx, gv, table_lr)
             if any(len(v) for v in dense.values()):
                 updates, opt_state = tx.update(gd, opt_state, dense)

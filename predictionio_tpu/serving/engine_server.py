@@ -174,6 +174,12 @@ class MicroBatcher:
         from collections import deque
 
         self._splits = deque(maxlen=50_000)
+        # abandoned submitters (timed out waiting) are counted here and
+        # EXCLUDED from the splits: their queue wait is the caller's
+        # timeout and their dispatch time covers work the worker skipped
+        # — folding them in would skew the bench's srv_queue /
+        # srv_dispatch percentiles with numbers no served request saw
+        self._abandoned = 0
         self._stop = False
         # orders submit()'s stop-check+enqueue against stop()'s flag+wake,
         # so nothing can be enqueued after the worker's shutdown drain
@@ -240,14 +246,21 @@ class MicroBatcher:
         shared one device dispatch."""
         with self._hist_lock:
             hist = {str(k): v for k, v in sorted(self._hist.items())}
+            abandoned = self._abandoned
         return {
             "maxBatch": self._max_batch,
             "dispatches": sum(hist.values()),
             "batchSizeHistogram": hist,
+            # timed-out submitters, kept OUT of the latency splits
+            "abandonedRequests": abandoned,
         }
 
     def _answer(self, batch) -> None:
-        batch = [p for p in batch if not p.abandoned]
+        live = [p for p in batch if not p.abandoned]
+        if len(live) < len(batch):
+            with self._hist_lock:
+                self._abandoned += len(batch) - len(live)
+        batch = live
         if not batch:
             return
         with self._hist_lock:
@@ -291,6 +304,13 @@ class MicroBatcher:
         t_done = time.perf_counter()
         with self._hist_lock:
             for p in batch:
+                if p.abandoned:
+                    # the submitter's timeout raced the dispatch (the
+                    # entry filter in _answer only catches tombstones
+                    # laid BEFORE the drain): count it, don't let its
+                    # give-up-sized wait skew the percentiles
+                    self._abandoned += 1
+                    continue
                 self._splits.append((t_start - p.t_submit, t_done - t_start))
 
     def recent_splits(self, n: int):

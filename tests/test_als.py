@@ -435,3 +435,21 @@ def test_map_batch_matches_default():
                                    rtol=1e-5, atol=1e-5)
         np.testing.assert_allclose(f.item_factors, base.item_factors,
                                    rtol=1e-5, atol=1e-5)
+
+
+def test_grid_train_validates_candidate_list_lengths():
+    """Mismatched per-candidate lists must raise ValueError (a bare
+    assert vanishes under `python -O` and would vmap over garbage
+    scalars — advisor finding, r6), and must raise BEFORE any layout
+    work touches the device."""
+    from predictionio_tpu.ops.als import als_grid_train
+
+    rng = np.random.default_rng(2)
+    coo = (rng.integers(0, 12, 60), rng.integers(0, 8, 60),
+           (rng.random(60) * 4 + 1).astype(np.float32))
+    cfg = ALSConfig(rank=4, iterations=2, block_size=8, seg_len=8)
+    for kw in ({"alphas": [1.0]}, {"iterations": [2, 3, 4]},
+               {"cg_iters": [4]}):
+        name = next(iter(kw))
+        with pytest.raises(ValueError, match=f"`{name}`.*match len\\(regs\\)"):
+            als_grid_train(coo, 12, 8, cfg, regs=[0.1, 0.2], **kw)

@@ -15,6 +15,8 @@ import importlib.util
 import json
 import os
 
+import pytest
+
 _spec = importlib.util.spec_from_file_location(
     "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py"))
 bench = importlib.util.module_from_spec(_spec)
@@ -162,3 +164,72 @@ def test_oversize_line_prunes_but_always_prints(tmp_path, monkeypatch):
     assert "gates" in line and line["gates"]["rmse"] is True
     full = json.loads((tmp_path / "d.json").read_text())
     assert full["headline_pruned_keys"]
+
+
+@pytest.mark.parametrize("wire_hangs,compile_hangs,expect", [
+    # a REAL tunnel hang wedges BOTH sides: compile()'s warm-up ends in
+    # a blocking scalar pull on the very arrays still crossing the wire
+    (True, True, "wire.*compile"),
+    (True, False, r"wire \(async puts"),
+    (False, True, r"compile\+warmup"),
+])
+def test_transfer_compile_overlap_times_out_with_side_attribution(
+        monkeypatch, wire_hangs, compile_hangs, expect):
+    """A hung transfer/compile overlap must surface as a diagnosable
+    error naming WHICH side(s) were still pending at the deadline,
+    instead of wedging the bench process forever — and the deadline
+    must cover the compile thread too, since its warm-up blocks on the
+    transferred data (advisor finding, r6)."""
+    import threading
+
+    monkeypatch.setattr(bench, "TRANSFER_JOIN_TIMEOUT_SEC", 0.05)
+    release = threading.Event()
+
+    class HungTrainer:
+        put_start = 0.0
+        transfer_bytes = 0
+
+        def wait_device_timed(self):
+            if wire_hangs:
+                release.wait(5.0)
+            return [0.0]
+
+        def compile(self):
+            if compile_hangs:
+                release.wait(5.0)
+
+    try:
+        with pytest.raises(RuntimeError, match=expect):
+            bench._transfer_and_compile({"bin_sec": 0.0}, HungTrainer(),
+                                        iterations=1, n_read=1)
+    finally:
+        release.set()            # unblock the daemon threads
+
+
+def test_transfer_timeout_surfaces_dead_side_error(monkeypatch):
+    """When one side FAILED fast and the other hangs (dropped tunnel:
+    watcher errors, warm-up waits forever), the timeout message must
+    carry the dead side's error — it is the root cause."""
+    import threading
+
+    monkeypatch.setattr(bench, "TRANSFER_JOIN_TIMEOUT_SEC", 0.05)
+    release = threading.Event()
+
+    class Trainer:
+        put_start = 0.0
+        transfer_bytes = 0
+
+        def wait_device_timed(self):
+            raise OSError("tunnel dropped")
+
+        def compile(self):
+            release.wait(5.0)   # waits on data that will never land
+
+    try:
+        with pytest.raises(RuntimeError,
+                           match=r"compile\+warmup.*wire already failed.*"
+                                 r"tunnel dropped"):
+            bench._transfer_and_compile({"bin_sec": 0.0}, Trainer(),
+                                        iterations=1, n_read=1)
+    finally:
+        release.set()

@@ -1,0 +1,305 @@
+"""ops/pallas kernels under the interpreter (JAX_PLATFORMS=cpu):
+fwd/bwd equivalence against the XLA reference paths, the adagrad
+update, and the selection/fallback machinery.
+
+The contract these tests pin (ops/pallas/__init__.py): the XLA forms
+in ops/twotower.py remain the numerical reference; a kernel may only
+replace one if it agrees to <=1e-5 in f32 — including in-batch
+duplicate users/items, zero-weight padding rows, and a RAGGED last
+grid tile — and selection must fall back (never fail) everywhere a
+kernel is ineligible.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from predictionio_tpu.ops import pallas as plk
+from predictionio_tpu.ops.pallas.embed_update import pallas_rowwise_adagrad
+from predictionio_tpu.ops.pallas.flash_ce import (
+    make_flash_ce,
+    pallas_blockwise_ce,
+)
+from predictionio_tpu.ops.twotower import (
+    TwoTowerConfig,
+    TwoTowerTrainer,
+    _dense_softmax_ce,
+    _make_blockwise_ce_vjp,
+    _rowwise_adagrad,
+)
+
+
+def _batch(B, D, seed=9, n_users=60, n_items=40, n_pad=17,
+           uniform_w=True):
+    """Unit-norm towers + index vectors with many in-batch duplicates
+    and a zero-weight padded tail — the full masking surface.
+    ``uniform_w=False`` draws real-valued weights (the
+    ``weight_by_rating`` path), exercising the w-asymmetric terms of
+    the loss and backward that 0/1 weights cannot distinguish."""
+    rng = np.random.default_rng(seed)
+    u = rng.normal(size=(B, D)).astype(np.float32)
+    v = rng.normal(size=(B, D)).astype(np.float32)
+    u /= np.linalg.norm(u, axis=1, keepdims=True)
+    v /= np.linalg.norm(v, axis=1, keepdims=True)
+    u_idx = rng.integers(0, n_users, B).astype(np.int32)
+    i_idx = rng.integers(0, n_items, B).astype(np.int32)
+    w = (np.ones(B, np.float32) if uniform_w
+         else (0.5 + 4.0 * rng.random(B)).astype(np.float32))
+    if n_pad:
+        w[-n_pad:] = 0.0
+    return (jnp.asarray(u), jnp.asarray(v), jnp.asarray(u_idx),
+            jnp.asarray(i_idx), jnp.asarray(w))
+
+
+@pytest.mark.parametrize("cdt_name,uniform_w,l_rtol,g_rtol,g_atol", [
+    ("float32", True, 1e-5, 1e-4, 1e-6),
+    # weight_by_rating shape: real-valued weights exercise the
+    # w-asymmetric loss/backward terms 0/1 weights cannot distinguish
+    ("float32", False, 1e-5, 1e-4, 1e-6),
+    # bf16 tile logits: same tolerance story as the XLA blockwise test
+    # (quantization under different summation orders)
+    ("bfloat16", True, 5e-3, 1e-1, 2e-3),
+])
+def test_flash_ce_matches_xla_paths(cdt_name, uniform_w, l_rtol, g_rtol,
+                                    g_atol):
+    """Loss AND grads of the Pallas flash-CE agree with the dense
+    reference and the XLA blockwise VJP it replaces."""
+    B, D, block = 256, 16, 64
+    u, v, u_idx, i_idx, w = _batch(B, D, uniform_w=uniform_w)
+    cdt = jnp.dtype(cdt_name)
+
+    def dense(u_, v_):
+        return _dense_softmax_ce(u_, v_, u_idx, i_idx, w, 0.07, cdt)
+
+    xla = _make_blockwise_ce_vjp(u_idx, i_idx, w, 0.07, block, cdt, B)
+    flash = make_flash_ce(u_idx, i_idx, w, 0.07, cdt, B,
+                          interpret=True, block=block)
+
+    ld, (gdu, gdv) = jax.value_and_grad(dense, argnums=(0, 1))(u, v)
+    lx, (gxu, gxv) = jax.value_and_grad(xla, argnums=(0, 1))(u, v)
+    lf, (gfu, gfv) = jax.value_and_grad(flash, argnums=(0, 1))(u, v)
+    np.testing.assert_allclose(float(lf), float(ld), rtol=l_rtol)
+    np.testing.assert_allclose(float(lf), float(lx), rtol=l_rtol)
+    for got, ref in ((gfu, gdu), (gfv, gdv), (gfu, gxu), (gfv, gxv)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=g_rtol, atol=g_atol)
+
+
+@pytest.mark.parametrize("B", [200, 130])
+def test_flash_ce_ragged_last_tile(B):
+    """B not divisible by the tile: the zero-pad path must stay exact
+    vs the dense reference (which needs no padding)."""
+    D, block = 16, 64
+    u, v, u_idx, i_idx, w = _batch(B, D, seed=4, n_pad=9)
+
+    def dense(u_, v_):
+        return _dense_softmax_ce(u_, v_, u_idx, i_idx, w, 0.07,
+                                 jnp.float32)
+
+    flash = make_flash_ce(u_idx, i_idx, w, 0.07, jnp.float32, B,
+                          interpret=True, block=block)
+    ld, (gdu, gdv) = jax.value_and_grad(dense, argnums=(0, 1))(u, v)
+    lf, (gfu, gfv) = jax.value_and_grad(flash, argnums=(0, 1))(u, v)
+    np.testing.assert_allclose(float(lf), float(ld), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gfu), np.asarray(gdu),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gfv), np.asarray(gdv),
+                               rtol=1e-4, atol=1e-6)
+    assert gfu.shape == (B, D) and gfv.shape == (B, D)
+
+
+def test_flash_ce_one_call_form_jits():
+    """The convenience wrapper traces under jit (how the epoch scan
+    uses it) and returns a finite f32 scalar."""
+    B, D = 128, 8
+    u, v, u_idx, i_idx, w = _batch(B, D, seed=2, n_pad=5)
+
+    @jax.jit
+    def f(u_, v_):
+        return pallas_blockwise_ce(u_, v_, u_idx, i_idx, w, 0.07,
+                                   jnp.float32, interpret=True, block=32)
+
+    out = f(u, v)
+    assert out.dtype == jnp.float32 and bool(jnp.isfinite(out))
+
+
+@pytest.mark.parametrize("N,E,B,vocab", [
+    (64, 24, 37, 64),    # ragged tile + non-128 row width
+    (128, 16, 32, 128),  # aligned
+    (50, 8, 24, 6),      # duplicate-heavy: every tile collides
+])
+def test_pallas_adagrad_matches_xla(N, E, B, vocab):
+    """The fused embedding-update equals _rowwise_adagrad — table AND
+    accumulator — including duplicate indices within and across tiles
+    (read-after-full-add scale semantics)."""
+    rng = np.random.default_rng(3)
+    table = jnp.asarray(rng.normal(size=(N, E)).astype(np.float32))
+    acc = jnp.asarray(np.abs(rng.normal(size=N)).astype(np.float32))
+    idx = jnp.asarray(rng.integers(0, vocab, B).astype(np.int32))
+    grad = jnp.asarray(rng.normal(size=(B, E)).astype(np.float32))
+
+    t_ref, a_ref = _rowwise_adagrad(table, acc, idx, grad, 0.03)
+    t_k, a_k = pallas_rowwise_adagrad(table, acc, idx, grad, 0.03,
+                                      interpret=True)
+    np.testing.assert_allclose(np.asarray(a_k), np.asarray(a_ref),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(t_k), np.asarray(t_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pallas_adagrad_in_donated_jit():
+    """The scan-body usage shape: jitted with donated buffers (the
+    aliased in-place table update must compose with XLA donation)."""
+    rng = np.random.default_rng(7)
+    table = jnp.asarray(rng.normal(size=(40, 8)).astype(np.float32))
+    acc = jnp.zeros((40,), jnp.float32)
+    idx = jnp.asarray(rng.integers(0, 40, 16).astype(np.int32))
+    grad = jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32))
+    t_ref, a_ref = _rowwise_adagrad(table, acc, idx, grad, 0.05)
+
+    f = jax.jit(lambda t, a: pallas_rowwise_adagrad(
+        t, a, idx, grad, 0.05, interpret=True), donate_argnums=(0, 1))
+    t_k, a_k = f(table, acc)
+    np.testing.assert_allclose(np.asarray(t_k), np.asarray(t_ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(a_k), np.asarray(a_ref),
+                               rtol=1e-6, atol=1e-7)
+
+
+# -- selection / fallback ----------------------------------------------------
+
+
+def _positives(n=700, n_users=80, n_items=50, seed=1):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, n_users, n), rng.integers(0, n_items, n),
+            n_users, n_items)
+
+
+def test_trainer_kernel_plan_defaults_off_on_cpu():
+    """'auto' must NOT engage on a CPU backend (interpret mode is a
+    test vehicle, not a production path) — existing CPU users keep the
+    XLA forms untouched."""
+    u, i, n_users, n_items = _positives()
+    cfg = TwoTowerConfig(dim=8, epochs=1, batch_size=256, seed=3)
+    tr = TwoTowerTrainer((u, i, None), n_users, n_items, cfg)
+    assert tr.kernel_plan["flash_ce"] is False
+    assert tr.kernel_plan["embed_update"] is False
+    assert tr.kernel_plan["interpret"] is True  # cpu backend implies it
+
+
+def test_trainer_kernel_plan_forced_on_engages_interpret():
+    u, i, n_users, n_items = _positives()
+    cfg = TwoTowerConfig(dim=8, epochs=1, batch_size=256, seed=3,
+                         flash_ce_kernel="on", embed_update_kernel="on")
+    tr = TwoTowerTrainer((u, i, None), n_users, n_items, cfg)
+    assert tr.kernel_plan["flash_ce"] is True
+    assert tr.kernel_plan["embed_update"] is True
+
+
+def test_trainer_kernel_plan_env_overrides_config(monkeypatch):
+    """The bench A/B switch: env beats the config flag."""
+    monkeypatch.setenv("PIO_TT_FLASH_CE", "off")
+    monkeypatch.setenv("PIO_TT_EMBED_UPDATE", "off")
+    u, i, n_users, n_items = _positives()
+    cfg = TwoTowerConfig(dim=8, epochs=1, batch_size=256, seed=3,
+                         flash_ce_kernel="on", embed_update_kernel="on")
+    tr = TwoTowerTrainer((u, i, None), n_users, n_items, cfg)
+    assert tr.kernel_plan["flash_ce"] is False
+    assert tr.kernel_plan["embed_update"] is False
+
+
+def test_trainer_kernel_plan_ineligible_falls_back():
+    """Multi-device mesh and small batches fall back with a reason —
+    never an error (pallas_call does not partition under a mesh)."""
+    from predictionio_tpu.parallel.mesh import create_mesh
+
+    u, i, n_users, n_items = _positives()
+    cfg = TwoTowerConfig(dim=4, epochs=1, batch_size=256, seed=3,
+                         flash_ce_kernel="on", embed_update_kernel="on")
+    tr = TwoTowerTrainer((u, i, None), n_users, n_items, cfg,
+                         mesh=create_mesh({"data": 8}))
+    assert tr.kernel_plan["flash_ce"] is False
+    assert "mesh" in tr.kernel_plan["flash_ce_reason"]
+    assert tr.kernel_plan["embed_update"] is False
+
+    small = TwoTowerTrainer(
+        (u, i, None), n_users, n_items,
+        TwoTowerConfig(dim=4, epochs=1, batch_size=64, seed=3,
+                       flash_ce_kernel="on"))
+    assert small.kernel_plan["flash_ce"] is False
+    assert "batch" in small.kernel_plan["flash_ce_reason"]
+
+
+def test_trainer_kernels_end_to_end_match_xla():
+    """A full trainer run with BOTH kernels engaged (interpret) tracks
+    the XLA-path run epoch-for-epoch in f32 — the integration-level
+    equivalence, scan + donation + adagrad included."""
+    u, i, n_users, n_items = _positives(n=520, seed=5)
+    base = dict(dim=8, epochs=2, batch_size=128, seed=7,
+                learning_rate=1e-2, compute_dtype="float32")
+    ref = TwoTowerTrainer((u, i, None), n_users, n_items,
+                          TwoTowerConfig(**base))
+    ker = TwoTowerTrainer((u, i, None), n_users, n_items,
+                          TwoTowerConfig(**base, flash_ce_kernel="on",
+                                         embed_update_kernel="on"))
+    assert ker.kernel_plan["flash_ce"] and ker.kernel_plan["embed_update"]
+    l_ref = ref.run()
+    l_ker = ker.run()
+    np.testing.assert_allclose(l_ker, l_ref, rtol=1e-4, atol=1e-5)
+    e_ref = ref.embeddings(l_ref)
+    e_ker = ker.embeddings(l_ker)
+    np.testing.assert_allclose(e_ker.item_vecs, e_ref.item_vecs,
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_probe_failure_disables_kernel(monkeypatch):
+    """A smoke-probe crash must mean 'XLA fallback', never a failed
+    train (the Mosaic-regression safety net)."""
+    monkeypatch.setattr(plk, "_probe_cache", {})
+
+    def boom():
+        raise RuntimeError("mosaic said no")
+
+    assert plk.probe("boom_kernel", boom) is False
+    # memoized: the second call doesn't re-run the probe
+    assert plk.probe("boom_kernel", boom) is False
+    assert plk._probe_cache["boom_kernel"] is False
+
+
+def test_flash_ce_weight_grad_raises_not_zero():
+    """The documented nondiff contract: asking for d(loss)/d(weight)
+    through the closed-over factory raises loudly instead of silently
+    returning zeros (weighted-loss tuning hazard, ops/twotower.py
+    _make_blockwise_ce_vjp docstring)."""
+    B, D = 128, 8
+    u, v, u_idx, i_idx, w = _batch(B, D, seed=8, n_pad=0)
+
+    def loss_of_w(w_):
+        fn = make_flash_ce(u_idx, i_idx, w_, 0.07, jnp.float32, B,
+                           interpret=True, block=32)
+        return fn(u, v)
+
+    with pytest.raises(Exception):  # UnexpectedTracerError on jax 0.4.x
+        jax.grad(loss_of_w)(w)
+
+
+def test_pallas_import_failure_degrades_to_xla(monkeypatch):
+    """An import-time break in jax.experimental.pallas (API churn)
+    must leave every two-tower train on the XLA paths with the reason
+    recorded — even with the kernels requested 'on' — not raise."""
+    import predictionio_tpu.ops.twotower as tt
+
+    monkeypatch.setattr(tt, "_pl_flash", None)
+    monkeypatch.setattr(tt, "_pl_embed", None)
+    monkeypatch.setattr(tt, "_PALLAS_IMPORT_ERROR",
+                        "ImportError: no pallas today")
+    u, i, n_users, n_items = _positives()
+    cfg = TwoTowerConfig(dim=8, epochs=1, batch_size=128, seed=3,
+                         flash_ce_kernel="on", embed_update_kernel="on")
+    tr = tt.TwoTowerTrainer((u, i, None), n_users, n_items, cfg)
+    assert tr.kernel_plan["flash_ce"] is False
+    assert "unavailable" in tr.kernel_plan["flash_ce_reason"]
+    assert tr.kernel_plan["embed_update"] is False
+    assert tr.run() and len(tr.run()) == 1   # trains on the XLA path

@@ -778,3 +778,44 @@ def test_rest_insert_json_unsupported_backend_falls_back(memory_storage):
             es.stop()
     finally:
         ss.stop()
+
+
+def test_record_splits_skips_abandoned_requests():
+    """An abandoned submitter (timeout raced the dispatch) must NOT
+    leak its give-up-sized queue wait / skipped-work dispatch time into
+    the (queue_wait, dispatch) splits the bench percentiles read — it
+    is counted separately instead (advisor finding, r6)."""
+    import threading as _th
+
+    from predictionio_tpu.serving.engine_server import MicroBatcher
+
+    release = _th.Event()
+
+    def run_one(payload):
+        release.wait(5.0)  # hold the dispatch until the submitter quits
+        return payload
+
+    def run_batch(payloads):
+        release.wait(5.0)
+        return list(payloads)
+
+    b = MicroBatcher(run_batch, run_one)
+    try:
+        with pytest.raises(TimeoutError):
+            b.submit("q1", timeout=0.05)   # abandons mid-dispatch
+        release.set()
+        deadline = time.time() + 5.0
+        while b.histogram()["abandonedRequests"] < 1:
+            assert time.time() < deadline, "abandoned request never counted"
+            time.sleep(0.01)
+        assert b.recent_splits(10) == []   # nothing skewed the splits
+        # a live request afterwards records exactly one split
+        assert b.submit("q2", timeout=5.0) == "q2"
+        splits = b.recent_splits(10)
+        assert len(splits) == 1
+        wait_sec, dispatch_sec = splits[0]
+        assert 0.0 <= wait_sec < 1.0 and 0.0 <= dispatch_sec < 1.0
+        assert b.histogram()["abandonedRequests"] == 1
+    finally:
+        release.set()
+        b.stop()
