@@ -315,112 +315,28 @@ def _read_prepare_bin_train(detail, n_expected):
 
 
 def _parse_train_profile(profile_dir):
-    """Parse the profiled train step's xplane trace into MEASURED
-    occupancy numbers (VERDICT r3 item 4): per-HLO-category device time,
-    XLA cost-model flops, and bytes split by memory space (space 1 =
-    HBM on TPU xplanes). Runs in its own subprocess (tensorflow's proto
-    stack must not share the bench process). Prints ONE JSON line."""
-    import glob
+    """Parse a profiled run's xplane trace into MEASURED occupancy
+    numbers (VERDICT r3 item 4): per-HLO-category device time, XLA
+    cost-model flops, and bytes split by memory space. The decoding now
+    lives in the framework itself (obs/profiler.py — shared with
+    workflow/train.py's post-train breakdown and `pio profile`
+    artifacts); this stage keeps the subprocess boundary (tensorflow's
+    proto stack must not share the bench process) and prints ONE JSON
+    line."""
+    from predictionio_tpu.obs.profiler import parse_xplane
 
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    print(json.dumps(parse_xplane(profile_dir)))
 
-    def varint(buf, i):
-        out = shift = 0
-        while True:
-            b = buf[i]
-            out |= (b & 0x7F) << shift
-            i += 1
-            if not b & 0x80:
-                return out, i
-            shift += 7
 
-    def hbm_bytes_of(breakdown: bytes) -> int:
-        """Decode OpMetrics.MemoryAccessed entries; sum bytes where
-        memory_space == 1 (HBM)."""
-        total = 0
-        i = 0
-        while i < len(breakdown):
-            tag, i = varint(breakdown, i)
-            if tag >> 3 != 1 or (tag & 7) != 2:  # repeated message field
-                break
-            ln, i = varint(breakdown, i)
-            sub = breakdown[i:i + ln]
-            i += ln
-            j = 0
-            space = by = 0
-            while j < len(sub):
-                t, j = varint(sub, j)
-                v, j = varint(sub, j)
-                f = t >> 3
-                if f == 2:
-                    space = v
-                elif f == 3:
-                    by = v
-            if space == 1:
-                total += by
-        return total
+def _step_device_breakdown(trace, steps):
+    """detail.* per-step device-time breakdown from a parsed trace that
+    covered ``steps`` steps — so future BENCH_r*.json carry where each
+    step's device time went, not just its total. Delegates to the one
+    shared implementation (obs/profiler.per_step), so bench captures
+    and workflow/train.py logs can never disagree on the same trace."""
+    from predictionio_tpu.obs.profiler import per_step
 
-    files = glob.glob(os.path.join(profile_dir, "**", "*.xplane.pb"),
-                      recursive=True)
-    if not files:
-        print(json.dumps({"error": "no xplane trace found"}))
-        return
-    space = xplane_pb2.XSpace()
-    with open(sorted(files)[-1], "rb") as f:
-        space.ParseFromString(f.read())
-    plane = next((p for p in space.planes if "TPU" in p.name), None)
-    if plane is None:
-        print(json.dumps({"error": "no TPU plane in trace"}))
-        return
-    smeta = {k: v.name for k, v in plane.stat_metadata.items()}
-    # per-op (event metadata) cost stats: bytes/flops are XLA's cost
-    # analysis of the compiled HLO — measured occupancy comes from the
-    # recorded durations, bytes/flops from the compiler's own accounting
-    em_stats = {}
-    for k, em in plane.event_metadata.items():
-        st = {}
-        for s in em.stats:
-            name = smeta.get(s.metadata_id)
-            st[name] = (s.bytes_value if s.bytes_value
-                        else (s.int64_value or s.uint64_value
-                              or s.double_value or s.str_value))
-        em_stats[k] = (em.name, st)
-    ops_line = next((l for l in plane.lines if l.name == "XLA Ops"), None)
-    if ops_line is None:
-        print(json.dumps({"error": "no XLA Ops line"}))
-        return
-    by_cat = {}
-    tot_dur_ps = tot_flops = tot_bytes = tot_hbm = 0
-    for ev in ops_line.events:
-        name, st = em_stats.get(ev.metadata_id, ("?", {}))
-        cat = st.get("hlo_category", "?")
-        dur = ev.duration_ps
-        flops = int(st.get("flops") or 0)
-        byts = int(st.get("bytes_accessed") or 0)
-        hbm = hbm_bytes_of(st.get("memory_access_breakdown") or b"")
-        agg = by_cat.setdefault(cat, {"dur_ps": 0, "flops": 0,
-                                      "bytes": 0, "hbm_bytes": 0})
-        agg["dur_ps"] += dur
-        agg["flops"] += flops
-        agg["bytes"] += byts
-        agg["hbm_bytes"] += hbm
-        tot_dur_ps += dur
-        tot_flops += flops
-        tot_bytes += byts
-        tot_hbm += hbm
-    cats = sorted(by_cat.items(), key=lambda kv: -kv[1]["dur_ps"])
-    out = {
-        "device_time_sec": round(tot_dur_ps / 1e12, 4),
-        "flops_total": tot_flops,
-        "bytes_total": tot_bytes,
-        "hbm_bytes_total": tot_hbm,
-        "by_category": {
-            k: {"time_frac": round(v["dur_ps"] / max(tot_dur_ps, 1), 3),
-                "hbm_bytes": v["hbm_bytes"], "flops": v["flops"]}
-            for k, v in cats[:8]
-        },
-    }
-    print(json.dumps(out))
+    return per_step(trace, steps)
 
 
 def _roofline(trainer, train_sec, iterations):
@@ -959,6 +875,10 @@ def stage_cold(base_dir, out_path):
         measured["hbm_fraction_traced"] = round(
             trace["hbm_bytes_total"] / trace["device_time_sec"]
             / V5E_PEAK_HBM_BYTES, 3)
+    # the profiled region was exactly ONE alternation
+    breakdown = _step_device_breakdown(trace, 1)
+    if breakdown is not None:
+        measured["step_device_breakdown"] = breakdown
     detail["roofline"]["measured"] = measured
     # release the trainer's HBM before the serving deployment compiles
     del trainer
@@ -1082,6 +1002,11 @@ def stage_twotower(base_dir, out_path):
         trace = {"error": str(e)}
     detail["profiled_epoch_sec"] = round(profiled_epoch_sec, 2)
     detail["trace"] = trace
+    # per-step device-time breakdown (the traced epoch ran `steps`
+    # steps): lands in detail.twotower.step_device_breakdown
+    breakdown = _step_device_breakdown(trace, steps)
+    if breakdown is not None:
+        detail["step_device_breakdown"] = breakdown
     matmul_flops = trainer.matmul_flops_per_step() * steps
     detail["matmul_flops_per_step"] = trainer.matmul_flops_per_step()
     device_sec = trace.get("device_time_sec") or steady

@@ -1,22 +1,33 @@
-"""obs: the unified telemetry subsystem.
+"""obs: the unified telemetry + diagnostics subsystem.
 
-Three pillars, one registry:
+Aggregate pillars (PR 2) and the per-request diagnostics layer (this
+PR), one registry:
 
   obs.metrics  — thread-safe Counter/Gauge/Histogram with labels in a
                  process-global Registry, Prometheus text exposition
                  (served at ``GET /metrics`` by every HTTP server via
-                 serving/http.py, dumped by ``pio metrics``)
+                 serving/http.py, dumped by ``pio metrics [--json]``)
   obs.trace    — trace ids + spans with ``X-PIO-Trace-Id`` propagation
                  (engine server -> rest storage client -> storage
-                 server), structured JSON-line span records
+                 server), structured JSON-line span records (rotated),
+                 span sinks
   obs.jaxmon   — JAX runtime bridge: compile-cache hit/miss, compile
                  wall time, transfer bytes, train-step timing, device
                  memory gauges
+  obs.flight   — the black-box flight recorder: ring of completed
+                 request records (stage timings + span trees), metric
+                 snapshots, slow-request log, automatic error dumps;
+                 served by ``GET /admin/flight`` on every server
+  obs.profiler — on-demand JAX profiler capture windows
+                 (``POST /admin/profile``) + xplane device-time parsing
+  obs.logging  — structured JSON log lines carrying the active trace id
 
-Import cost is stdlib-only; jax is touched lazily inside jaxmon.
+Import cost is stdlib-only; jax is touched lazily inside jaxmon and
+profiler.
 """
 
-from predictionio_tpu.obs import jaxmon, metrics, trace
+from predictionio_tpu.obs import flight, jaxmon, metrics, profiler, trace
+from predictionio_tpu.obs import logging as obs_logging
 from predictionio_tpu.obs.metrics import (
     CONTENT_TYPE,
     REGISTRY,
@@ -31,10 +42,13 @@ __all__ = [
     "REGISTRY",
     "TRACE_HEADER",
     "counter",
+    "flight",
     "gauge",
     "histogram",
     "jaxmon",
     "metrics",
+    "obs_logging",
+    "profiler",
     "span",
     "trace",
 ]

@@ -1,0 +1,330 @@
+"""Flight recorder: the black box for the serving/training path.
+
+Aggregate telemetry (obs/metrics.py) answers "how is the fleet doing";
+it cannot answer "what exactly happened to THAT request". This module
+keeps the evidence an operator needs for the post-hoc question without
+reproducing anything:
+
+  - a bounded ring buffer of COMPLETED request records — server, method,
+    route, status, trace id, total duration, per-stage timings (parse /
+    queue / batch / dispatch / device / serialize, plus the
+    unattributed remainder so stages always sum to the total) and the
+    request's own span tree (collected via a trace-sink, O(1) per span,
+    never a ring scan on the hot path)
+  - periodic metric snapshots (a compact registry summary every
+    ``SNAPSHOT_INTERVAL_SEC``), so a dump carries the aggregate context
+    the individual records sat in
+  - a slow-request log: any request slower than ``PIO_SLOW_MS`` is
+    flagged in its record AND emitted through the ``pio.slow`` logger
+    with the full stage breakdown (JSON-parseable under
+    obs/logging.py's formatter)
+  - error capture: a handler that raises or answers >= 500 produces a
+    record carrying the error, and — when ``PIO_FLIGHT_DIR`` is set —
+    an automatic JSON dump file, no operator action required
+
+The whole dump is served as JSON by ``GET /admin/flight`` on every PIO
+server (serving/http.py routes it, like ``/metrics``) and by
+``pio flight --url ...``.
+
+Config (all env):
+  PIO_FLIGHT_CAPACITY   ring size (default 256 records)
+  PIO_SLOW_MS           slow-request threshold in ms (default 1000;
+                        0 flags everything — useful in tests)
+  PIO_FLIGHT_DIR        directory for automatic error dumps (unset =
+                        ring-only, no files)
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from predictionio_tpu.obs import metrics, trace
+
+log = logging.getLogger(__name__)
+
+#: the slow-request log: one record per over-threshold request, carrying
+#: the stage breakdown; under obs/logging.py JSON output each line is a
+#: parseable object with the request's trace id
+slow_log = logging.getLogger("pio.slow")
+
+DEFAULT_CAPACITY = 256
+DEFAULT_SLOW_MS = 1000.0
+SNAPSHOT_INTERVAL_SEC = 60.0
+#: snapshots kept alongside the record ring
+SNAPSHOT_CAPACITY = 32
+#: per-request span cap: a runaway span loop must not balloon one record
+MAX_SPANS_PER_RECORD = 128
+
+_RECORDS_TOTAL = metrics.counter(
+    "pio_flight_records_total",
+    "Requests recorded by the flight recorder, by outcome "
+    "(ok / slow / error)",
+    ("outcome",),
+)
+
+
+def slow_threshold_ms() -> float:
+    """The PIO_SLOW_MS threshold (read per request: env changes and
+    test monkeypatching take effect immediately)."""
+    raw = os.environ.get("PIO_SLOW_MS")
+    if raw is None:
+        return DEFAULT_SLOW_MS
+    try:
+        return float(raw)
+    except ValueError:
+        return DEFAULT_SLOW_MS
+
+
+def _metrics_snapshot() -> Dict[str, Any]:
+    """A compact registry summary: per family, the summed child values
+    (counter/gauge) or total (count, sum) (histogram) — enough to see
+    rates and load around a record without the full exposition."""
+    out: Dict[str, Any] = {}
+    for family in metrics.REGISTRY.collect():
+        with family._lock:
+            children = list(family._children.values())
+        if not children:
+            continue
+        if family.kind == "histogram":
+            count = total = 0
+            for c in children:
+                count += c._count
+                total += c._sum
+            out[family.name] = {"count": count, "sum": round(total, 6)}
+        else:
+            out[family.name] = round(sum(c._value for c in children), 6)
+    return out
+
+
+class FlightRecorder:
+    """Bounded ring of completed request records + metric snapshots.
+
+    ``begin`` opens a record for an in-flight request (keyed by a unique
+    integer, NOT the trace id — nested servers in one process can serve
+    the same propagated trace concurrently); stage timings and fields
+    attach by trace id to the OLDEST open record with that id (the edge
+    request that owns the latency budget); ``finish`` seals the record
+    into the ring."""
+
+    def __init__(self, capacity: Optional[int] = None,
+                 snapshot_interval: float = SNAPSHOT_INTERVAL_SEC):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get("PIO_FLIGHT_CAPACITY",
+                                              DEFAULT_CAPACITY))
+            except ValueError:
+                capacity = DEFAULT_CAPACITY
+        self.capacity = max(1, capacity)
+        self._lock = threading.Lock()
+        self._ring: "collections.deque[Dict[str, Any]]" = collections.deque(
+            maxlen=self.capacity)
+        self._snapshots: "collections.deque[Dict[str, Any]]" = (
+            collections.deque(maxlen=SNAPSHOT_CAPACITY))
+        self._snapshot_interval = snapshot_interval
+        self._last_snapshot = 0.0
+        self._keys = itertools.count(1)
+        # open records, insertion-ordered (dict preserves order): the
+        # oldest open record for a trace id is the edge request
+        self._open: Dict[int, Dict[str, Any]] = {}
+
+    # -- request lifecycle --------------------------------------------------
+    def begin(self, trace_id: str, server: str, method: str,
+              route: str) -> int:
+        record = {
+            "trace": trace_id,
+            "server": server,
+            "method": method,
+            "route": route,
+            "start_unix": round(time.time(), 6),
+            "stages": {},
+            "spans": [],
+            "_t0": time.perf_counter(),
+        }
+        with self._lock:
+            key = next(self._keys)
+            self._open[key] = record
+        return key
+
+    def _find_open(self, trace_id: Optional[str]) -> Optional[Dict[str, Any]]:
+        if trace_id is None:
+            ctx = trace.current_context()
+            trace_id = ctx.trace_id if ctx else None
+        if trace_id is None:
+            return None
+        for record in self._open.values():  # oldest first
+            if record["trace"] == trace_id:
+                return record
+        return None
+
+    def note_stage(self, stage: str, seconds: float,
+                   trace_id: Optional[str] = None) -> None:
+        """Attribute ``seconds`` of the request to ``stage`` (additive:
+        repeated notes accumulate). No open record -> silent no-op, so
+        instrumented paths need no "is the recorder watching" guards."""
+        with self._lock:
+            record = self._find_open(trace_id)
+            if record is None:
+                return
+            stages = record["stages"]
+            stages[stage] = round(stages.get(stage, 0.0) + seconds * 1e3, 3)
+
+    def note_field(self, name: str, value: Any,
+                   trace_id: Optional[str] = None) -> None:
+        """Attach one JSON-serializable field to the open record."""
+        with self._lock:
+            record = self._find_open(trace_id)
+            if record is not None and not name.startswith("_"):
+                record[name] = value
+
+    def on_span(self, span_record: Dict[str, Any]) -> None:
+        """trace-sink: route an emitted span into the open record that
+        owns its trace (bounded per record)."""
+        with self._lock:
+            record = self._find_open(span_record.get("trace"))
+            if record is not None and len(record["spans"]) < (
+                    MAX_SPANS_PER_RECORD):
+                record["spans"].append(span_record)
+
+    def finish(self, key: int, status: Optional[int],
+               error: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        """Seal an open record: compute the total + unattributed stage,
+        flag slow/error outcomes, snapshot metrics on the interval, and
+        append to the ring. Returns the sealed record."""
+        with self._lock:
+            record = self._open.pop(key, None)
+        if record is None:
+            return None
+        total_ms = (time.perf_counter() - record.pop("_t0")) * 1e3
+        record["duration_ms"] = round(total_ms, 3)
+        record["status"] = status
+        stages = record["stages"]
+        attributed = sum(stages.values())
+        # the remainder (header parse, thread scheduling, GIL waits)
+        # keeps sum(stages) == duration_ms by construction, so a stage
+        # breakdown can always be read as a complete account
+        stages["unattributed"] = round(max(0.0, total_ms - attributed), 3)
+        # precedence: an exception that escaped the handler, then an
+        # error the handler noted itself (the engine server's answered
+        # 500 path), then the bare status
+        error = error or record.get("error")
+        if error is None and status is not None and status >= 500:
+            error = f"handler answered {status}"
+        if error is not None:
+            record["error"] = error
+        slow = total_ms >= slow_threshold_ms()
+        if slow:
+            record["slow"] = True
+        outcome = "error" if error is not None else (
+            "slow" if slow else "ok")
+        _RECORDS_TOTAL.labels(outcome).inc()
+        now = time.time()
+        snap = None
+        with self._lock:
+            if now - self._last_snapshot >= self._snapshot_interval:
+                self._last_snapshot = now
+                snap = {"ts": round(now, 3)}
+            self._ring.append(record)
+        if snap is not None:
+            # registry walk outside the ring lock (it takes family locks)
+            snap["metrics"] = _metrics_snapshot()
+            with self._lock:
+                self._snapshots.append(snap)
+        if slow:
+            slow_log.warning(
+                "slow request: %s %s %.1f ms (threshold %.1f ms)",
+                record["method"], record["route"], total_ms,
+                slow_threshold_ms(),
+                extra={"pio": {k: v for k, v in record.items()
+                               if k != "spans"}},
+            )
+        if error is not None:
+            self._dump_on_error(record)
+        return record
+
+    # -- reading ------------------------------------------------------------
+    def records(self, n: Optional[int] = None,
+                slow_only: bool = False) -> List[Dict[str, Any]]:
+        """The last ``n`` sealed records (all when None), oldest
+        first. ``n <= 0`` is an explicit "none" — Python's ``[-0:]``
+        would silently mean "all"."""
+        with self._lock:
+            out = list(self._ring)
+        if slow_only:
+            out = [r for r in out if r.get("slow") or r.get("error")]
+        if n is None:
+            return out
+        return out[-n:] if n > 0 else []
+
+    def snapshots(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._snapshots)
+
+    def dump(self, n: Optional[int] = None,
+             slow_only: bool = False) -> Dict[str, Any]:
+        """The full flight dump (what ``GET /admin/flight`` serves)."""
+        return {
+            "capacity": self.capacity,
+            "slow_threshold_ms": slow_threshold_ms(),
+            "records": self.records(n, slow_only=slow_only),
+            "metric_snapshots": self.snapshots(),
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._snapshots.clear()
+            self._open.clear()
+
+    # -- error dumps --------------------------------------------------------
+    def _dump_on_error(self, record: Dict[str, Any]) -> None:
+        """Automatic dump on a handler error: the record is already in
+        the ring (visible at /admin/flight with no operator action);
+        with PIO_FLIGHT_DIR set, the whole dump also lands as a JSON
+        file — the post-mortem survives the process."""
+        out_dir = os.environ.get("PIO_FLIGHT_DIR")
+        if not out_dir:
+            return
+        name = "flight-{}-{}.json".format(
+            record.get("trace", "noid")[:16], int(time.time() * 1e3))
+        path = os.path.join(out_dir, name)
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(self.dump(), f, sort_keys=True)
+            log.warning("handler error on %s %s — flight dump written "
+                        "to %s", record["method"], record["route"], path)
+        except OSError as e:
+            log.warning("flight dump to %s failed: %s", path, e)
+
+
+#: the process-global recorder every server records into
+RECORDER = FlightRecorder()
+
+# spans route into open request records as they are emitted
+trace.add_sink(RECORDER.on_span)
+
+
+def begin(trace_id: str, server: str, method: str, route: str) -> int:
+    return RECORDER.begin(trace_id, server, method, route)
+
+
+def finish(key: int, status: Optional[int],
+           error: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    return RECORDER.finish(key, status, error)
+
+
+def note_stage(stage: str, seconds: float,
+               trace_id: Optional[str] = None) -> None:
+    RECORDER.note_stage(stage, seconds, trace_id)
+
+
+def note_field(name: str, value: Any,
+               trace_id: Optional[str] = None) -> None:
+    RECORDER.note_field(name, value, trace_id)

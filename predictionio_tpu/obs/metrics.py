@@ -369,6 +369,26 @@ REGISTRY = Registry()
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
+def samples_dict(text: str) -> Dict[str, float]:
+    """Parse a Prometheus text-format document into a flat
+    ``{"name{labels}": value}`` mapping — the machine-readable shape
+    ``pio metrics --json`` emits, identical whether the document came
+    from the in-process registry or a server's ``GET /metrics``."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name_part, _, value = line.rpartition(" ")
+        if not name_part:
+            continue
+        try:
+            out[name_part] = float(value)
+        except ValueError:
+            continue  # tolerate foreign exposition extensions
+    return out
+
+
 def counter(name: str, help: str, labelnames: Sequence[str] = ()) -> Counter:
     return REGISTRY.counter(name, help, labelnames)
 

@@ -1,0 +1,323 @@
+"""On-demand JAX/XLA profiling: capture windows + device-time breakdown.
+
+The Spark-era literature found its wins by profiling the actual
+runtime (arxiv 1612.01437); the TPU rebuild's equivalent is the JAX
+profiler's xplane trace. This module makes it first-party:
+
+  - ``capture(seconds)`` records a profiling window of the LIVE process
+    (serving or training) and returns the artifact directory — wired to
+    ``POST /admin/profile?seconds=N`` on every PIO server
+    (serving/http.py) and ``pio profile``. On a CPU backend there is no
+    device timeline worth the overhead: ``available()`` is False and
+    the endpoint answers a clean 501 (``PIO_PROFILE_FORCE=1`` overrides
+    for tests).
+  - ``parse_xplane(dir)`` decodes the trace into per-HLO-category
+    device time / XLA-cost-model flops / HBM bytes — shared by
+    bench.py's roofline stages and workflow/train.py's post-train
+    breakdown. The tensorflow proto stack it imports must not share a
+    serving or bench process: call it via ``python -m
+    predictionio_tpu.obs.profiler <dir>`` in a subprocess (this
+    module's ``__main__`` prints the result as one JSON line).
+
+Artifacts land under ``PIO_PROFILE_DIR`` (default: a fresh temp dir per
+capture) and open with TensorBoard or xprof.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Dict, Optional
+
+log = logging.getLogger(__name__)
+
+
+class ProfilerUnavailable(RuntimeError):
+    """No profilable device backend (or jax missing entirely)."""
+
+
+class ProfilerBusy(RuntimeError):
+    """A capture window is already open (jax allows one at a time)."""
+
+
+_capture_lock = threading.Lock()
+
+
+def backend() -> str:
+    """The active jax backend name, or 'none' when jax is unavailable."""
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception as e:  # noqa: BLE001 — probing must not raise
+        log.debug("jax backend probe failed: %s", e)
+        return "none"
+
+
+def available() -> bool:
+    """Whether a capture would record a device timeline worth having.
+    CPU tier-1 runs answer False (the endpoint no-ops with 501);
+    ``PIO_PROFILE_FORCE=1`` forces True so tests can drive the full
+    capture path on CPU."""
+    if os.environ.get("PIO_PROFILE_FORCE") == "1":
+        return True
+    return backend() not in ("cpu", "none")
+
+
+def clamp_seconds(seconds: float) -> float:
+    """The EFFECTIVE capture window for a requested length (bounds a
+    typo'd N at 5 minutes). Callers that report the window to an
+    operator must echo this value, not the request."""
+    seconds = float(seconds)
+    if not seconds >= 0.0:  # negatives AND NaN ("nan" parses as float)
+        return 0.0
+    return min(seconds, 300.0)
+
+
+def capture(seconds: float, out_dir: Optional[str] = None) -> str:
+    """Record a profiling window of this process; returns the artifact
+    directory. Raises ProfilerUnavailable on CPU/no-jax and
+    ProfilerBusy when a window is already open — including one this
+    module did not start (a ``PIO_PROFILE_DIR`` train capture holds no
+    lock here, but jax refuses the second start_trace)."""
+    if not available():
+        raise ProfilerUnavailable(
+            f"jax profiler needs a device backend (active: {backend()}); "
+            "no-op on CPU")
+    seconds = clamp_seconds(seconds)
+    if not _capture_lock.acquire(blocking=False):
+        raise ProfilerBusy("a profiler capture is already running")
+    try:
+        import jax
+
+        path = (out_dir or os.environ.get("PIO_PROFILE_DIR")
+                or tempfile.mkdtemp(prefix="pio_profile_"))
+        os.makedirs(path, exist_ok=True)
+        try:
+            jax.profiler.start_trace(path)
+        except Exception as e:  # noqa: BLE001 — map to the busy answer
+            raise ProfilerBusy(
+                f"profiler could not start (a capture started elsewhere "
+                f"— e.g. a PIO_PROFILE_DIR train — may be in progress): "
+                f"{e}") from e
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+        log.info("profiler capture of %.1fs written to %s", seconds, path)
+        return path
+    finally:
+        _capture_lock.release()
+
+
+def trace_capture(out_dir: str):
+    """``with trace_capture(dir):`` — the block runs under the JAX
+    profiler; start/stop failures are logged, never raised (profiling
+    must not change whether training runs). Returns a context manager
+    whose ``__exit__`` reports whether the capture actually recorded."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        started = False
+        try:
+            import jax
+
+            jax.profiler.start_trace(out_dir)
+            started = True
+            log.info("profiling to %s", out_dir)
+        except Exception:  # noqa: BLE001 — observability is optional
+            log.exception("profiler failed to start; continuing without")
+        try:
+            yield started
+        finally:
+            if started:
+                try:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                except Exception:  # noqa: BLE001
+                    log.exception("profiler failed to stop")
+
+    return _cm()
+
+
+# -- xplane decoding ----------------------------------------------------------
+
+def _varint(buf: bytes, i: int):
+    out = shift = 0
+    while True:
+        b = buf[i]
+        out |= (b & 0x7F) << shift
+        i += 1
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def _hbm_bytes_of(breakdown: bytes) -> int:
+    """Decode OpMetrics.MemoryAccessed entries; sum bytes where
+    memory_space == 1 (HBM on TPU xplanes)."""
+    total = 0
+    i = 0
+    while i < len(breakdown):
+        tag, i = _varint(breakdown, i)
+        if tag >> 3 != 1 or (tag & 7) != 2:  # repeated message field
+            break
+        ln, i = _varint(breakdown, i)
+        sub = breakdown[i:i + ln]
+        i += ln
+        j = 0
+        space = by = 0
+        while j < len(sub):
+            t, j = _varint(sub, j)
+            v, j = _varint(sub, j)
+            f = t >> 3
+            if f == 2:
+                space = v
+            elif f == 3:
+                by = v
+        if space == 1:
+            total += by
+    return total
+
+
+def parse_xplane(profile_dir: str) -> Dict[str, Any]:
+    """Parse the newest ``*.xplane.pb`` under ``profile_dir`` into
+    MEASURED occupancy numbers: total + per-HLO-category device time,
+    XLA cost-model flops, and bytes split by memory space. Returns
+    ``{"error": ...}`` instead of raising — a failed parse must never
+    fail the run that captured the trace. Import note at module top:
+    run this in a subprocess."""
+    try:
+        import glob
+
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except Exception as e:  # noqa: BLE001 — parser deps are optional
+        return {"error": f"xplane parser unavailable: {e}"}
+    files = glob.glob(os.path.join(profile_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not files:
+        return {"error": "no xplane trace found"}
+    space = xplane_pb2.XSpace()
+    try:
+        with open(sorted(files)[-1], "rb") as f:
+            space.ParseFromString(f.read())
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"xplane decode failed: {e}"}
+    plane = next((p for p in space.planes if "TPU" in p.name), None)
+    if plane is None:
+        return {"error": "no TPU plane in trace"}
+    smeta = {k: v.name for k, v in plane.stat_metadata.items()}
+    # per-op (event metadata) cost stats: bytes/flops are XLA's cost
+    # analysis of the compiled HLO — measured occupancy comes from the
+    # recorded durations, bytes/flops from the compiler's own accounting
+    em_stats = {}
+    for k, em in plane.event_metadata.items():
+        st = {}
+        for s in em.stats:
+            name = smeta.get(s.metadata_id)
+            st[name] = (s.bytes_value if s.bytes_value
+                        else (s.int64_value or s.uint64_value
+                              or s.double_value or s.str_value))
+        em_stats[k] = (em.name, st)
+    ops_line = next((l for l in plane.lines if l.name == "XLA Ops"), None)
+    if ops_line is None:
+        return {"error": "no XLA Ops line"}
+    by_cat: Dict[str, Dict[str, int]] = {}
+    tot_dur_ps = tot_flops = tot_bytes = tot_hbm = 0
+    for ev in ops_line.events:
+        name, st = em_stats.get(ev.metadata_id, ("?", {}))
+        cat = st.get("hlo_category", "?")
+        dur = ev.duration_ps
+        flops = int(st.get("flops") or 0)
+        byts = int(st.get("bytes_accessed") or 0)
+        hbm = _hbm_bytes_of(st.get("memory_access_breakdown") or b"")
+        agg = by_cat.setdefault(cat, {"dur_ps": 0, "flops": 0,
+                                      "bytes": 0, "hbm_bytes": 0})
+        agg["dur_ps"] += dur
+        agg["flops"] += flops
+        agg["bytes"] += byts
+        agg["hbm_bytes"] += hbm
+        tot_dur_ps += dur
+        tot_flops += flops
+        tot_bytes += byts
+        tot_hbm += hbm
+    cats = sorted(by_cat.items(), key=lambda kv: -kv[1]["dur_ps"])
+    return {
+        "device_time_sec": round(tot_dur_ps / 1e12, 4),
+        "flops_total": tot_flops,
+        "bytes_total": tot_bytes,
+        "hbm_bytes_total": tot_hbm,
+        "by_category": {
+            k: {"time_frac": round(v["dur_ps"] / max(tot_dur_ps, 1), 3),
+                "hbm_bytes": v["hbm_bytes"], "flops": v["flops"]}
+            for k, v in cats[:8]
+        },
+    }
+
+
+def per_step(parsed: Dict[str, Any], steps: int) -> Optional[Dict[str, Any]]:
+    """Per-STEP device-time breakdown from an already-parsed trace that
+    covered ``steps`` train steps: device ms/step overall and per HLO
+    category — the number a step-time regression investigation starts
+    from. The ONE implementation of this division: workflow/train.py's
+    post-train log and bench.py's detail.* both call it, so they can
+    never disagree on the same trace. None when the trace carries no
+    device time or ``steps`` is unknown (<= 0) — a whole-train total
+    must never masquerade as a per-step number."""
+    if not parsed.get("device_time_sec") or steps <= 0:
+        return None
+    dev = parsed["device_time_sec"]
+    return {
+        "steps": int(steps),
+        "device_ms_per_step": round(dev / steps * 1e3, 4),
+        "by_category_ms_per_step": {
+            cat: round(v["time_frac"] * dev / steps * 1e3, 4)
+            for cat, v in (parsed.get("by_category") or {}).items()
+        },
+    }
+
+
+def step_breakdown(profile_dir: str, steps: int) -> Dict[str, Any]:
+    """parse_xplane + per_step over a trace directory; the full parsed
+    trace rides along under ``trace``. Same subprocess caveat as
+    parse_xplane."""
+    parsed = parse_xplane(profile_dir)
+    if "error" in parsed:
+        return parsed
+    out = per_step(parsed, steps)
+    if out is None:
+        return {"error": f"no per-step breakdown (steps={steps}, "
+                         f"device_time_sec="
+                         f"{parsed.get('device_time_sec')})",
+                "trace": parsed}
+    out["trace"] = parsed
+    return out
+
+
+def main(argv=None) -> int:
+    """``python -m predictionio_tpu.obs.profiler <dir> [--steps N]``:
+    parse a trace in a clean process, print ONE JSON line."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="parse a JAX xplane profile into device-time numbers")
+    parser.add_argument("profile_dir")
+    parser.add_argument("--steps", type=int, default=0,
+                        help="train steps the trace covered (adds the "
+                             "per-step breakdown)")
+    args = parser.parse_args(argv)
+    if args.steps > 0:
+        print(json.dumps(step_breakdown(args.profile_dir, args.steps)))
+    else:
+        print(json.dumps(parse_xplane(args.profile_dir)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -12,8 +12,10 @@ This module is a deliberately small tracer:
   - ``span("storage.find")`` wraps a unit of work; on exit a structured
     record {trace, span, parent, name, start_unix, duration_ms, ...}
     is appended to an in-process ring buffer, optionally mirrored as a
-    JSON line to the file named by ``PIO_TRACE_LOG``, and counted in
-    the ``pio_trace_spans_total{name=...}`` metric
+    JSON line to the file named by ``PIO_TRACE_LOG`` (size-rotated:
+    current + one ``.1`` roll, threshold ``PIO_TRACE_LOG_MAX_BYTES``,
+    rolls counted in ``pio_trace_log_rotations_total``), and counted
+    in the ``pio_trace_spans_total{name=...}`` metric
   - context travels in a contextvar; spans nest (parent ids) within a
     thread, and ``current_context()``/``activate_context()`` hand the
     trace across explicit thread hops (the serving micro-batcher)
@@ -57,10 +59,21 @@ def valid_trace_id(value: str) -> bool:
 #: ring buffer size: enough for a test run or a quick operator look-back
 RECENT_LIMIT = 4096
 
+#: PIO_TRACE_LOG rotation threshold: when the current file outgrows
+#: this many bytes it is rolled to ``<path>.1`` (replacing any previous
+#: roll) — current + one rolled file bound the disk footprint at ~2x
+_LOG_MAX_BYTES_DEFAULT = 64 * 1024 * 1024
+
 _SPANS_TOTAL = metrics.counter(
     "pio_trace_spans_total",
     "Spans recorded, by span name",
     ("name",),
+)
+
+_LOG_ROTATIONS_TOTAL = metrics.counter(
+    "pio_trace_log_rotations_total",
+    "PIO_TRACE_LOG size-based rotations (each drops the previously "
+    "rolled file's spans)",
 )
 
 
@@ -98,12 +111,27 @@ def _write_log_line(line: str) -> None:
         # warning + failed syscall per span would flood a serving host
         return
     try:
+        max_bytes = int(os.environ.get("PIO_TRACE_LOG_MAX_BYTES",
+                                       _LOG_MAX_BYTES_DEFAULT))
+    except ValueError:
+        max_bytes = _LOG_MAX_BYTES_DEFAULT
+    try:
         with _log_lock:
             if path != _log_path:
                 if _log_file is not None:
                     _log_file.close()
                 _log_file = open(path, "a", encoding="utf-8")
                 _log_path = path
+            elif max_bytes > 0 and _log_file.tell() >= max_bytes:
+                # size-based rotation: keep current + ONE rolled file —
+                # an unbounded span log on a serving host eventually
+                # fills the disk (the pre-rotation failure mode). tell()
+                # is the write offset of our own append handle, so no
+                # stat() syscall rides the span hot path.
+                _log_file.close()
+                os.replace(path, path + ".1")
+                _log_file = open(path, "a", encoding="utf-8")
+                _LOG_ROTATIONS_TOTAL.inc()
             _log_file.write(line + "\n")
             _log_file.flush()
     except OSError as e:
@@ -142,10 +170,38 @@ def deactivate(token) -> None:
     _ctx.reset(token)
 
 
+#: extra per-span consumers (the flight recorder routes spans into the
+#: request record they belong to). A sink must be fast and non-raising;
+#: a raising sink is dropped with a warning rather than poisoning the
+#: span exit path of every handler thread.
+_sinks: List[Any] = []
+
+
+def add_sink(fn) -> None:
+    """Register ``fn(record: dict)`` to be called for every emitted
+    span record (idempotent per function object)."""
+    with _emit_lock:
+        if fn not in _sinks:
+            _sinks.append(fn)
+
+
+def remove_sink(fn) -> None:
+    with _emit_lock:
+        if fn in _sinks:
+            _sinks.remove(fn)
+
+
 def _emit(record: Dict[str, Any]) -> None:
     _SPANS_TOTAL.labels(record["name"]).inc()
     with _emit_lock:
         _recent.append(record)
+        sinks = list(_sinks)
+    for fn in sinks:
+        try:
+            fn(record)
+        except Exception:  # noqa: BLE001 — a sink must never break spans
+            log.exception("span sink %r failed; removing it", fn)
+            remove_sink(fn)
     if os.environ.get("PIO_TRACE_LOG"):
         _write_log_line(json.dumps(record, sort_keys=True))
 

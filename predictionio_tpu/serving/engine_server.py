@@ -39,7 +39,7 @@ from urllib.parse import urlparse
 
 from predictionio_tpu.core.engine import Engine
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.obs import metrics, trace
+from predictionio_tpu.obs import flight, metrics, trace
 from predictionio_tpu.parallel.mesh import MeshContext
 from predictionio_tpu.serving.http import HTTPServerBase, JSONRequestHandler
 from predictionio_tpu.workflow.deploy import Deployment, prepare_deploy
@@ -134,8 +134,9 @@ class _Pending:
         self.t_submit = time.perf_counter()
         # the submitting handler thread's trace context: contextvars do
         # not cross the hop to the batcher worker, so it rides along and
-        # is re-activated around a lone dispatch (a >1 batch spans many
-        # traces at once and runs untraced — documented limitation)
+        # is re-activated around a lone dispatch; a >1 batch dispatches
+        # under its own ``serve.batch`` span carrying every member's
+        # trace id (the ROADMAP obs follow-up)
         self.trace_ctx = trace.current_context()
 
 
@@ -281,8 +282,23 @@ class MicroBatcher:
             self._record_splits(batch, t_start)
             p.event.set()
             return
+        # the multi-query dispatch gets its OWN span: one record, under
+        # a batch-minted trace id, carrying every member's trace id —
+        # so a member's span chain joins its batchmates' (previously a
+        # >1 batch ran untraced), and each member's flight record
+        # learns the dispatch size it shared
+        members = [p.trace_ctx.trace_id for p in batch
+                   if p.trace_ctx is not None]
+        for tid in members:
+            flight.note_field("batch_size", len(batch), trace_id=tid)
         try:
-            results = self._run_batch([p.payload for p in batch])
+            batch_token = trace.activate(trace.new_trace_id())
+            try:
+                with trace.span("serve.batch", batch_size=len(batch),
+                                members=members):
+                    results = self._run_batch([p.payload for p in batch])
+            finally:
+                trace.deactivate(batch_token)
             for p, r in zip(batch, results):
                 p.result = r
         except BaseException as e:
@@ -292,10 +308,17 @@ class MicroBatcher:
                         "re-running individually to isolate the poison "
                         "query", len(batch), type(e).__name__, e)
             for p in batch:
+                token = (trace.activate_context(p.trace_ctx)
+                         if p.trace_ctx is not None else None)
                 try:
-                    p.result = self._run_one(p.payload)
+                    with trace.span("serve.dispatch", batch_size=1,
+                                    fallback=True):
+                        p.result = self._run_one(p.payload)
                 except BaseException as e:  # noqa: BLE001
                     p.error = e
+                finally:
+                    if token is not None:
+                        trace.deactivate(token)
         self._record_splits(batch, t_start)
         for p in batch:
             p.event.set()
@@ -312,6 +335,14 @@ class MicroBatcher:
                     self._abandoned += 1
                     continue
                 self._splits.append((t_start - p.t_submit, t_done - t_start))
+        # the same split, attributed to each request's flight record
+        # (outside the histogram lock: flight takes its own)
+        for p in batch:
+            if p.abandoned or p.trace_ctx is None:
+                continue
+            tid = p.trace_ctx.trace_id
+            flight.note_stage("queue", t_start - p.t_submit, trace_id=tid)
+            flight.note_stage("dispatch", t_done - t_start, trace_id=tid)
 
     def recent_splits(self, n: int):
         """Last ``n`` answered requests' (queue_wait_sec, dispatch_sec)
@@ -422,7 +453,9 @@ class EngineServer(HTTPServerBase):
             if self._batcher is not None:
                 result = self._batcher.submit(payload)
             else:
+                t_disp = time.perf_counter()
                 result = self._query_now(payload)
+                flight.note_stage("dispatch", time.perf_counter() - t_disp)
         elapsed = time.perf_counter() - t0
         self.stats.record(elapsed)
         if self.feedback_url and self.feedback_access_key:
@@ -595,6 +628,10 @@ class _EngineRequestHandler(JSONRequestHandler):
                 return
             except Exception as e:
                 log.exception("query failed")
+                # the answered-500 path never raises through the
+                # instrumented wrapper, so name the error here — the
+                # flight record must carry WHAT failed, not just "500"
+                flight.note_field("error", f"{type(e).__name__}: {e}")
                 self.server_ref.remote_log(
                     f"query failed: {type(e).__name__}: {e}"
                 )

@@ -37,6 +37,8 @@ from urllib.parse import parse_qs, urlparse
 from predictionio_tpu.data.backends.eventlog import _ROW_ERRORS, JsonRowsUnsupported
 from predictionio_tpu.data.event import Event, EventValidationError, validate_event, _parse_time
 from predictionio_tpu.data.storage import UNSET, Storage, StorageError, get_storage
+from predictionio_tpu.obs import flight
+from predictionio_tpu.obs import logging as obs_logging
 from predictionio_tpu.serving.http import HTTPServerBase, JSONRequestHandler
 from predictionio_tpu.serving.stats import Stats
 from predictionio_tpu.serving import webhooks as webhook_registry
@@ -371,6 +373,9 @@ class _EventRequestHandler(JSONRequestHandler):
             self._send(e.status, {"message": e.message})
         except Exception as e:  # pragma: no cover - defensive 500
             log.exception("event server error")
+            # name the failure in the request's flight record (the
+            # answered 500 never raises through the wrapper)
+            flight.note_field("error", f"{type(e).__name__}: {e}")
             self._send(500, {"message": str(e)})
 
     def do_GET(self):
@@ -405,7 +410,8 @@ def main(argv=None) -> None:
     parser.add_argument("--ip", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=DEFAULT_PORT)
     args = parser.parse_args(argv)
-    logging.basicConfig(level=logging.INFO)
+    # structured JSON log lines with trace-id correlation (obs/logging)
+    obs_logging.setup(level=logging.INFO)
     EventServer(host=args.ip, port=args.port).serve_forever()
 
 

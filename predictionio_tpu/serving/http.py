@@ -16,9 +16,9 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
-from predictionio_tpu.obs import metrics, trace
+from predictionio_tpu.obs import flight, metrics, profiler, trace
 
 log = logging.getLogger(__name__)
 
@@ -71,24 +71,76 @@ def metrics_route(path: str) -> str:
     return ":other"
 
 
+def _serve_admin_flight(handler, query: str) -> None:
+    """``GET /admin/flight``: the flight-recorder dump as JSON.
+    ``?n=N`` limits to the last N records, ``?slow=1`` keeps only
+    slow/errored ones."""
+    params = parse_qs(query)
+    try:
+        n = int(params["n"][0]) if "n" in params else None
+    except ValueError:
+        handler._send(400, {"message": "n must be an integer"})
+        return
+    slow_only = (params.get("slow") or ["0"])[0].lower() in ("1", "true")
+    handler._send(200, flight.RECORDER.dump(n, slow_only=slow_only))
+
+
+def _serve_admin_profile(handler, query: str) -> None:
+    """``POST /admin/profile?seconds=N``: record a JAX profiler window
+    of THIS process and answer the artifact path; 501 on CPU backends
+    (no device timeline to record), 409 while a capture is running.
+    The handler thread sleeps through the window by design — the
+    capture is of the OTHER threads doing device work."""
+    params = parse_qs(query)
+    try:
+        seconds = float((params.get("seconds") or ["3"])[0])
+    except ValueError:
+        handler._send(400, {"message": "seconds must be a number"})
+        return
+    # echo the EFFECTIVE window (capture clamps a typo'd N): the answer
+    # must describe the trace the operator actually holds
+    seconds = profiler.clamp_seconds(seconds)
+    try:
+        artifact = profiler.capture(seconds)
+    except profiler.ProfilerUnavailable as e:
+        handler._send(501, {"message": str(e),
+                            "backend": profiler.backend()})
+        return
+    except profiler.ProfilerBusy as e:
+        handler._send(409, {"message": str(e)})
+        return
+    handler._send(200, {"artifact": artifact, "seconds": seconds,
+                        "backend": profiler.backend()})
+
+
 def _instrument(fn):
-    """Wrap a do_METHOD handler: serve the shared ``GET /metrics`` route,
+    """Wrap a do_METHOD handler: serve the shared routes (``GET
+    /metrics``, ``GET /admin/flight``, ``POST /admin/profile``),
     activate the request's trace context (minting or accepting an
-    ``X-PIO-Trace-Id``), and record the built-in request metrics. Applied
-    once to every handler subclass via ``__init_subclass__`` — servers
-    inherit all of it without touching their routing code."""
+    ``X-PIO-Trace-Id``), open a flight-recorder record, and record the
+    built-in request metrics. Applied once to every handler subclass
+    via ``__init_subclass__`` — servers inherit all of it without
+    touching their routing code."""
     if getattr(fn, "_pio_instrumented", False):
         return fn
 
     @functools.wraps(fn)
     def wrapper(self):
-        path = urlparse(self.path).path
+        parsed = urlparse(self.path)
+        path = parsed.path
         server = self.server_version.split("/", 1)[0]
+        # shared operator routes: before any per-server auth (a
+        # scraper/diagnoser holds no storage keys) and outside their
+        # own request counts, traces and flight records
         if self.command == "GET" and path == "/metrics":
-            # exposition endpoint: before any per-server auth (a scraper
-            # holds no storage keys) and outside its own request count
             self._send(200, metrics.REGISTRY.render(),
                        content_type=metrics.CONTENT_TYPE)
+            return
+        if self.command == "GET" and path == "/admin/flight":
+            _serve_admin_flight(self, parsed.query)
+            return
+        if self.command == "POST" and path == "/admin/profile":
+            _serve_admin_profile(self, parsed.query)
             return
         # the inbound id is untrusted: anything not id-shaped (header
         # injection attempts, oversized strings) is re-minted, never
@@ -97,21 +149,32 @@ def _instrument(fn):
         trace_id = raw_id if trace.valid_trace_id(raw_id) else (
             trace.new_trace_id())
         token = trace.activate(trace_id)
+        route = metrics_route(path)
+        fkey = flight.begin(trace_id, server, self.command, route)
         inflight = _IN_FLIGHT.labels(server)
         inflight.inc()
         t0 = time.perf_counter()
         name = server.lower()
         name = name.removeprefix("pio") or name
+        error: Optional[str] = None
         try:
             with trace.span(f"http.{name}", method=self.command,
-                            route=metrics_route(path)):
+                            route=route):
                 fn(self)
+        except BaseException as e:
+            # an exception ESCAPING a handler (their own except blocks
+            # already answered anything they understood) is exactly the
+            # evidence the flight recorder exists for
+            error = f"{type(e).__name__}: {e}"
+            raise
         finally:
             inflight.dec()
-            trace.deactivate(token)
             status = getattr(self, "_metrics_status", None)
+            # seal the flight record while the trace is still active so
+            # the slow-request log line carries the trace id
+            flight.finish(fkey, status, error)
+            trace.deactivate(token)
             if status is not None:
-                route = metrics_route(path)
                 _REQUESTS_TOTAL.labels(server, self.command, route,
                                        str(status)).inc()
                 _REQUEST_SECONDS.labels(server, self.command, route).observe(
@@ -173,6 +236,7 @@ class JSONRequestHandler(BaseHTTPRequestHandler):
     def _send(self, status: int, body: Any,
               content_type: str = "application/json; charset=UTF-8",
               extra_headers: Optional[dict] = None) -> None:
+        t_ser = time.perf_counter()
         if isinstance(body, bytes):
             data = body
         elif isinstance(body, str):
@@ -212,11 +276,17 @@ class JSONRequestHandler(BaseHTTPRequestHandler):
             self.send_header(name, value)
         self.end_headers()
         self.wfile.write(data)
+        # response encode+write billed to the request's flight record
+        # (no-op when no record is open, e.g. the shared /metrics route)
+        flight.note_stage("serialize", time.perf_counter() - t_ser)
 
     def _read_body(self) -> bytes:
+        t0 = time.perf_counter()
         length = int(self.headers.get("Content-Length", 0))
         self._body_consumed = True
-        return self.rfile.read(length) if length else b""
+        data = self.rfile.read(length) if length else b""
+        flight.note_stage("parse", time.perf_counter() - t0)
+        return data
 
     def _read_json(self) -> Any:
         """Parsed JSON body; raises json.JSONDecodeError."""
@@ -225,9 +295,14 @@ class JSONRequestHandler(BaseHTTPRequestHandler):
     def _do_get_fallback(self):
         self._send(404, {"message": "Not Found"})
 
-    # servers that define no do_GET of their own still expose /metrics
-    # (served by the _instrument wrapper) and 404 everything else
+    def _do_post_fallback(self):
+        self._send(404, {"message": "Not Found"})
+
+    # servers that define no do_GET/do_POST of their own still expose
+    # the shared routes (/metrics, /admin/flight, /admin/profile —
+    # served by the _instrument wrapper) and 404 everything else
     do_GET = _instrument(_do_get_fallback)
+    do_POST = _instrument(_do_post_fallback)
 
 
 class _ThreadingHTTPServer(ThreadingHTTPServer):

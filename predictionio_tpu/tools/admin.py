@@ -8,6 +8,17 @@ Behavior contract from the reference (tools/.../admin/AdminAPI.scala:64-101
   POST   /cmd/app {name, description?} -> create app (+ key)
   DELETE /cmd/app/<name>        -> delete app
   DELETE /cmd/app/<name>/data   -> wipe the app's event data
+
+Beyond the reference, every PIO server (this one included) inherits the
+shared diagnostics surface from serving/http.py:
+
+  GET  /metrics                 -> Prometheus exposition
+  GET  /admin/flight[?n=&slow=] -> flight-recorder dump (obs/flight.py):
+                                   last N completed request records with
+                                   stage timings, span trees, trace ids,
+                                   plus periodic metric snapshots
+  POST /admin/profile?seconds=N -> on-demand JAX profiler window
+                                   (obs/profiler.py); 501 on CPU
 """
 
 from __future__ import annotations
@@ -18,6 +29,7 @@ from typing import Optional
 from urllib.parse import urlparse
 
 from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.obs import logging as obs_logging
 from predictionio_tpu.serving.http import HTTPServerBase, JSONRequestHandler
 from predictionio_tpu.tools import commands
 from predictionio_tpu.tools.commands import CommandError
@@ -115,7 +127,7 @@ def main(argv=None) -> None:
     parser.add_argument("--ip", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=DEFAULT_PORT)
     args = parser.parse_args(argv)
-    logging.basicConfig(level=logging.INFO)
+    obs_logging.setup(level=logging.INFO)
     server = AdminServer(host=args.ip, port=args.port)
     log.info("admin server running on %s:%s", args.ip, server.port)
     server.serve_forever()

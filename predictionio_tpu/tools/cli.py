@@ -17,6 +17,9 @@ Console.scala:128-735 command surface; bin/pio:17-42 wrapper):
                          instead of downloading from the gallery,
                          ref: console/Template.scala:198-415)
   status                (ref: Storage.verifyAllDataObjects)
+  metrics [--json]      (obs: Prometheus text or flat JSON dump)
+  flight / profile      (obs diagnostics: a server's flight-recorder
+                         dump; an on-demand JAX profiler window)
 
 Run as ``python -m predictionio_tpu.tools.cli <command> ...``.
 """
@@ -484,10 +487,12 @@ def cmd_status(args) -> int:
 
 
 def cmd_metrics(args) -> int:
-    """Dump telemetry in Prometheus text format (obs subsystem): from a
-    running server's ``GET /metrics`` when --url is given (every PIO
-    server exposes it), otherwise the in-process registry — useful after
-    an in-process `pio train` to read compile-cache and train timings."""
+    """Dump telemetry (obs subsystem): from a running server's
+    ``GET /metrics`` when --url is given (every PIO server exposes it),
+    otherwise the in-process registry — useful after an in-process
+    `pio train` to read compile-cache and train timings. Default output
+    is Prometheus text format; ``--json`` emits a flat machine-readable
+    ``{"name{labels}": value}`` object (same shape in both modes)."""
     if args.url:
         import urllib.request
 
@@ -495,17 +500,93 @@ def cmd_metrics(args) -> int:
         if not url.endswith("/metrics"):
             url += "/metrics"
         with urllib.request.urlopen(url, timeout=10) as resp:
-            sys.stdout.write(resp.read().decode())
-        return 0
-    from predictionio_tpu.obs.metrics import REGISTRY
+            text = resp.read().decode()
+    else:
+        from predictionio_tpu.obs.metrics import REGISTRY
 
-    sys.stdout.write(REGISTRY.render())
+        text = REGISTRY.render()
+    if args.json:
+        from predictionio_tpu.obs.metrics import samples_dict
+
+        json.dump(samples_dict(text), sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+def cmd_flight(args) -> int:
+    """Fetch a server's flight-recorder dump (``GET /admin/flight``,
+    obs/flight.py): the last N completed request records with stage
+    timings, span trees and trace ids, plus metric snapshots —
+    pretty-printed JSON on stdout."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    query = {}
+    if args.n is not None:
+        query["n"] = str(args.n)
+    if args.slow:
+        query["slow"] = "1"
+    url = args.url.rstrip("/") + "/admin/flight"
+    if query:
+        url += "?" + urllib.parse.urlencode(query)
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            payload = json.load(resp)
+    except urllib.error.HTTPError as e:
+        raise CommandError(
+            f"flight dump failed ({e.code}): "
+            f"{e.read().decode(errors='replace')[:200]}")
+    except urllib.error.URLError as e:
+        raise CommandError(f"cannot reach {args.url}: {e.reason}")
+    json.dump(payload, sys.stdout, indent=1, sort_keys=True)
+    sys.stdout.write("\n")
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """Ask a live server for an on-demand JAX profiler capture
+    (``POST /admin/profile?seconds=N``, obs/profiler.py) and print the
+    artifact path. The server answers 501 on a CPU backend — there is
+    no device timeline to record."""
+    import urllib.error
+    import urllib.request
+
+    url = (args.url.rstrip("/")
+           + f"/admin/profile?seconds={float(args.seconds)}")
+    req = urllib.request.Request(url, method="POST", data=b"")
+    try:
+        # the server sleeps through the capture window before answering
+        with urllib.request.urlopen(
+                req, timeout=float(args.seconds) + 30) as resp:
+            payload = json.load(resp)
+    except urllib.error.HTTPError as e:
+        body = e.read().decode(errors="replace")
+        try:
+            message = json.loads(body).get("message", body)
+        except json.JSONDecodeError:
+            message = body
+        if e.code == 501:
+            _p(f"profiler unavailable on the server: {message}")
+            return 1
+        raise CommandError(f"profile request failed ({e.code}): {message}")
+    except urllib.error.URLError as e:
+        # after HTTPError: a down/unreachable server is an operator
+        # error, not a traceback
+        raise CommandError(f"cannot reach {args.url}: {e.reason}")
+    _p(f"profile captured ({payload['seconds']}s, "
+       f"backend {payload.get('backend', '?')})")
+    _p(f"artifact: {payload['artifact']}")
+    _p("open with TensorBoard/xprof, or parse device time via "
+       f"`python -m predictionio_tpu.obs.profiler {payload['artifact']}`")
     return 0
 
 
 def cmd_lint(args) -> int:
     """graftlint: the JAX/TPU-aware static analysis over the tree
-    (rules JT01-JT07; tier-1 CI runs the same pass via tests/test_lint_clean.py)."""
+    (rules JT01-JT08; tier-1 CI runs the same pass via tests/test_lint_clean.py)."""
     from predictionio_tpu.tools.lint import run_cli
 
     try:
@@ -718,10 +799,38 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--url", default=None,
                    help="base URL of any PIO server, e.g. "
                         "http://127.0.0.1:8000")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable flat {name{labels}: value} dump")
     p.set_defaults(func=cmd_metrics)
 
+    p = sub.add_parser(
+        "flight",
+        help="dump a server's flight recorder (GET /admin/flight): the "
+             "last completed requests with stage timings + trace ids",
+    )
+    p.add_argument("--url", required=True,
+                   help="base URL of any PIO server, e.g. "
+                        "http://127.0.0.1:8000")
+    p.add_argument("-n", type=int, default=None,
+                   help="only the last N records")
+    p.add_argument("--slow", action="store_true",
+                   help="only slow/errored records")
+    p.set_defaults(func=cmd_flight)
+
+    p = sub.add_parser(
+        "profile",
+        help="capture an on-demand JAX profiler window on a live server "
+             "(POST /admin/profile); prints the artifact path, exits 1 "
+             "with a message on CPU backends",
+    )
+    p.add_argument("--url", required=True,
+                   help="base URL of the server doing the device work")
+    p.add_argument("--seconds", type=float, default=3.0,
+                   help="capture window length (default 3)")
+    p.set_defaults(func=cmd_profile)
+
     p = sub.add_parser("lint", help="run graftlint (JAX/TPU-aware static "
-                                    "analysis, rules JT01-JT07) over the tree")
+                                    "analysis, rules JT01-JT08) over the tree")
     p.add_argument("paths", nargs="*", default=[],
                    help="files/dirs (default: the installed package)")
     p.add_argument("--format", choices=["human", "json"], default="human")
@@ -739,7 +848,15 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+    # structured logging with trace-id correlation (obs/logging.py):
+    # the interactive console stays human-readable unless PIO_LOG_JSON
+    # opts in; server subcommands inherit the same handler
+    from predictionio_tpu.obs import logging as obs_logging
+
+    obs_logging.setup(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        default_json=False,
+    )
     try:
         return args.func(args)
     except (CommandError, StorageError, RuntimeError, FileNotFoundError, ValueError) as e:

@@ -9,17 +9,26 @@ instances (newest first) with per-instance result routes
   GET /engine_instances/<id>/evaluator_results.html    -> HTML report
   GET /engine_instances/<id>/evaluator_results.json    -> JSON report
 
-plus CORS headers (ref: CorsSupport.scala).
+plus CORS headers (ref: CorsSupport.scala), and — beyond the
+reference — an operator view of this process's flight recorder:
+
+  GET /flight[?slow=1]  -> HTML table of the last recorded requests
+                           (stage timings, trace ids; ?slow=1 keeps
+                           only slow/errored ones). The JSON dump is
+                           at /admin/flight like on every PIO server.
 """
 
 from __future__ import annotations
 
 import html
+import json as _json
 import logging
 from typing import Optional
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 from predictionio_tpu.data.storage import Storage, get_storage
+from predictionio_tpu.obs import flight
+from predictionio_tpu.obs import logging as obs_logging
 from predictionio_tpu.serving.http import HTTPServerBase, JSONRequestHandler
 
 log = logging.getLogger(__name__)
@@ -36,10 +45,17 @@ class _DashboardRequestHandler(JSONRequestHandler):
                    extra_headers={"Access-Control-Allow-Origin": "*"})
 
     def do_GET(self):
-        path = urlparse(self.path).path
+        url = urlparse(self.path)
+        path = url.path
         storage: Storage = self.server_ref.storage
         if path == "/":
             self._send_cors(200, self.server_ref.index_html(),
+                            "text/html; charset=UTF-8")
+            return
+        if path == "/flight":
+            slow_only = (parse_qs(url.query).get("slow")
+                         or ["0"])[0].lower() in ("1", "true")
+            self._send_cors(200, self.server_ref.flight_html(slow_only),
                             "text/html; charset=UTF-8")
             return
         parts = [p for p in path.split("/") if p]
@@ -103,8 +119,48 @@ class DashboardServer(HTTPServerBase):
             "</title></head><body><h1>Evaluation Instances</h1>"
             "<table border='1'><tr><th>ID</th><th>Started</th>"
             "<th>Evaluation</th><th>Batch</th><th>Results</th></tr>"
-            f"{rows}</table></body></html>"
+            f"{rows}</table>"
+            '<p><a href="/flight">Flight recorder</a> · '
+            '<a href="/flight?slow=1">slow/errored requests</a> · '
+            '<a href="/admin/flight">JSON dump</a> · '
+            '<a href="/metrics">metrics</a></p>'
+            "</body></html>"
         )
+
+    def flight_html(self, slow_only: bool = False) -> str:
+        """The flight recorder as an operator table: one row per
+        recorded request (newest first), stage breakdown inline — the
+        slow-query view when ``slow_only``."""
+        records = flight.RECORDER.records(slow_only=slow_only)
+        rows = "\n".join(
+            "<tr><td>{trace}</td><td>{server}</td><td>{method} {route}</td>"
+            "<td>{status}</td><td>{dur:.1f}</td><td><code>{stages}</code>"
+            "</td><td>{flags}</td></tr>".format(
+                trace=html.escape(str(r.get("trace", ""))[:16]),
+                server=html.escape(str(r.get("server", ""))),
+                method=html.escape(str(r.get("method", ""))),
+                route=html.escape(str(r.get("route", ""))),
+                status=html.escape(str(r.get("status"))),
+                dur=r.get("duration_ms", 0.0),
+                stages=html.escape(_json.dumps(r.get("stages", {}))),
+                flags=html.escape(
+                    ("SLOW " if r.get("slow") else "")
+                    + (f"ERROR: {r.get('error')}" if r.get("error") else "")),
+            )
+            for r in reversed(records)
+        )
+        title = "Slow / errored requests" if slow_only else "Flight recorder"
+        return (
+            "<!DOCTYPE html><html><head><title>{t}</title></head><body>"
+            "<h1>{t}</h1><p>{n} record(s); slow threshold "
+            "{ms:.0f} ms (PIO_SLOW_MS). <a href='/flight'>all</a> · "
+            "<a href='/flight?slow=1'>slow only</a> · "
+            "<a href='/admin/flight'>JSON</a></p>"
+            "<table border='1'><tr><th>Trace</th><th>Server</th>"
+            "<th>Request</th><th>Status</th><th>ms</th><th>Stages (ms)"
+            "</th><th>Flags</th></tr>{rows}</table></body></html>"
+        ).format(t=title, n=len(records), ms=flight.slow_threshold_ms(),
+                 rows=rows)
 
 
 def main(argv=None) -> None:
@@ -114,7 +170,7 @@ def main(argv=None) -> None:
     parser.add_argument("--ip", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=DEFAULT_PORT)
     args = parser.parse_args(argv)
-    logging.basicConfig(level=logging.INFO)
+    obs_logging.setup(level=logging.INFO)
     server = DashboardServer(host=args.ip, port=args.port)
     log.info("dashboard running on %s:%s", args.ip, server.port)
     server.serve_forever()

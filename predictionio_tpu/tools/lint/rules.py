@@ -1,4 +1,4 @@
-"""graftlint rules JT01-JT07: the TPU hazards this codebase has hit.
+"""graftlint rules JT01-JT08: the TPU hazards this codebase has hit.
 
 Each rule encodes a failure class with a concrete precedent in this
 tree's history (the bf16-Gramian divergence behind JT03 is recorded in
@@ -701,3 +701,167 @@ class MissingBufferDonation(Rule):
                     "declare donate_argnums/donate_argnames for the "
                     "rebound arguments",
                 )
+
+
+# -- JT08 ----------------------------------------------------------------------
+
+@register
+class CompileCacheKeyInstability(Rule):
+    id = "JT08"
+    name = "compile-cache-key-instability"
+    rationale = (
+        "A jit-wrapped closure capturing unhashable or per-process Python "
+        "state (dict/list/set displays, time/pid/uuid/random values) "
+        "bakes that state into the traced program as constants, so "
+        "byte-identical work fingerprints differently per process and "
+        "the persistent compile cache (parallel/compile_cache.py) "
+        "silently misses across trains/deploys/reloads."
+    )
+
+    #: calls whose value differs per process/invocation: traced in as a
+    #: constant, each process compiles a different program
+    _NONDET_CALLS = {
+        "time.time", "time.time_ns", "time.monotonic", "time.perf_counter",
+        "os.getpid", "os.urandom", "uuid.uuid1", "uuid.uuid4",
+        "id", "hash",
+    }
+    #: stdlib/numpy RNG draws are per-process too; jax.random is NOT
+    #: listed — its draws are pure functions of an explicit key
+    _NONDET_PREFIXES = ("random.", "np.random.", "numpy.random.")
+
+    _UNHASHABLE = (ast.Dict, ast.List, ast.Set,
+                   ast.ListComp, ast.SetComp, ast.DictComp)
+
+    def _is_nondet_call(self, node: ast.AST) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        d = dotted(node.func)
+        if d in self._NONDET_CALLS or d.startswith(self._NONDET_PREFIXES):
+            return d
+        return None
+
+    @staticmethod
+    def _fn_params(fn) -> Set[str]:
+        args = fn.args
+        names = {a.arg for a in (args.posonlyargs + args.args
+                                 + args.kwonlyargs)}
+        if args.vararg:
+            names.add(args.vararg.arg)
+        if args.kwarg:
+            names.add(args.kwarg.arg)
+        return names
+
+    def _free_names(self, fn) -> Set[str]:
+        """Names a lambda/nested def reads but neither receives nor
+        binds itself — the closure captures."""
+        body = [fn.body] if isinstance(fn, ast.Lambda) else fn.body
+        loads: Set[str] = set()
+        stores: Set[str] = set(self._fn_params(fn))
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Name):
+                    if isinstance(node.ctx, ast.Load):
+                        loads.add(node.id)
+                    else:
+                        stores.add(node.id)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    stores.add(node.name)
+        return loads - stores
+
+    def _check_closure(self, ctx: FileContext, site: ast.AST, fn: ast.AST,
+                       assigns: Dict[str, ast.AST]) -> Iterator[Finding]:
+        for name in sorted(self._free_names(fn)):
+            value = assigns.get(name)
+            if value is None:
+                continue
+            if isinstance(value, self._UNHASHABLE):
+                kind = type(value).__name__.lower().replace("comp",
+                                                            " comprehension")
+                yield Finding(
+                    self.id, ctx.path, site.lineno, site.col_offset,
+                    f"jit-wrapped closure captures `{name}`, a {kind} "
+                    "built in the enclosing scope — its contents trace "
+                    "in as constants, so per-process variation defeats "
+                    "the persistent compile cache; pass it as a (static) "
+                    "argument or hoist it to a module-level constant",
+                )
+                continue
+            nondet = self._is_nondet_call(value)
+            if nondet is not None:
+                yield Finding(
+                    self.id, ctx.path, site.lineno, site.col_offset,
+                    f"jit-wrapped closure captures `{name}` = {nondet}() "
+                    "— a per-process value traced in as a constant "
+                    "guarantees a persistent compile-cache miss in every "
+                    "new process; pass it as a traced argument instead",
+                )
+
+    @staticmethod
+    def _scope_nodes(fn) -> Iterator[ast.AST]:
+        """Walk a function's body WITHOUT descending into nested
+        function/lambda bodies: a sibling helper's locals are not this
+        scope's bindings, and attributing them here would flag
+        cache-stable captures of same-named outer/module values."""
+        stack: List[ast.AST] = list(fn.body)
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue  # separate scope — visited on its own turn
+            stack.extend(ast.iter_child_nodes(node))
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # (1) per-process values consumed DIRECTLY inside any jit'd body
+        for fn, _traced, _static in iter_jit_functions(ctx.tree):
+            for node in _walk_body(fn):
+                nondet = self._is_nondet_call(node)
+                if nondet is not None:
+                    yield Finding(
+                        self.id, ctx.path, node.lineno, node.col_offset,
+                        f"{nondet}() inside jit-compiled `{fn.name}` "
+                        "traces to a per-process constant — every new "
+                        "process compiles (and caches) a different "
+                        "program; compute it outside and pass it in",
+                    )
+        # (2) jit-wrapped closures capturing unstable enclosing state;
+        # each function is analyzed as ITS OWN scope (ast.walk visits
+        # nested defs separately), so bindings never leak across scopes
+        for outer in ast.walk(ctx.tree):
+            if not isinstance(outer, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            local_defs: Dict[str, ast.AST] = {}
+            assigns: Dict[str, ast.AST] = {}
+            for node in self._scope_nodes(outer):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    local_defs[node.name] = node
+                elif isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            assigns.setdefault(tgt.id, node.value)
+                elif isinstance(node, ast.AnnAssign) and (
+                        node.value is not None
+                        and isinstance(node.target, ast.Name)):
+                    assigns.setdefault(node.target.id, node.value)
+            for node in self._scope_nodes(outer):
+                fn_node: Optional[ast.AST] = None
+                site: ast.AST = node
+                if isinstance(node, ast.Call) and _is_jit_callable(node.func):
+                    if not node.args:
+                        continue
+                    target = node.args[0]
+                    if isinstance(target, ast.Lambda):
+                        fn_node = target
+                    elif isinstance(target, ast.Name):
+                        fn_node = local_defs.get(target.id)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    # a jit-DECORATED def nested in a function is a
+                    # closure too
+                    if any(_jit_static_params(dec, node) is not None
+                           for dec in node.decorator_list):
+                        fn_node = node
+                if fn_node is not None:
+                    yield from self._check_closure(ctx, site, fn_node,
+                                                   assigns)

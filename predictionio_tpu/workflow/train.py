@@ -25,7 +25,7 @@ from predictionio_tpu.core.params import EngineParams, params_to_dict
 from predictionio_tpu.core.persistent_model import PersistentModel, manifest_for
 from predictionio_tpu.data.metadata import EngineInstance, Model
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.obs import jaxmon
+from predictionio_tpu.obs import jaxmon, profiler
 from predictionio_tpu.parallel.mesh import MeshContext
 from predictionio_tpu.workflow.config import WorkflowParams
 
@@ -67,29 +67,74 @@ def _maybe_profile(instance_id: str):
     training observability is the Spark UI — SURVEY.md §5.1): set
     ``PIO_PROFILE_DIR`` to capture a JAX/XLA device trace of the whole
     train into ``<dir>/<instance_id>`` (open with TensorBoard or
-    xprof). Profiling failures never fail training."""
+    xprof; obs/profiler.py owns the capture machinery). After a
+    successful capture the PER-STEP device-time breakdown is computed
+    in a subprocess (the xplane parser's tensorflow proto stack must
+    not share this process) and logged as a structured record plus a
+    ``breakdown.json`` beside the trace. Profiling failures never fail
+    training."""
     profile_dir = os.environ.get("PIO_PROFILE_DIR")
     if not profile_dir:
         yield
         return
     out = os.path.join(profile_dir, instance_id)
-    try:
-        import jax
+    steps_before = jaxmon.TRAIN_STEP_SECONDS.labels().count
+    with profiler.trace_capture(out) as started:
+        yield
+    if started:
+        steps = jaxmon.TRAIN_STEP_SECONDS.labels().count - steps_before
+        _log_step_breakdown(out, steps)
 
-        tracer = jax.profiler.trace(out)
-        tracer.__enter__()
-        log.info("profiling train to %s", out)
-    except Exception:  # noqa: BLE001 — observability must not break train
-        log.exception("profiler failed to start; continuing without trace")
-        yield
-        return
+
+def _log_step_breakdown(profile_dir: str, steps: int) -> None:
+    """Parse the captured trace into device ms/step by HLO category
+    (best effort: on CPU tier-1 or without the parser deps this logs
+    the parse error and moves on). A train whose loop never feeds
+    ``pio_train_step_seconds`` has ``steps == 0``: the TOTAL device
+    time is logged instead — a whole-train number must never be
+    presented as a per-step one."""
+    import subprocess
+    import sys as _sys
+
+    cmd = [_sys.executable, "-m", "predictionio_tpu.obs.profiler",
+           profile_dir]
+    if steps > 0:
+        cmd += ["--steps", str(steps)]
     try:
-        yield
-    finally:
-        try:
-            tracer.__exit__(None, None, None)
-        except Exception:  # noqa: BLE001
-            log.exception("profiler failed to stop")
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=600)
+        lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+        breakdown = json.loads(lines[-1]) if lines else {
+            "error": f"parse rc={proc.returncode}: {proc.stderr[-300:]}"}
+    except Exception as e:  # noqa: BLE001 — observability must not break train
+        breakdown = {"error": str(e)}
+    if "error" in breakdown:
+        log.info("train profile captured at %s (device-time breakdown "
+                 "unavailable: %s)", profile_dir, breakdown["error"])
+        return
+    if steps > 0:
+        log.info(
+            "train device time: %.3f ms/step over %d step(s)",
+            breakdown["device_ms_per_step"], breakdown["steps"],
+            extra={"pio": {"profile_dir": profile_dir, **{
+                k: breakdown[k] for k in ("device_ms_per_step",
+                                          "by_category_ms_per_step",
+                                          "steps")}}},
+        )
+    else:
+        log.info(
+            "train device time: %.3f s total (no per-step timings "
+            "observed)", breakdown["device_time_sec"],
+            extra={"pio": {"profile_dir": profile_dir,
+                           "device_time_sec": breakdown["device_time_sec"],
+                           "by_category": breakdown.get("by_category")}},
+        )
+    try:
+        with open(os.path.join(profile_dir, "breakdown.json"), "w") as f:
+            json.dump(breakdown, f, indent=1, sort_keys=True)
+    except OSError as e:
+        log.warning("could not persist %s/breakdown.json: %s",
+                    profile_dir, e)
 
 
 def run_train(

@@ -37,7 +37,7 @@ def rule_ids(findings):
 
 def test_all_rules_registered():
     assert {"JT01", "JT02", "JT03", "JT04", "JT05", "JT06",
-            "JT07"} <= set(RULES)
+            "JT07", "JT08"} <= set(RULES)
 
 
 def test_syntax_error_is_reported_not_raised(tmp_path):
@@ -459,6 +459,106 @@ def test_jt07_negative_donated_and_unrelated(tmp_path):
                 loss = score(params, b)          # no rebind of an arg
                 other = not_jitted(params, b)    # unknown callee: silent
             return params
+    """)
+    assert findings == []
+
+
+# -- JT08 compile-cache-key-instability ---------------------------------------
+
+def test_jt08_positive_closure_over_dict_and_clock(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import jax
+        import time
+
+        def build_step(cfg):
+            tables = {"a": 1, "b": 2}
+            started = time.time()
+            step = jax.jit(lambda x: x * tables["a"] + started)
+            return step
+    """)
+    assert rule_ids(findings) == ["JT08", "JT08"]
+    messages = " ".join(f.message for f in findings)
+    assert "`tables`" in messages and "`started`" in messages
+
+
+def test_jt08_positive_decorated_nested_def_and_direct_call(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import jax
+        import os
+
+        def build(cfg):
+            layout = [1, 2, 3]
+
+            @jax.jit
+            def inner(x):
+                return x + layout[0]
+
+            return inner
+
+        @jax.jit
+        def stamped(x):
+            return x + os.getpid()
+    """)
+    assert sorted(rule_ids(findings)) == ["JT08", "JT08"]
+    messages = " ".join(f.message for f in findings)
+    assert "`layout`" in messages and "os.getpid" in messages
+
+
+def test_jt08_negative_stable_captures(tmp_path):
+    # scalar config reads, module constants, declared-static args and
+    # jax.random (pure function of an explicit key) are all cache-stable
+    findings = lint_src(tmp_path, """\
+        from functools import partial
+        import jax
+
+        SCALE = 2.0
+
+        def build_step(cfg):
+            rate = cfg.rate
+            key = jax.random.PRNGKey(0)
+            step = jax.jit(lambda x: x * rate * SCALE)
+
+            @partial(jax.jit, static_argnames=("n",))
+            def inner(x, n):
+                return x + jax.random.normal(key, (n,))
+
+            return step, inner
+    """)
+    assert findings == []
+
+
+def test_jt08_negative_sibling_scope_locals_do_not_leak(tmp_path):
+    # a sibling helper's LOCAL `layout` must not shadow the stable
+    # module-level value the closure actually captures
+    findings = lint_src(tmp_path, """\
+        import jax
+
+        layout = (1, 2, 3)
+
+        def outer():
+            def helper():
+                layout = [1, 2]
+                return layout
+
+            step = jax.jit(lambda x: x + layout[0])
+            return helper, step
+    """)
+    assert findings == []
+
+
+def test_jt08_negative_dict_as_argument_not_capture(tmp_path):
+    # passing the mapping IN (traced or static argument) is the fix —
+    # the rule must not flag the corrected form
+    findings = lint_src(tmp_path, """\
+        import jax
+
+        def build_step(cfg):
+            tables = {"a": 1}
+
+            def inner(x, scale):
+                return x * scale
+
+            return jax.jit(inner)(1.0, tables["a"])
     """)
     assert findings == []
 
