@@ -652,6 +652,13 @@ class SqliteStorageClient(S.StorageClient):
     def models(self) -> S.ModelsRepo:
         return self._models
 
+    def health_check(self) -> bool:
+        """A real round-trip, not the base class's constant True: a
+        closed/corrupted database file must turn /readyz and `pio
+        status` red, and only a live query notices."""
+        self._db.query("SELECT 1")
+        return True
+
     def close(self) -> None:
         self._db.close()
 

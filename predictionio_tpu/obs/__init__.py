@@ -21,12 +21,20 @@ PR), one registry:
   obs.profiler — on-demand JAX profiler capture windows
                  (``POST /admin/profile``) + xplane device-time parsing
   obs.logging  — structured JSON log lines carrying the active trace id
+  obs.health   — active monitoring: the probe registry behind every
+                 server's ``GET /healthz`` / ``GET /readyz`` and the
+                 stall watchdogs (serving dispatch, train steps)
+  obs.slo      — declarative SLOs with multi-window burn-rate alerting
+                 (``GET /admin/slo``, ``pio slo``, dashboard ``/slo``)
+  obs.push     — PIO_PUSH_URL background OpenMetrics pusher with
+                 retry/backoff (the push-gateway path)
 
-Import cost is stdlib-only; jax is touched lazily inside jaxmon and
-profiler.
+Import cost is stdlib-only; jax is touched lazily inside jaxmon,
+profiler and the health device probe.
 """
 
-from predictionio_tpu.obs import flight, jaxmon, metrics, profiler, trace
+from predictionio_tpu.obs import (flight, health, jaxmon, metrics, profiler,
+                                  push, slo, trace)
 from predictionio_tpu.obs import logging as obs_logging
 from predictionio_tpu.obs.metrics import (
     CONTENT_TYPE,
@@ -44,11 +52,14 @@ __all__ = [
     "counter",
     "flight",
     "gauge",
+    "health",
     "histogram",
     "jaxmon",
     "metrics",
     "obs_logging",
     "profiler",
+    "push",
+    "slo",
     "span",
     "trace",
 ]

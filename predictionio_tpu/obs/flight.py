@@ -27,11 +27,15 @@ server (serving/http.py routes it, like ``/metrics``) and by
 ``pio flight --url ...``.
 
 Config (all env):
-  PIO_FLIGHT_CAPACITY   ring size (default 256 records)
-  PIO_SLOW_MS           slow-request threshold in ms (default 1000;
-                        0 flags everything — useful in tests)
-  PIO_FLIGHT_DIR        directory for automatic error dumps (unset =
-                        ring-only, no files)
+  PIO_FLIGHT_CAPACITY        ring size (default 256 records)
+  PIO_SLOW_MS                slow-request threshold in ms (default 1000;
+                             0 flags everything — useful in tests)
+  PIO_FLIGHT_DIR             directory for automatic error dumps (unset
+                             = ring-only, no files)
+  PIO_FLIGHT_MAX_DUMPS       dump files kept in PIO_FLIGHT_DIR (default
+                             64; oldest evicted first)
+  PIO_FLIGHT_MAX_DUMP_BYTES  total bytes of dump files kept (default
+                             64 MiB; oldest evicted first)
 """
 
 from __future__ import annotations
@@ -69,6 +73,74 @@ _RECORDS_TOTAL = metrics.counter(
     ("outcome",),
 )
 
+_DUMPS_EVICTED_TOTAL = metrics.counter(
+    "pio_flight_dumps_evicted_total",
+    "PIO_FLIGHT_DIR dump files evicted (oldest first) to stay under "
+    "the count/byte caps",
+)
+
+DEFAULT_MAX_DUMPS = 64
+DEFAULT_MAX_DUMP_BYTES = 64 * 1024 * 1024
+
+
+def _enforce_dump_caps(out_dir: str) -> None:
+    """Bound PIO_FLIGHT_DIR: keep at most PIO_FLIGHT_MAX_DUMPS files
+    and PIO_FLIGHT_MAX_DUMP_BYTES total, evicting oldest-first (by
+    mtime) — a long-lived erroring server must not fill the disk with
+    post-mortems of the same failure."""
+    max_dumps = max(1, metrics.env_int("PIO_FLIGHT_MAX_DUMPS",
+                                       DEFAULT_MAX_DUMPS))
+    max_bytes = max(0, metrics.env_int("PIO_FLIGHT_MAX_DUMP_BYTES",
+                                       DEFAULT_MAX_DUMP_BYTES))
+    try:
+        entries = []
+        with os.scandir(out_dir) as it:
+            for entry in it:
+                if not entry.name.endswith(".json"):
+                    continue
+                st = entry.stat()
+                entries.append((st.st_mtime, st.st_size, entry.path))
+    except OSError as e:
+        log.warning("flight dump cap scan of %s failed: %s", out_dir, e)
+        return
+    entries.sort()  # oldest first
+    total = sum(size for _, size, _ in entries)
+    evict = []
+    # the newest dump (the one just written) always survives — an
+    # over-cap single file still beats losing the only post-mortem
+    while len(entries) > 1 and (len(entries) > max_dumps
+                                or (max_bytes and total > max_bytes)):
+        mtime, size, path = entries.pop(0)
+        total -= size
+        evict.append(path)
+    for path in evict:
+        try:
+            os.remove(path)
+            _DUMPS_EVICTED_TOTAL.inc()
+        except OSError as e:
+            log.warning("flight dump eviction of %s failed: %s", path, e)
+
+
+def write_dump_file(prefix: str, payload: Dict[str, Any]) -> Optional[str]:
+    """Write one JSON diagnostic dump into PIO_FLIGHT_DIR (error dumps,
+    watchdog stack dumps) and enforce the directory caps. Returns the
+    path, or None when PIO_FLIGHT_DIR is unset or the write failed —
+    never raises, diagnostics must not take down the diagnosed."""
+    out_dir = os.environ.get("PIO_FLIGHT_DIR")
+    if not out_dir:
+        return None
+    name = "{}-{}.json".format(prefix, int(time.time() * 1e3))
+    path = os.path.join(out_dir, name)
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(payload, f, sort_keys=True)
+    except OSError as e:
+        log.warning("flight dump to %s failed: %s", path, e)
+        return None
+    _enforce_dump_caps(out_dir)
+    return path
+
 
 def slow_threshold_ms() -> float:
     """The PIO_SLOW_MS threshold (read per request: env changes and
@@ -88,18 +160,18 @@ def _metrics_snapshot() -> Dict[str, Any]:
     rates and load around a record without the full exposition."""
     out: Dict[str, Any] = {}
     for family in metrics.REGISTRY.collect():
-        with family._lock:
-            children = list(family._children.values())
+        children = [c for _, c in family.children()]
         if not children:
             continue
         if family.kind == "histogram":
             count = total = 0
             for c in children:
-                count += c._count
-                total += c._sum
+                n, s = c.snapshot()
+                count += n
+                total += s
             out[family.name] = {"count": count, "sum": round(total, 6)}
         else:
-            out[family.name] = round(sum(c._value for c in children), 6)
+            out[family.name] = round(sum(c.value for c in children), 6)
     return out
 
 
@@ -236,6 +308,13 @@ class FlightRecorder:
             snap["metrics"] = _metrics_snapshot()
             with self._lock:
                 self._snapshots.append(snap)
+            # periodic consumers (the SLO monitor's sampler) ride the
+            # same cadence instead of running threads of their own
+            for fn in list(_snapshot_listeners):
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001 — cadence must survive
+                    log.exception("flight snapshot listener %r failed", fn)
         if slow:
             slow_log.warning(
                 "slow request: %s %s %.1f ms (threshold %.1f ms)",
@@ -287,21 +366,26 @@ class FlightRecorder:
         """Automatic dump on a handler error: the record is already in
         the ring (visible at /admin/flight with no operator action);
         with PIO_FLIGHT_DIR set, the whole dump also lands as a JSON
-        file — the post-mortem survives the process."""
-        out_dir = os.environ.get("PIO_FLIGHT_DIR")
-        if not out_dir:
-            return
-        name = "flight-{}-{}.json".format(
-            record.get("trace", "noid")[:16], int(time.time() * 1e3))
-        path = os.path.join(out_dir, name)
-        try:
-            os.makedirs(out_dir, exist_ok=True)
-            with open(path, "w", encoding="utf-8") as f:
-                json.dump(self.dump(), f, sort_keys=True)
+        file — the post-mortem survives the process. The directory is
+        capped (count + bytes, oldest evicted) by write_dump_file."""
+        path = write_dump_file(
+            "flight-{}".format(record.get("trace", "noid")[:16]),
+            self.dump())
+        if path is not None:
             log.warning("handler error on %s %s — flight dump written "
                         "to %s", record["method"], record["route"], path)
-        except OSError as e:
-            log.warning("flight dump to %s failed: %s", path, e)
+
+
+#: periodic-cadence listeners invoked whenever a metric snapshot is
+#: taken (every SNAPSHOT_INTERVAL_SEC while requests flow)
+_snapshot_listeners: List[Any] = []
+
+
+def add_snapshot_listener(fn) -> None:
+    """Register ``fn()`` to run on the recorder's snapshot cadence
+    (idempotent per function object)."""
+    if fn not in _snapshot_listeners:
+        _snapshot_listeners.append(fn)
 
 
 #: the process-global recorder every server records into

@@ -147,6 +147,12 @@ def record_transfer(nbytes: Optional[int], direction: str) -> None:
 
 def observe_train_step(seconds: float) -> None:
     TRAIN_STEP_SECONDS.observe(seconds)
+    # feed the train-step deadman (obs/health.py): each completed step
+    # both extends its duration history and pushes the stall deadline
+    # out; silence beyond factor x trailing median fires the watchdog
+    from predictionio_tpu.obs import health
+
+    health.TRAIN_WATCHDOG.beat(seconds)
 
 
 def update_device_memory_gauges() -> int:

@@ -26,8 +26,34 @@ Design constraints:
 from __future__ import annotations
 
 import math
+import os
 import threading
+import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+def env_float(name: str, default: float) -> float:
+    """A float env knob, falling back on unset OR unparseable values —
+    a typo'd threshold must degrade to the default, never crash a
+    probe/pusher/monitor (shared by the obs modules)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    """Integer twin of :func:`env_float`."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
 
 #: serving-latency oriented default histogram buckets (seconds): the
 #: north-star budget is p50 < 10ms, so sub-ms resolution at the bottom,
@@ -114,8 +140,14 @@ class HistogramChild(_Child):
         self._counts = [0] * (len(self._bounds) + 1)  # +1: the +Inf bucket
         self._sum = 0.0
         self._count = 0
+        # last exemplar per bucket index: (labels, value, unix_ts) —
+        # OpenMetrics exposition attaches these to _bucket lines so a
+        # collector can jump from a latency bucket to the trace that
+        # landed in it
+        self._exemplars: Dict[int, Tuple[Dict[str, str], float, float]] = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[Dict[str, str]] = None) -> None:
         value = float(value)
         with self._lock:
             self._sum += value
@@ -123,8 +155,18 @@ class HistogramChild(_Child):
             for i, bound in enumerate(self._bounds):
                 if value <= bound:
                     self._counts[i] += 1
-                    return
-            self._counts[-1] += 1
+                    break
+            else:
+                i = len(self._bounds)
+                self._counts[-1] += 1
+            if exemplar:
+                self._exemplars[i] = (dict(exemplar), value, time.time())
+
+    def exemplars(self) -> Dict[int, Tuple[Dict[str, str], float, float]]:
+        """Bucket index -> (labels, observed value, unix ts) — the last
+        exemplar-bearing observation per bucket."""
+        with self._lock:
+            return dict(self._exemplars)
 
     @property
     def count(self) -> int:
@@ -190,6 +232,14 @@ class MetricFamily:
         self._lock = threading.Lock()
         self._children: Dict[Tuple[str, ...], _Child] = {}
 
+    def children(self) -> List[Tuple[Tuple[str, ...], "_Child"]]:
+        """A consistent snapshot of (label values, child) pairs — the
+        public walk for consumers (health probes, SLO measurement,
+        flight snapshots) that would otherwise reach into the family's
+        private storage."""
+        with self._lock:
+            return list(self._children.items())
+
     def labels(self, *values, **kwargs):
         if kwargs:
             if values:
@@ -229,8 +279,9 @@ class MetricFamily:
     def inc(self, amount: float = 1.0) -> None:
         self._default_child().inc(amount)
 
-    def observe(self, value: float) -> None:
-        self._default_child().observe(value)
+    def observe(self, value: float,
+                exemplar: Optional[Dict[str, str]] = None) -> None:
+        self._default_child().observe(value, exemplar=exemplar)
 
     def set(self, value: float) -> None:
         self._default_child().set(value)
@@ -258,10 +309,42 @@ class MetricFamily:
         return [f"{self.name}{_label_str(self.labelnames, values)} "
                 f"{_fmt(child.value)}"]
 
+    # -- OpenMetrics exposition --------------------------------------------
+    def _om_name(self) -> str:
+        """OpenMetrics metric-family name (counters drop the ``_total``
+        suffix — it belongs to the sample, not the family)."""
+        return self.name
+
+    def render_openmetrics(self) -> List[str]:
+        om = self._om_name()
+        lines = [
+            f"# HELP {om} {self.help}",
+            f"# TYPE {om} {self.kind}",
+        ]
+        with self._lock:
+            children = list(self._children.items())
+        for values, child in sorted(children):
+            lines.extend(self._render_child_openmetrics(values, child))
+        return lines
+
+    def _render_child_openmetrics(self, values, child) -> List[str]:
+        return self._render_child(values, child)
+
 
 class Counter(MetricFamily):
     kind = "counter"
     child_cls = CounterChild
+
+    def _om_name(self) -> str:
+        return self.name[:-6] if self.name.endswith("_total") else self.name
+
+    def _render_child_openmetrics(self, values, child) -> List[str]:
+        # OpenMetrics: the sample is <family>_total, whatever the
+        # Prometheus-format name was — identical here by convention
+        # (every counter in this tree is registered as *_total)
+        return [f"{self._om_name()}_total"
+                f"{_label_str(self.labelnames, values)} "
+                f"{_fmt(child.value)}"]
 
 
 class Gauge(MetricFamily):
@@ -288,6 +371,33 @@ class Histogram(MetricFamily):
                 self.labelnames + ("le",), tuple(values) + (_fmt(bound),)
             )
             lines.append(f"{self.name}_bucket{labels} {running}")
+        base = _label_str(self.labelnames, values)
+        lines.append(f"{self.name}_sum{base} {_fmt(child.sum)}")
+        lines.append(f"{self.name}_count{base} {child.count}")
+        return lines
+
+    def _render_child_openmetrics(self, values,
+                                  child: HistogramChild) -> List[str]:
+        """Bucket lines carry exemplars: ``... 17 # {trace_id="ab..."}
+        0.0042 1712345678.9`` — the OpenMetrics syntax a collector
+        needs to jump from a bucket to the request that landed in it."""
+        exemplars = child.exemplars()
+        lines = []
+        for i, (bound, running) in enumerate(child.cumulative()):
+            labels = _label_str(
+                self.labelnames + ("le",), tuple(values) + (_fmt(bound),)
+            )
+            line = f"{self.name}_bucket{labels} {running}"
+            ex = exemplars.get(i)
+            if ex is not None:
+                ex_labels, ex_value, ex_ts = ex
+                inner = ",".join(
+                    f'{n}="{_escape_label(v)}"'
+                    for n, v in sorted(ex_labels.items())
+                )
+                line += (f" # {{{inner}}} {_fmt(ex_value)} "
+                         f"{round(ex_ts, 3)}")
+            lines.append(line)
         base = _label_str(self.labelnames, values)
         lines.append(f"{self.name}_sum{base} {_fmt(child.sum)}")
         lines.append(f"{self.name}_count{base} {child.count}")
@@ -356,6 +466,18 @@ class Registry:
             lines.extend(family.render())
         return "\n".join(lines) + "\n"
 
+    def render_openmetrics(self) -> str:
+        """The OpenMetrics 1.0 document (served when a scraper sends
+        ``Accept: application/openmetrics-text``): counter samples keep
+        their ``_total`` suffix under a suffix-less family name,
+        histogram buckets carry exemplars, and the document ends with
+        the mandatory ``# EOF``."""
+        lines: List[str] = []
+        for family in sorted(self.collect(), key=lambda f: f.name):
+            lines.extend(family.render_openmetrics())
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
     def reset(self) -> None:
         """Clear every family's children, keeping registrations (tests)."""
         for family in self.collect():
@@ -368,6 +490,10 @@ REGISTRY = Registry()
 #: Prometheus exposition content type for /metrics responses
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+#: OpenMetrics exposition content type (negotiated via Accept)
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8")
+
 
 def samples_dict(text: str) -> Dict[str, float]:
     """Parse a Prometheus text-format document into a flat
@@ -379,6 +505,9 @@ def samples_dict(text: str) -> Dict[str, float]:
         line = line.strip()
         if not line or line.startswith("#"):
             continue
+        # OpenMetrics exemplars trail the sample after " # "; the
+        # sample value is everything before that marker
+        line = line.split(" # ", 1)[0].rstrip()
         name_part, _, value = line.rpartition(" ")
         if not name_part:
             continue

@@ -1,0 +1,297 @@
+"""Declarative SLOs with multi-window burn-rate alerting.
+
+An SLO here is a statement like "99% of serving requests finish under
+100 ms" or "99.9% of HTTP requests do not 5xx", evaluated against the
+metrics the servers already record — the latency SLO reads the
+``pio_serving_request_seconds`` histogram's buckets, the availability
+SLO reads ``pio_http_requests_total`` by status. Nothing new is
+measured; this module turns the existing counters into a paging signal.
+
+Burn rate is the SRE-workbook quantity: (observed error rate) /
+(error budget). Burn 1.0 spends the budget exactly at the objective's
+pace; burn 14.4 exhausts a 30-day budget in ~2 days. Alerts use the
+standard multi-window, multi-burn-rate rules so a blip does not page
+but a real regression pages fast:
+
+  fast page:  burn >= 14.4 over BOTH the last 5m and the last 1h
+  slow page:  burn >= 6    over BOTH the last 30m and the last 6h
+
+Windows are computed from periodic cumulative (good, total) snapshots.
+The sampler rides the flight recorder's snapshot cadence (one hook —
+obs/flight.py already wakes on that interval) and also samples on
+every read, so an ``/admin/slo`` poll or ``pio slo`` call is always
+current. Tests feed synthetic samples directly via ``record()``.
+
+Surfaces: ``GET /admin/slo`` on every server (serving/http.py),
+``pio slo`` in the CLI, and the dashboard's ``/slo`` panel.
+
+Config (all env):
+  PIO_SLO_LATENCY_MS              latency threshold (default 100)
+  PIO_SLO_LATENCY_OBJECTIVE       fraction under threshold (default 0.99)
+  PIO_SLO_AVAILABILITY_OBJECTIVE  fraction non-5xx (default 0.999)
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from predictionio_tpu.obs import flight, metrics
+
+#: (window_seconds pairs, burn threshold) — the SRE-workbook defaults
+FAST_WINDOWS = (300.0, 3600.0)
+FAST_BURN = 14.4
+SLOW_WINDOWS = (1800.0, 21600.0)
+SLOW_BURN = 6.0
+
+#: snapshots kept: 6h of 60s cadence plus generous slack
+SAMPLE_CAPACITY = 512
+
+#: minimum spacing between samples — the nominal cadence. On-read
+#: ticks (every /admin/slo or dashboard poll) are no-ops inside this
+#: window; otherwise a 1s-autorefresh dashboard would churn the
+#: 512-sample ring in minutes and silently shrink the 6h slow window
+#: to however far back the flood reaches.
+MIN_SAMPLE_SPACING_SEC = 60.0
+
+_BURN_GAUGE = metrics.gauge(
+    "pio_slo_burn_rate",
+    "Latest burn rate per SLO and evaluation window",
+    ("slo", "window"),
+)
+_ALERT_GAUGE = metrics.gauge(
+    "pio_slo_alert_firing",
+    "Whether an SLO's multi-window burn-rate alert is firing (1) or "
+    "not (0)",
+    ("slo",),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SLO:
+    """One objective over an existing metric family.
+
+    kind "latency": ``metric`` is a histogram; good = observations in
+    buckets whose upper bound is <= ``threshold_ms`` (the tightest
+    bucket boundary at or above the threshold — bucket math, so this
+    agrees with any PromQL evaluation of the same rule).
+
+    kind "availability": ``metric`` is a counter labeled with
+    ``status``; good = series whose status parses below 500.
+    """
+
+    name: str
+    kind: str                      # "latency" | "availability"
+    metric: str
+    objective: float
+    threshold_ms: Optional[float] = None
+
+    def budget(self) -> float:
+        return max(1e-9, 1.0 - self.objective)
+
+    # -- cumulative (good, total) from the live registry -------------------
+    def measure(self) -> Tuple[float, float]:
+        family = metrics.REGISTRY.get(self.metric)
+        if family is None:
+            return 0.0, 0.0
+        if self.kind == "latency":
+            return self._measure_latency(family)
+        return self._measure_availability(family)
+
+    def _measure_latency(self, family) -> Tuple[float, float]:
+        threshold = (self.threshold_ms or 0.0) / 1e3
+        good = total = 0.0
+        for _values, child in family.children():
+            for bound, running in child.cumulative():
+                if bound >= threshold or bound == math.inf:
+                    good += running
+                    break
+            total += child.count
+        return good, total
+
+    def _measure_availability(self, family) -> Tuple[float, float]:
+        try:
+            idx = family.labelnames.index("status")
+        except ValueError:
+            return 0.0, 0.0
+        good = total = 0.0
+        for values, child in family.children():
+            v = child.value
+            total += v
+            try:
+                status = int(values[idx])
+            except (ValueError, IndexError):
+                status = 0
+            if status < 500:
+                good += v
+        return good, total
+
+
+def default_slos() -> List[SLO]:
+    return [
+        SLO(
+            name="serving-latency",
+            kind="latency",
+            metric="pio_serving_request_seconds",
+            objective=metrics.env_float("PIO_SLO_LATENCY_OBJECTIVE", 0.99),
+            threshold_ms=metrics.env_float("PIO_SLO_LATENCY_MS", 100.0),
+        ),
+        SLO(
+            name="http-availability",
+            kind="availability",
+            metric="pio_http_requests_total",
+            objective=metrics.env_float("PIO_SLO_AVAILABILITY_OBJECTIVE", 0.999),
+        ),
+    ]
+
+
+def burn_rate(samples: List[Tuple[float, float, float]],
+              now: float, window: float, budget: float) -> Optional[float]:
+    """Burn over the trailing ``window`` from cumulative samples
+    ``(ts, good, total)``: error fraction of the requests that arrived
+    in the window, divided by the error budget. None when the window
+    has no two samples or saw no traffic — "no data" must stay
+    distinguishable from "burning at 0"."""
+    if not samples:
+        return None
+    start = now - window
+    # the baseline is the newest sample at or before the window start
+    # (falling back to the oldest available — a partially covered
+    # window still evaluates, it just spans less history)
+    baseline = samples[0]
+    for s in samples:
+        if s[0] <= start:
+            baseline = s
+        else:
+            break
+    latest = samples[-1]
+    if latest[0] <= baseline[0]:
+        return None
+    d_total = latest[2] - baseline[2]
+    d_good = latest[1] - baseline[1]
+    if d_total <= 0:
+        return None
+    error_rate = min(1.0, max(0.0, (d_total - d_good) / d_total))
+    return error_rate / budget
+
+
+class SLOMonitor:
+    """Cumulative snapshot series per SLO + the multi-window evaluation."""
+
+    def __init__(self, slos: Optional[List[SLO]] = None):
+        self._lock = threading.Lock()
+        self._slos: Dict[str, SLO] = {}
+        self._samples: Dict[str, "collections.deque"] = {}
+        self._last_tick = 0.0
+        for slo in (slos if slos is not None else default_slos()):
+            self.add(slo)
+
+    def add(self, slo: SLO) -> None:
+        with self._lock:
+            self._slos[slo.name] = slo
+            self._samples.setdefault(
+                slo.name, collections.deque(maxlen=SAMPLE_CAPACITY))
+
+    def slos(self) -> List[SLO]:
+        with self._lock:
+            return list(self._slos.values())
+
+    def record(self, name: str, ts: float, good: float, total: float) -> None:
+        """Append one cumulative sample (tests feed synthetic series
+        here; live sampling goes through ``tick``)."""
+        with self._lock:
+            series = self._samples.setdefault(
+                name, collections.deque(maxlen=SAMPLE_CAPACITY))
+            series.append((float(ts), float(good), float(total)))
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Sample every SLO's (good, total) from the live registry.
+        Rate-limited so the cadence hook and on-read ticks coexist."""
+        now = time.time() if now is None else now
+        with self._lock:
+            if now - self._last_tick < MIN_SAMPLE_SPACING_SEC:
+                return
+            self._last_tick = now
+            slos = list(self._slos.values())
+        for slo in slos:
+            good, total = slo.measure()
+            self.record(slo.name, now, good, total)
+
+    def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The full evaluation served by /admin/slo: per SLO, the burn
+        rate in each window, which alert pair is firing, and the state
+        ("firing" / "ok" / "no_data")."""
+        now = time.time() if now is None else now
+        out: List[Dict[str, Any]] = []
+        for slo in self.slos():
+            with self._lock:
+                samples = list(self._samples.get(slo.name, ()))
+            budget = slo.budget()
+            windows: Dict[str, Optional[float]] = {}
+            for seconds in sorted(set(FAST_WINDOWS + SLOW_WINDOWS)):
+                label = _window_label(seconds)
+                burn = burn_rate(samples, now, seconds, budget)
+                windows[label] = None if burn is None else round(burn, 3)
+                _BURN_GAUGE.labels(slo.name, label).set(
+                    0.0 if burn is None else burn)
+            fast = _pair_firing(windows, FAST_WINDOWS, FAST_BURN)
+            slow = _pair_firing(windows, SLOW_WINDOWS, SLOW_BURN)
+            firing = bool(fast or slow)
+            has_data = any(v is not None for v in windows.values())
+            state = "firing" if firing else ("ok" if has_data else "no_data")
+            _ALERT_GAUGE.labels(slo.name).set(1.0 if firing else 0.0)
+            entry: Dict[str, Any] = {
+                "name": slo.name,
+                "kind": slo.kind,
+                "metric": slo.metric,
+                "objective": slo.objective,
+                "burn_rates": windows,
+                "alerts": {
+                    "fast": {"windows": [_window_label(w)
+                                         for w in FAST_WINDOWS],
+                             "threshold": FAST_BURN, "firing": fast},
+                    "slow": {"windows": [_window_label(w)
+                                         for w in SLOW_WINDOWS],
+                             "threshold": SLOW_BURN, "firing": slow},
+                },
+                "state": state,
+            }
+            if slo.threshold_ms is not None:
+                entry["threshold_ms"] = slo.threshold_ms
+            out.append(entry)
+        return {"generated_unix": round(now, 3), "slos": out}
+
+    def report(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """tick + evaluate: the read path ``/admin/slo`` serves."""
+        self.tick(now)
+        return self.evaluate(now)
+
+    def clear(self) -> None:
+        with self._lock:
+            for series in self._samples.values():
+                series.clear()
+            self._last_tick = 0.0
+
+
+def _window_label(seconds: float) -> str:
+    if seconds < 3600:
+        return f"{int(seconds // 60)}m"
+    return f"{int(seconds // 3600)}h"
+
+
+def _pair_firing(windows: Dict[str, Optional[float]],
+                 pair: Tuple[float, float], threshold: float) -> bool:
+    values = [windows.get(_window_label(w)) for w in pair]
+    return all(v is not None and v >= threshold for v in values)
+
+
+#: the process-global monitor every server's /admin/slo reads
+MONITOR = SLOMonitor()
+
+# ride the flight recorder's snapshot cadence: one sample per interval
+# while traffic flows, without a thread of our own
+flight.add_snapshot_listener(lambda: MONITOR.tick())

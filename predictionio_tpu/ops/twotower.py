@@ -713,13 +713,21 @@ class TwoTowerTrainer:
         already completed by a restored checkpoint are not repeated).
         One device dispatch per epoch; the shuffle key derives from
         (seed, epoch index) so a resumed run replays the same order."""
+        import time as _time
+
+        from predictionio_tpu.obs import jaxmon
+
         target = epochs if epochs is not None else self.cfg.epochs
         base = jax.random.PRNGKey(self.cfg.seed + 1)
         while self._epochs_done < target:
+            t_step = _time.perf_counter()
             key = jax.random.fold_in(base, self._epochs_done)
             *state, mean_loss = self._epoch_fn(*self._state, key)
             self._state = tuple(state)
             self._losses.append(float(mean_loss))
+            # per-dispatch wall time onto pio_train_step_seconds; also
+            # beats the train-step stall watchdog (obs/health.py)
+            jaxmon.observe_train_step(_time.perf_counter() - t_step)
             self._epochs_done += 1
             if self._ckpt is not None:
                 tables, acc, dense, opt_state = self._state

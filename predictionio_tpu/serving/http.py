@@ -4,13 +4,33 @@ One copy of the JSON response writer, body reader, bind-retry loop and
 thread lifecycle used by the event server, engine server, dashboard and
 admin API (the reference gets this from spray; each server here is a
 stdlib ThreadingHTTPServer).
+
+Every server also inherits the shared operator surface from the
+``_instrument`` wrapper:
+
+  GET  /healthz          liveness (cheap, no probes)
+  GET  /readyz           readiness (health probes; 503 on any FAILED)
+  GET  /metrics          Prometheus text, or OpenMetrics with
+                         exemplars under ``Accept:
+                         application/openmetrics-text``
+  GET  /admin/flight     flight-recorder dump        } bearer-token
+  POST /admin/profile    on-demand profiler window   } guarded when
+  GET  /admin/slo        SLO burn-rate evaluation    } PIO_ADMIN_TOKEN
+                                                       is set
+
+``/healthz``, ``/readyz`` and ``/metrics`` stay unauthenticated — a
+liveness prober or scraper holds no operator secrets; the ``/admin/*``
+diagnostics expose request payloads/traces and so require
+``Authorization: Bearer $PIO_ADMIN_TOKEN`` once the operator sets it.
 """
 
 from __future__ import annotations
 
 import functools
+import hmac
 import json
 import logging
+import os
 import re
 import threading
 import time
@@ -18,7 +38,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 from urllib.parse import parse_qs, urlparse
 
-from predictionio_tpu.obs import flight, metrics, profiler, trace
+from predictionio_tpu.obs import (flight, health, metrics, profiler, push,
+                                  slo, trace)
 
 log = logging.getLogger(__name__)
 
@@ -69,6 +90,55 @@ def metrics_route(path: str) -> str:
         _routes_seen.add(route)
         return route
     return ":other"
+
+
+def _admin_authorized(handler) -> bool:
+    """Bearer-token gate for the ``/admin/*`` diagnostics: with
+    ``PIO_ADMIN_TOKEN`` unset everything stays open (trusted-network
+    default, the pre-auth behavior); once set, requests must carry
+    ``Authorization: Bearer <token>`` (constant-time compare)."""
+    token = os.environ.get("PIO_ADMIN_TOKEN")
+    if not token:
+        return True
+    supplied = handler.headers.get("Authorization") or ""
+    return hmac.compare_digest(supplied, f"Bearer {token}")
+
+
+def _server_storage(server_ref) -> Any:
+    """The serving object's storage, wherever the server keeps it (the
+    event server nests it inside its core)."""
+    storage = getattr(server_ref, "storage", None)
+    if storage is None:
+        storage = getattr(getattr(server_ref, "core", None), "storage", None)
+    return storage
+
+
+def _serve_readyz(handler) -> None:
+    """``GET /readyz``: run the process health probes plus THIS
+    server's storage probe; 200 while nothing FAILED (DEGRADED still
+    serves — readiness is "can answer", not "is pristine"), 503 with
+    the same per-probe detail otherwise."""
+    health.install_default_probes()
+    storage = _server_storage(handler.server_ref)
+    extra = {"storage": lambda: health.storage_probe(storage)}
+    overall, detail = health.REGISTRY.run(extra=extra)
+    status = 503 if overall == health.FAILED else 200
+    handler._send(status, {"status": overall, "probes": detail})
+
+
+def _serve_metrics(handler, query: str) -> None:
+    """``GET /metrics``: Prometheus text by default; the OpenMetrics
+    document (counter `_total` families, histogram exemplars, `# EOF`)
+    under ``Accept: application/openmetrics-text`` or
+    ``?format=openmetrics``."""
+    accept = handler.headers.get("Accept") or ""
+    fmt = (parse_qs(query).get("format") or [""])[0]
+    if "application/openmetrics-text" in accept or fmt == "openmetrics":
+        handler._send(200, metrics.REGISTRY.render_openmetrics(),
+                      content_type=metrics.OPENMETRICS_CONTENT_TYPE)
+    else:
+        handler._send(200, metrics.REGISTRY.render(),
+                      content_type=metrics.CONTENT_TYPE)
 
 
 def _serve_admin_flight(handler, query: str) -> None:
@@ -132,16 +202,34 @@ def _instrument(fn):
         # shared operator routes: before any per-server auth (a
         # scraper/diagnoser holds no storage keys) and outside their
         # own request counts, traces and flight records
+        if self.command == "GET" and path == "/healthz":
+            # liveness: no probes, no locks beyond _send — a wedged
+            # process fails this by not answering, nothing else does
+            self._send(200, {"status": "alive"})
+            return
+        if self.command == "GET" and path == "/readyz":
+            _serve_readyz(self)
+            return
         if self.command == "GET" and path == "/metrics":
-            self._send(200, metrics.REGISTRY.render(),
-                       content_type=metrics.CONTENT_TYPE)
+            _serve_metrics(self, parsed.query)
             return
-        if self.command == "GET" and path == "/admin/flight":
-            _serve_admin_flight(self, parsed.query)
-            return
-        if self.command == "POST" and path == "/admin/profile":
-            _serve_admin_profile(self, parsed.query)
-            return
+        if path.startswith("/admin/"):
+            # diagnostics expose payloads and traces: bearer-gated once
+            # PIO_ADMIN_TOKEN is set (liveness/metrics stay open above)
+            if not _admin_authorized(self):
+                self._send(401, {"message": "missing or invalid bearer "
+                                            "token (PIO_ADMIN_TOKEN)"},
+                           extra_headers={"WWW-Authenticate": "Bearer"})
+                return
+            if self.command == "GET" and path == "/admin/flight":
+                _serve_admin_flight(self, parsed.query)
+                return
+            if self.command == "POST" and path == "/admin/profile":
+                _serve_admin_profile(self, parsed.query)
+                return
+            if self.command == "GET" and path == "/admin/slo":
+                self._send(200, slo.MONITOR.report())
+                return
         # the inbound id is untrusted: anything not id-shaped (header
         # injection attempts, oversized strings) is re-minted, never
         # echoed into response headers or span logs
@@ -177,8 +265,12 @@ def _instrument(fn):
             if status is not None:
                 _REQUESTS_TOTAL.labels(server, self.command, route,
                                        str(status)).inc()
+                # the trace id rides along as an OpenMetrics exemplar:
+                # a collector can jump from a latency bucket straight
+                # to this request's trace
                 _REQUEST_SECONDS.labels(server, self.command, route).observe(
-                    time.perf_counter() - t0)
+                    time.perf_counter() - t0,
+                    exemplar={"trace_id": trace_id})
 
     wrapper._pio_instrumented = True
     return wrapper
@@ -343,6 +435,7 @@ class HTTPServerBase:
         # start() still runs shutdown() (which blocks until the serve
         # loop has run and exited) instead of closing the socket under it
         self._serving = True
+        push.start_from_env()  # no-op unless PIO_PUSH_URL is set
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         self._thread.start()
         log.info("%s listening on %s", type(self).__name__, self.port)
@@ -350,6 +443,7 @@ class HTTPServerBase:
 
     def serve_forever(self) -> None:
         self._serving = True
+        push.start_from_env()
         self.httpd.serve_forever()
 
     def stop(self) -> None:

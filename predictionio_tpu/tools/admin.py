@@ -12,13 +12,22 @@ Behavior contract from the reference (tools/.../admin/AdminAPI.scala:64-101
 Beyond the reference, every PIO server (this one included) inherits the
 shared diagnostics surface from serving/http.py:
 
-  GET  /metrics                 -> Prometheus exposition
+  GET  /healthz                 -> liveness (always 200, no probes)
+  GET  /readyz                  -> readiness (health probes incl. this
+                                   server's storage; 503 on FAILED)
+  GET  /metrics                 -> Prometheus exposition (OpenMetrics
+                                   with exemplars via Accept)
   GET  /admin/flight[?n=&slow=] -> flight-recorder dump (obs/flight.py):
                                    last N completed request records with
                                    stage timings, span trees, trace ids,
                                    plus periodic metric snapshots
   POST /admin/profile?seconds=N -> on-demand JAX profiler window
                                    (obs/profiler.py); 501 on CPU
+  GET  /admin/slo               -> SLO burn-rate evaluation (obs/slo.py)
+
+The ``/admin/*`` routes answer 401 without ``Authorization: Bearer
+$PIO_ADMIN_TOKEN`` once that env var is set; health and metrics stay
+open for probers and scrapers.
 """
 
 from __future__ import annotations

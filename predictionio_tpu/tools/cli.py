@@ -20,6 +20,11 @@ Console.scala:128-735 command surface; bin/pio:17-42 wrapper):
   metrics [--json]      (obs: Prometheus text or flat JSON dump)
   flight / profile      (obs diagnostics: a server's flight-recorder
                          dump; an on-demand JAX profiler window)
+  slo                   (obs: SLO burn-rate evaluation, in-process or
+                         from a server's /admin/slo)
+  bench-compare         (per-metric deltas across the BENCH_r*.json
+                         trajectory; exit 1 on regressions beyond the
+                         tolerance band)
 
 Run as ``python -m predictionio_tpu.tools.cli <command> ...``.
 """
@@ -515,6 +520,17 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def _add_admin_auth(req) -> None:
+    """Attach the PIO_ADMIN_TOKEN bearer header to an /admin/* request
+    when the operator has one configured — the servers 401 those
+    routes without it (serving/http.py)."""
+    import os
+
+    token = os.environ.get("PIO_ADMIN_TOKEN")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+
+
 def cmd_flight(args) -> int:
     """Fetch a server's flight-recorder dump (``GET /admin/flight``,
     obs/flight.py): the last N completed request records with stage
@@ -532,8 +548,10 @@ def cmd_flight(args) -> int:
     url = args.url.rstrip("/") + "/admin/flight"
     if query:
         url += "?" + urllib.parse.urlencode(query)
+    req = urllib.request.Request(url)
+    _add_admin_auth(req)
     try:
-        with urllib.request.urlopen(url, timeout=10) as resp:
+        with urllib.request.urlopen(req, timeout=10) as resp:
             payload = json.load(resp)
     except urllib.error.HTTPError as e:
         raise CommandError(
@@ -557,6 +575,7 @@ def cmd_profile(args) -> int:
     url = (args.url.rstrip("/")
            + f"/admin/profile?seconds={float(args.seconds)}")
     req = urllib.request.Request(url, method="POST", data=b"")
+    _add_admin_auth(req)
     try:
         # the server sleeps through the capture window before answering
         with urllib.request.urlopen(
@@ -584,9 +603,73 @@ def cmd_profile(args) -> int:
     return 0
 
 
+def cmd_slo(args) -> int:
+    """SLO burn-rate evaluation (obs/slo.py): from a running server's
+    ``GET /admin/slo`` when --url is given (sending the
+    ``PIO_ADMIN_TOKEN`` bearer header when set), otherwise evaluated
+    in-process against this process's registry. ``--json`` dumps the
+    raw report; default output is one line per SLO with its state and
+    the worst-window burn."""
+    import urllib.error
+    import urllib.request
+
+    if args.url:
+        url = args.url.rstrip("/") + "/admin/slo"
+        req = urllib.request.Request(url)
+        _add_admin_auth(req)
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                report = json.load(resp)
+        except urllib.error.HTTPError as e:
+            raise CommandError(
+                f"slo request failed ({e.code}): "
+                f"{e.read().decode(errors='replace')[:200]}")
+        except urllib.error.URLError as e:
+            raise CommandError(f"cannot reach {args.url}: {e.reason}")
+    else:
+        from predictionio_tpu.obs import slo as _slo
+
+        report = _slo.MONITOR.report()
+    if args.json:
+        json.dump(report, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+        return 0
+    firing = 0
+    for entry in report["slos"]:
+        burns = {w: b for w, b in entry["burn_rates"].items()
+                 if b is not None}
+        worst = max(burns.values()) if burns else None
+        target = f"{entry['objective']:.3%}"
+        if entry.get("threshold_ms") is not None:
+            target += f" <= {entry['threshold_ms']:g}ms"
+        _p(f"{entry['name']:>20} [{entry['kind']}] objective {target}  "
+           f"state={entry['state']}  "
+           + (f"worst-window burn {worst:.2f}" if worst is not None
+              else "no data"))
+        for alert, info in entry["alerts"].items():
+            if info["firing"]:
+                _p(f"{'':>20} {alert} page FIRING "
+                   f"(burn >= {info['threshold']} over "
+                   f"{' and '.join(info['windows'])})")
+        firing += entry["state"] == "firing"
+    return 1 if firing else 0
+
+
+def cmd_bench_compare(args) -> int:
+    """Per-metric deltas across the bench trajectory (BENCH_r*.json):
+    newest round vs the previous (or --against first), REGRESSION/
+    IMPROVED verdicts beyond --tolerance percent, exit 1 on any
+    regression — perf drift becomes visible at review time."""
+    from predictionio_tpu.tools import benchcmp
+
+    files = args.files or benchcmp.default_files(args.dir)
+    return benchcmp.run(files, tolerance_pct=args.tolerance,
+                        against=args.against)
+
+
 def cmd_lint(args) -> int:
     """graftlint: the JAX/TPU-aware static analysis over the tree
-    (rules JT01-JT08; tier-1 CI runs the same pass via tests/test_lint_clean.py)."""
+    (rules JT01-JT09; tier-1 CI runs the same pass via tests/test_lint_clean.py)."""
     from predictionio_tpu.tools.lint import run_cli
 
     try:
@@ -829,8 +912,39 @@ def build_parser() -> argparse.ArgumentParser:
                    help="capture window length (default 3)")
     p.set_defaults(func=cmd_profile)
 
+    p = sub.add_parser(
+        "slo",
+        help="SLO burn-rate evaluation (from a server's /admin/slo with "
+             "--url, else the in-process registry); exit 1 when firing",
+    )
+    p.add_argument("--url", default=None,
+                   help="base URL of any PIO server, e.g. "
+                        "http://127.0.0.1:8000 (sends the "
+                        "PIO_ADMIN_TOKEN bearer header when set)")
+    p.add_argument("--json", action="store_true",
+                   help="dump the raw evaluation report")
+    p.set_defaults(func=cmd_slo)
+
+    p = sub.add_parser(
+        "bench-compare",
+        help="compare the newest BENCH_r*.json round against a baseline; "
+             "print per-metric deltas, exit 1 on regressions beyond the "
+             "tolerance band",
+    )
+    p.add_argument("files", nargs="*", default=[],
+                   help="bench files in trajectory order (default: "
+                        "BENCH_r*.json in --dir)")
+    p.add_argument("--dir", default=".",
+                   help="directory holding BENCH_r*.json (default: cwd)")
+    p.add_argument("--tolerance", type=float, default=10.0,
+                   help="tolerance band in percent (default 10)")
+    p.add_argument("--against", choices=["prev", "first"], default="prev",
+                   help="baseline round: the previous one (default) or "
+                        "the first")
+    p.set_defaults(func=cmd_bench_compare)
+
     p = sub.add_parser("lint", help="run graftlint (JAX/TPU-aware static "
-                                    "analysis, rules JT01-JT08) over the tree")
+                                    "analysis, rules JT01-JT09) over the tree")
     p.add_argument("paths", nargs="*", default=[],
                    help="files/dirs (default: the installed package)")
     p.add_argument("--format", choices=["human", "json"], default="human")

@@ -10,12 +10,16 @@ instances (newest first) with per-instance result routes
   GET /engine_instances/<id>/evaluator_results.json    -> JSON report
 
 plus CORS headers (ref: CorsSupport.scala), and — beyond the
-reference — an operator view of this process's flight recorder:
+reference — operator views of this process's diagnostics:
 
   GET /flight[?slow=1]  -> HTML table of the last recorded requests
                            (stage timings, trace ids; ?slow=1 keeps
                            only slow/errored ones). The JSON dump is
                            at /admin/flight like on every PIO server.
+  GET /slo              -> HTML panel of the SLO burn-rate evaluation
+                           (obs/slo.py) — per SLO, the burn in every
+                           window and whether the fast/slow page is
+                           firing. JSON at /admin/slo.
 """
 
 from __future__ import annotations
@@ -56,6 +60,10 @@ class _DashboardRequestHandler(JSONRequestHandler):
             slow_only = (parse_qs(url.query).get("slow")
                          or ["0"])[0].lower() in ("1", "true")
             self._send_cors(200, self.server_ref.flight_html(slow_only),
+                            "text/html; charset=UTF-8")
+            return
+        if path == "/slo":
+            self._send_cors(200, self.server_ref.slo_html(),
                             "text/html; charset=UTF-8")
             return
         parts = [p for p in path.split("/") if p]
@@ -123,7 +131,9 @@ class DashboardServer(HTTPServerBase):
             '<p><a href="/flight">Flight recorder</a> · '
             '<a href="/flight?slow=1">slow/errored requests</a> · '
             '<a href="/admin/flight">JSON dump</a> · '
-            '<a href="/metrics">metrics</a></p>'
+            '<a href="/slo">SLO burn rates</a> · '
+            '<a href="/metrics">metrics</a> · '
+            '<a href="/readyz">readiness</a></p>'
             "</body></html>"
         )
 
@@ -161,6 +171,52 @@ class DashboardServer(HTTPServerBase):
             "</th><th>Flags</th></tr>{rows}</table></body></html>"
         ).format(t=title, n=len(records), ms=flight.slow_threshold_ms(),
                  rows=rows)
+
+    def slo_html(self) -> str:
+        """The SLO evaluation as an operator panel: one row per SLO
+        with its burn rate in every window, colored by alert state."""
+        from predictionio_tpu.obs import slo as _slo
+
+        report = _slo.MONITOR.report()
+        window_labels: list = []
+        for entry in report["slos"]:
+            for label in entry["burn_rates"]:
+                if label not in window_labels:
+                    window_labels.append(label)
+        header = "".join(f"<th>burn {html.escape(w)}</th>"
+                         for w in window_labels)
+        rows = []
+        for entry in report["slos"]:
+            color = {"firing": "#c0392b", "ok": "#27ae60"}.get(
+                entry["state"], "#888")
+            cells = "".join(
+                "<td>{}</td>".format(
+                    "–" if entry["burn_rates"].get(w) is None
+                    else f"{entry['burn_rates'][w]:.2f}")
+                for w in window_labels)
+            objective = entry["objective"]
+            target = f"{objective:.3%}"
+            if entry.get("threshold_ms") is not None:
+                target += f" &le; {entry['threshold_ms']:.0f} ms"
+            rows.append(
+                "<tr><td>{name}</td><td>{kind}</td><td>{target}</td>{cells}"
+                '<td style="color:{color};font-weight:bold">{state}'
+                "</td></tr>".format(
+                    name=html.escape(entry["name"]),
+                    kind=html.escape(entry["kind"]),
+                    target=target, cells=cells, color=color,
+                    state=html.escape(entry["state"])))
+        return (
+            "<!DOCTYPE html><html><head><title>SLO burn rates</title>"
+            "</head><body><h1>SLO burn rates</h1>"
+            "<p>Multi-window burn-rate alerting: the fast page needs "
+            "burn &ge; 14.4 over both 5m and 1h; the slow page needs "
+            "&ge; 6 over both 30m and 6h. "
+            '<a href="/admin/slo">JSON</a> · <a href="/">index</a></p>'
+            "<table border='1'><tr><th>SLO</th><th>Kind</th>"
+            f"<th>Objective</th>{header}<th>State</th></tr>"
+            f"{''.join(rows)}</table></body></html>"
+        )
 
 
 def main(argv=None) -> None:

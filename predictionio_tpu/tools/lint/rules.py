@@ -1,4 +1,4 @@
-"""graftlint rules JT01-JT08: the TPU hazards this codebase has hit.
+"""graftlint rules JT01-JT09: the TPU hazards this codebase has hit.
 
 Each rule encodes a failure class with a concrete precedent in this
 tree's history (the bf16-Gramian divergence behind JT03 is recorded in
@@ -865,3 +865,136 @@ class CompileCacheKeyInstability(Rule):
                 if fn_node is not None:
                     yield from self._check_closure(ctx, site, fn_node,
                                                    assigns)
+
+
+# -- JT09 ----------------------------------------------------------------------
+
+@register
+class UnsupervisedDaemonThread(Rule):
+    id = "JT09"
+    name = "unsupervised-daemon-thread"
+    rationale = (
+        "A background threading.Thread whose service loop can raise "
+        "without a broad except-that-logs dies silently: the pusher/"
+        "watchdog/worker it implemented simply stops forever, and the "
+        "operator's first symptom is the absence of the thing it "
+        "produced. Every loop-running thread body needs a broad "
+        "except-with-log inside (or logged around) its loop."
+    )
+
+    _THREAD_NAMES = {"Thread", "threading.Thread"}
+
+    def _thread_targets(self, tree: ast.AST) -> Iterator[Tuple[ast.AST, str]]:
+        """(call node, target's last name component) for every
+        ``threading.Thread(target=...)`` whose target is resolvable
+        file-locally (a bare name or attribute chain — external
+        callables like ``server.serve_forever`` resolve to nothing)."""
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted(node.func) not in self._THREAD_NAMES:
+                continue
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    name = dotted(kw.value).rsplit(".", 1)[-1]
+                    if name:
+                        yield node, name
+
+    @staticmethod
+    def _own_scope(fn: ast.AST) -> Iterator[ast.AST]:
+        """Walk a function body without descending into nested defs or
+        lambdas — their loops run in other call frames."""
+        stack: List[ast.AST] = list(fn.body)
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _broad_logging_try(self, node: ast.AST) -> bool:
+        """A Try with a broad (bare/Exception/BaseException) handler
+        that logs — the supervision this rule requires."""
+        if not isinstance(node, ast.Try):
+            return False
+        for handler in node.handlers:
+            types = ([] if handler.type is None else
+                     handler.type.elts if isinstance(handler.type, ast.Tuple)
+                     else [handler.type])
+            broad = handler.type is None or any(
+                dotted(t).rsplit(".", 1)[-1] in {"Exception", "BaseException"}
+                for t in types
+            )
+            if not broad:
+                continue
+            for sub in ast.walk(handler):
+                if isinstance(sub, ast.Call) and isinstance(
+                    sub.func, ast.Attribute
+                ) and sub.func.attr in SilentBroadExcept._LOG_ATTRS:
+                    return True
+        return False
+
+    def _loop_supervised(self, loop: ast.AST,
+                         parents: Dict[ast.AST, ast.AST],
+                         fn: ast.AST) -> bool:
+        # supervised inside: any broad-logging try within the loop body
+        for sub in ast.walk(loop):
+            if sub is not loop and self._broad_logging_try(sub):
+                return True
+        # supervised outside: a broad-logging try wrapping the loop
+        # (the thread then logs its own death instead of vanishing)
+        node = parents.get(loop)
+        while node is not None and node is not fn:
+            if self._broad_logging_try(node):
+                return True
+            node = parents.get(node)
+        return False
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        defs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+        seen: Set[ast.AST] = set()
+        for _call, target in self._thread_targets(ctx.tree):
+            for fn in defs.get(target, ()):
+                if fn in seen:
+                    continue
+                seen.add(fn)
+                parents = _parent_map(fn)
+                # every unsupervised loop is ITS OWN finding: a
+                # supervised main loop must not mask an unsupervised
+                # sibling (drain/retry) loop in the same thread body.
+                # Loops nested inside a flagged loop are skipped — one
+                # unsupervised body, one report.
+                flagged: List[ast.AST] = []
+                loops = sorted(
+                    (n for n in self._own_scope(fn)
+                     if isinstance(n, (ast.While, ast.For))),
+                    key=lambda n: (n.lineno, n.col_offset))
+                for loop in loops:
+                    if any(loop is not f and self._is_within(loop, f, parents)
+                           for f in flagged):
+                        continue
+                    if self._loop_supervised(loop, parents, fn):
+                        continue
+                    flagged.append(loop)
+                    yield Finding(
+                        self.id, ctx.path, loop.lineno, loop.col_offset,
+                        f"thread target `{fn.name}` runs a loop with no "
+                        "broad except-with-log — if an iteration raises, "
+                        "the background thread dies silently; wrap the "
+                        "loop body in try/except Exception with a "
+                        "log.exception call",
+                    )
+
+    @staticmethod
+    def _is_within(node: ast.AST, ancestor: ast.AST,
+                   parents: Dict[ast.AST, ast.AST]) -> bool:
+        cur = parents.get(node)
+        while cur is not None:
+            if cur is ancestor:
+                return True
+            cur = parents.get(cur)
+        return False

@@ -53,7 +53,10 @@ def start_upgrade_daemon(component: str = "pio", interval_sec: float = 86400.0) 
         import time
 
         while True:
-            check_upgrade(component)
+            try:
+                check_upgrade(component)
+            except Exception:  # noqa: BLE001 — the daemon must outlive any surprise
+                log.exception("upgrade check iteration failed")
             time.sleep(interval_sec)
 
     threading.Thread(target=loop, name="pio-upgrade-check", daemon=True).start()

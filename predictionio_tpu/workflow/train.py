@@ -25,7 +25,7 @@ from predictionio_tpu.core.params import EngineParams, params_to_dict
 from predictionio_tpu.core.persistent_model import PersistentModel, manifest_for
 from predictionio_tpu.data.metadata import EngineInstance, Model
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.obs import jaxmon, profiler
+from predictionio_tpu.obs import health, jaxmon, profiler
 from predictionio_tpu.parallel.mesh import MeshContext
 from predictionio_tpu.workflow.config import WorkflowParams
 
@@ -209,7 +209,12 @@ def run_train(
         import time as _time
 
         t_train = _time.perf_counter()
-        with _maybe_profile(instance.id):
+        # deadman watchdog over the training steps: the loops beat it
+        # via jaxmon.observe_train_step, so a step hanging beyond
+        # PIO_STALL_FACTOR x the trailing median fires a pio.stall log
+        # and an all-thread stack dump (PIO_FLIGHT_DIR) while the hang
+        # is still alive — not after the eventual kill
+        with health.TRAIN_WATCHDOG.deadman(), _maybe_profile(instance.id):
             result: TrainResult = engine.train(ctx, engine_params, wp)
         # whole-train wall time + post-train device memory (the peak a
         # donation/HBM regression would move) on /metrics and `pio

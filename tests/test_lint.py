@@ -37,7 +37,7 @@ def rule_ids(findings):
 
 def test_all_rules_registered():
     assert {"JT01", "JT02", "JT03", "JT04", "JT05", "JT06",
-            "JT07", "JT08"} <= set(RULES)
+            "JT07", "JT08", "JT09"} <= set(RULES)
 
 
 def test_syntax_error_is_reported_not_raised(tmp_path):
@@ -593,3 +593,136 @@ def test_json_output_shape(tmp_path):
     payload = json.loads(proc.stdout)
     assert payload["findings"] == []
     assert payload["files_scanned"] > 0
+
+
+# -- JT09 unsupervised-daemon-thread -------------------------------------------
+
+def test_jt09_positive_bare_loop_thread(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import threading
+
+        def _loop():
+            while True:
+                do_work()
+
+        threading.Thread(target=_loop, daemon=True).start()
+    """)
+    assert rule_ids(findings) == ["JT09"]
+    assert "_loop" in findings[0].message
+
+
+def test_jt09_positive_method_target_and_narrow_except(tmp_path):
+    # a narrow except (queue.Empty) is flow control, not supervision —
+    # any other exception still kills the thread silently
+    findings = lint_src(tmp_path, """\
+        import queue
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def _run(self):
+                while not self.stopped:
+                    try:
+                        item = self.q.get_nowait()
+                    except queue.Empty:
+                        continue
+                    self.handle(item)
+    """)
+    assert rule_ids(findings) == ["JT09"]
+
+
+def test_jt09_negative_supervised_inside_loop(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import logging
+        import threading
+
+        log = logging.getLogger(__name__)
+
+        def _loop():
+            while True:
+                try:
+                    do_work()
+                except Exception:
+                    log.exception("iteration failed")
+
+        threading.Thread(target=_loop, daemon=True).start()
+    """)
+    assert findings == []
+
+
+def test_jt09_negative_supervised_around_loop(tmp_path):
+    # a broad-except-log WRAPPING the loop still logs the thread's
+    # death — not silent, so not a finding
+    findings = lint_src(tmp_path, """\
+        import logging
+        import threading
+
+        log = logging.getLogger(__name__)
+
+        def _run():
+            try:
+                while True:
+                    step()
+            except Exception:
+                log.exception("worker died")
+
+        threading.Thread(target=_run).start()
+    """)
+    assert findings == []
+
+
+def test_jt09_negative_looplss_target_and_external_callable(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import threading
+
+        def _once():
+            send_one_request()
+
+        def start(server):
+            threading.Thread(target=_once, daemon=True).start()
+            threading.Thread(target=server.serve_forever, daemon=True).start()
+    """)
+    assert findings == []
+
+
+def test_jt09_nested_def_loops_do_not_leak_into_target(tmp_path):
+    # the helper's loop runs in whoever CALLS it — the thread target
+    # itself has no loop of its own
+    findings = lint_src(tmp_path, """\
+        import threading
+
+        def _target():
+            def helper(items):
+                for i in items:
+                    use(i)
+            register(helper)
+
+        threading.Thread(target=_target).start()
+    """)
+    assert findings == []
+
+
+def test_jt09_supervised_loop_does_not_mask_sibling(tmp_path):
+    # one supervised loop + one bare sibling loop in the same thread
+    # body: the bare one is still a finding (per-loop reporting)
+    findings = lint_src(tmp_path, """\
+        import logging
+        import threading
+
+        log = logging.getLogger(__name__)
+
+        def _run():
+            while True:
+                try:
+                    serve_one()
+                except Exception:
+                    log.exception("iteration failed")
+            while True:
+                drain_one()
+
+        threading.Thread(target=_run).start()
+    """)
+    assert rule_ids(findings) == ["JT09"]
+    assert findings[0].line == 12  # the drain loop, not the main one
