@@ -412,7 +412,8 @@ def _serve_stage(storage, factors, pd, cfg, detail):
         import socket
 
         def connect():
-            c = http.client.HTTPConnection("127.0.0.1", server.port)
+            c = http.client.HTTPConnection("127.0.0.1", server.port,
+                                           timeout=60)
             c.connect()
             # what every production HTTP client (curl, urllib3) does;
             # stdlib http.client leaves Nagle on
@@ -635,7 +636,7 @@ def stage_loadgen(config_json):
 
     def worker(tid):
         try:
-            sock = socket.create_connection(("127.0.0.1", port))
+            sock = socket.create_connection(("127.0.0.1", port), 60)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             rfile = sock.makefile("rb")
             # per-connection warm-up OUTSIDE the timed region (TCP

@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import json
 import logging
-import random
 import time
 import urllib.error
 import urllib.request
@@ -30,6 +29,11 @@ from typing import Any, Dict, List, Optional
 
 from predictionio_tpu.data.event import Event
 from predictionio_tpu.data import metadata as MD
+from predictionio_tpu.resilience.policy import (
+    CircuitOpenError,
+    Policy,
+    breaker_for,
+)
 from predictionio_tpu.data.metadata import (
     AccessKey,
     App,
@@ -43,6 +47,16 @@ from predictionio_tpu.data import storage as S
 from predictionio_tpu.obs import trace
 
 log = logging.getLogger(__name__)
+
+
+class StorageCircuitOpenError(S.StorageUnavailableError):
+    """Unavailable because the endpoint's circuit is OPEN: retrying the
+    SAME endpoint is guaranteed to fail fast again until the half-open
+    window, so same-endpoint retry loops must give up immediately —
+    that is the breaker's whole fail-fast contract. Replica failover
+    (a DIFFERENT endpoint) still proceeds: this subclasses
+    StorageUnavailableError, so `_first_live` advances past a
+    circuit-broken replica like any other dead one."""
 
 
 def _span_name(path: str) -> str:
@@ -64,12 +78,20 @@ class _Transport:
     """One storage-server endpoint + auth; shared by all proxy DAOs.
 
     Resilience (the role HBase's client plays with its connection pool
-    and bounded retries, hbase/StorageClient.scala): connection-level
+    and bounded retries, hbase/StorageClient.scala), now carried by the
+    framework-wide resilience :class:`Policy`: connection-level
     failures — refused, reset, timed out — are classified as
     StorageUnavailableError and, for IDEMPOTENT operations, retried
-    with capped exponential backoff + jitter. Non-idempotent writes
-    (event/metadata inserts) never auto-retry: their first attempt's
-    outcome is unknown, and a blind replay could double-write."""
+    with capped exponential backoff + FULL jitter. Non-idempotent
+    writes (event/metadata inserts) never auto-retry: their first
+    attempt's outcome is unknown, and a blind replay could
+    double-write. Every request also runs through this endpoint's
+    circuit breaker: after enough consecutive connection failures the
+    circuit opens and calls fail FAST (StorageUnavailableError without
+    a connect attempt) until a half-open probe succeeds — a dead
+    storage server costs microseconds, not timeout x retries, which is
+    what lets the engine server flip to degraded mode instead of
+    stalling."""
 
     def __init__(self, base_url: str, auth_key: Optional[str], timeout: float,
                  retries: int = 3, backoff: float = 0.2):
@@ -78,6 +100,9 @@ class _Transport:
         self.timeout = timeout
         self.retries = max(0, int(retries))
         self.backoff = backoff
+        self.policy = Policy(deadline=timeout, retries=self.retries,
+                             backoff_base=backoff, backoff_cap=10.0)
+        self.breaker = breaker_for(self.base_url)
 
     def _request_obj(self, path, body, method, content_type) -> urllib.request.Request:
         req = urllib.request.Request(
@@ -117,7 +142,15 @@ class _Transport:
         return err
 
     def _sleep_backoff(self, attempt: int) -> None:
-        time.sleep(self.backoff * (2 ** attempt) * (1 + random.random()))
+        # the outer scan/fetch retry loops share the policy's jittered
+        # schedule (full jitter: spreads a retry storm instead of
+        # synchronizing it)
+        time.sleep(self.policy.backoff_seconds(attempt))
+
+    def _circuit_open_error(self, e: CircuitOpenError) -> S.StorageError:
+        return StorageCircuitOpenError(
+            f"storage server {self.base_url} unreachable (circuit open, "
+            f"next probe in {e.retry_after:.1f}s)")
 
     def request(
         self,
@@ -132,45 +165,51 @@ class _Transport:
         the server marks it as a data miss (``{"missing": true}``); a
         bare 404 means route/version skew and raises StorageError, so it
         can never masquerade as empty data. Connection-level failures
-        raise StorageUnavailableError, after bounded retries when
-        ``idempotent``."""
-        attempts = 1 + (self.retries if idempotent else 0)
+        raise StorageUnavailableError — after the policy's bounded
+        retries when ``idempotent``, immediately (fail-fast, no connect)
+        while the endpoint's circuit is open."""
         with trace.span(_span_name(path), endpoint=self.base_url):
-            return self._request_attempts(
-                attempts, path, body, method, content_type, timeout)
-
-    def _request_attempts(self, attempts, path, body, method, content_type,
-                          timeout):
-        last: Optional[S.StorageError] = None
-        for attempt in range(attempts):
-            if attempt:
-                self._sleep_backoff(attempt - 1)
-            req = self._request_obj(path, body, method, content_type)
             try:
-                with urllib.request.urlopen(
-                    req, timeout=timeout if timeout is not None else self.timeout
-                ) as resp:
-                    return resp.status, resp.read()
-            except urllib.error.HTTPError as e:
-                if e.code == 404:
-                    payload = e.read()
-                    try:
-                        missing = json.loads(payload).get("missing", False)
-                    except Exception:  # noqa: BLE001
-                        missing = False
-                    if missing:
-                        return 404, payload
-                    raise S.StorageError(
-                        f"storage server {self.base_url}{path}: unknown route "
-                        "(server/client version skew?)"
-                    ) from None
-                raise self._error(path, e) from None
-            except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
-                reason = getattr(e, "reason", e)
-                last = S.StorageUnavailableError(
-                    f"storage server {self.base_url} unreachable: {reason}"
+                return self.policy.run(
+                    lambda: self._one_attempt(path, body, method,
+                                              content_type, timeout),
+                    target=self.base_url,  # per-endpoint retry metrics
+                    idempotent=idempotent,
+                    retry_on=(S.StorageUnavailableError,),
+                    breaker=self.breaker,
                 )
-        raise last from None
+            except CircuitOpenError as e:
+                raise self._circuit_open_error(e) from None
+
+    def _one_attempt(self, path, body, method, content_type, timeout):
+        req = self._request_obj(path, body, method, content_type)
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout if timeout is not None else self.timeout
+            ) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            # an HTTP answer means the server is UP: these are
+            # application errors — never retried, invisible to the
+            # breaker's consecutive-failure count
+            if e.code == 404:
+                payload = e.read()
+                try:
+                    missing = json.loads(payload).get("missing", False)
+                except Exception:  # noqa: BLE001
+                    missing = False
+                if missing:
+                    return 404, payload
+                raise S.StorageError(
+                    f"storage server {self.base_url}{path}: unknown route "
+                    "(server/client version skew?)"
+                ) from None
+            raise self._error(path, e) from None
+        except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
+            reason = getattr(e, "reason", e)
+            raise S.StorageUnavailableError(
+                f"storage server {self.base_url} unreachable: {reason}"
+            ) from None
 
     def json_call(self, path: str, payload: Dict[str, Any],
                   idempotent: bool = False) -> Any:
@@ -184,15 +223,23 @@ class _Transport:
         """Yield non-empty response lines without buffering the body
         (the server chunk-streams finds; urllib decodes transparently).
         Connection failures — at connect or mid-stream — raise
-        StorageUnavailableError so read callers can retry the scan."""
+        StorageUnavailableError so read callers can retry the scan.
+        Streaming cannot run inside ``Policy.run`` (the generator
+        outlives the call), so the breaker is applied by hand: fail
+        fast while open, one failure/success record per stream."""
+        if not self.breaker.allow():
+            raise self._circuit_open_error(
+                CircuitOpenError(self.base_url, self.breaker.retry_after()))
         req = self._request_obj(
             path, json.dumps(payload).encode(), "POST", "application/json"
         )
         try:
             resp = urllib.request.urlopen(req, timeout=self.timeout)
         except urllib.error.HTTPError as e:
+            self.breaker.record_success()  # an HTTP answer: reachable
             raise self._error(path, e) from None
         except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
+            self.breaker.record_failure()
             raise S.StorageUnavailableError(
                 f"storage server {self.base_url} unreachable: "
                 f"{getattr(e, 'reason', e)}"
@@ -205,10 +252,12 @@ class _Transport:
                         yield line
         except (urllib.error.URLError, ConnectionError, TimeoutError,
                 IncompleteRead) as e:
+            self.breaker.record_failure()
             raise S.StorageUnavailableError(
                 f"storage server {self.base_url}: connection lost "
                 f"mid-stream: {getattr(e, 'reason', e)}"
             ) from None
+        self.breaker.record_success()
 
 
 class RestEventStore(S.EventStore):
@@ -381,6 +430,11 @@ class RestEventStore(S.EventStore):
                         for line in self._t.stream_lines(
                             "/storage/events/find", payload)
                     ]
+                except StorageCircuitOpenError:
+                    # guaranteed to fail fast again until the half-open
+                    # window: backoff-sleeping against it would defeat
+                    # the breaker (failover happens a layer up)
+                    raise
                 except S.StorageUnavailableError as e:
                     last = e
             raise last
@@ -465,7 +519,12 @@ class RestEventStore(S.EventStore):
         re-prepares)."""
         received = 0
         failures = 0
+        breaker = self._t.breaker
         while received < total:
+            if not breaker.allow():
+                raise StorageCircuitOpenError(
+                    f"storage server {self._t.base_url} unreachable "
+                    f"(circuit open mid-scan, {received}/{total} bytes)")
             req = self._t._request_obj(
                 f"/storage/events/scan/{scan_id}?offset={received}",
                 None, "GET", "application/octet-stream",
@@ -479,12 +538,15 @@ class RestEventStore(S.EventStore):
                         spool.write(chunk)
                         received += len(chunk)
                         failures = 0
+                breaker.record_success()
             except urllib.error.HTTPError as e:
+                breaker.record_success()  # an HTTP answer: reachable
                 if e.code == 404:
                     return False
                 raise self._t._error(f"/storage/events/scan/{scan_id}", e) from None
             except (urllib.error.URLError, ConnectionError, TimeoutError,
                     IncompleteRead):
+                breaker.record_failure()
                 failures += 1
                 if failures > self._t.retries:
                     raise S.StorageUnavailableError(

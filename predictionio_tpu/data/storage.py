@@ -31,6 +31,7 @@ if TYPE_CHECKING:
 
 from predictionio_tpu.data.datamap import PropertyMap
 from predictionio_tpu.data.event import Event
+from predictionio_tpu.resilience import chaos
 from predictionio_tpu.data.metadata import (
     AccessKey,
     App,
@@ -791,6 +792,12 @@ class Storage:
         self._repo_to_source = repo_to_source
 
     def client_for(self, repo: str) -> StorageClient:
+        # the chaos harness's storage seam: every repository access —
+        # DAO lookups, health probes, model loads — funnels through
+        # here, so injected latency/errors/hangs hit local AND network
+        # backends identically (resilience/chaos.py; ChaosError is a
+        # ConnectionError, indistinguishable from a real outage)
+        chaos.inject("storage")
         source = self._repo_to_source.get(repo.upper())
         if source is None or source not in self._clients:
             raise StorageError(f"repository {repo} has no configured source")

@@ -71,15 +71,35 @@ class MetricsPusher:
             self._thread.join(timeout=self.timeout + 1.0)
 
     def push_once(self) -> bool:
-        """One push attempt; True on a 2xx answer. Raises nothing."""
+        """One push attempt; True on a 2xx answer. Raises nothing.
+
+        Runs under the resilience policy with retries=0 — the loop's
+        cadence backoff IS this call's retry schedule (stacking a
+        per-push retry budget under it would multiply the probing of a
+        dead sink) — so the push path still gets the explicit deadline
+        and the ``push`` circuit breaker's fail-fast + state gauge."""
+        from predictionio_tpu.resilience.policy import Policy
+
         body = metrics.REGISTRY.render_openmetrics().encode()
         req = urllib.request.Request(
             self.url, data=body, method="POST",
             headers={"Content-Type": metrics.OPENMETRICS_CONTENT_TYPE},
         )
+
+        def attempt() -> bool:
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                    return 200 <= resp.status < 300
+            except urllib.error.HTTPError as e:
+                # an HTTP error body is an ANSWER: the sink is up but
+                # rejecting — no breaker failure, cadence backoff still
+                # applies via the False return
+                log.debug("metrics push to %s rejected: %d", self.url, e.code)
+                return False
+
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                ok = 200 <= resp.status < 300
+            ok = bool(Policy(deadline=self.timeout, retries=0).run(
+                attempt, target="push"))
         except Exception as e:  # noqa: BLE001 — a dead sink must not raise
             log.debug("metrics push to %s failed: %s", self.url, e)
             ok = False

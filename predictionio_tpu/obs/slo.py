@@ -25,10 +25,29 @@ current. Tests feed synthetic samples directly via ``record()``.
 Surfaces: ``GET /admin/slo`` on every server (serving/http.py),
 ``pio slo`` in the CLI, and the dashboard's ``/slo`` panel.
 
+Alert DELIVERY: ``add_alert_listener`` registers a callback invoked on
+every alert transition (ok -> firing, firing -> resolved) during
+evaluation — the resilience webhook sink (resilience/alerts.py)
+subscribes here, and the engine server's admission controller reads
+the resulting ``pio_slo_burn_rate`` gauge.
+
+Declarative objectives: operators page on THEIR objectives, not the
+defaults — :func:`configure` applies an ``slo`` block (an engine.json
+top-level ``"slo"`` object, or a standalone JSON file named by
+``PIO_SLO_FILE``, loaded at server start):
+
+    {"latency_ms": 50, "latency_objective": 0.999,
+     "availability_objective": 0.995,
+     "shed": {"queue_depth": 128, "inflight": 64, "burn": 10.0}}
+
+(the ``shed`` block is consumed by the engine server's admission
+controller; this module applies the objective keys.)
+
 Config (all env):
   PIO_SLO_LATENCY_MS              latency threshold (default 100)
   PIO_SLO_LATENCY_OBJECTIVE       fraction under threshold (default 0.99)
   PIO_SLO_AVAILABILITY_OBJECTIVE  fraction non-5xx (default 0.999)
+  PIO_SLO_FILE                    JSON file with the block above
 """
 
 from __future__ import annotations
@@ -132,21 +151,67 @@ class SLO:
 
 
 def default_slos() -> List[SLO]:
+    return slos_from_config({})
+
+
+def slos_from_config(config: Dict[str, Any]) -> List[SLO]:
+    """The two framework SLOs, with a declarative block's overrides
+    applied over the env defaults."""
     return [
         SLO(
             name="serving-latency",
             kind="latency",
             metric="pio_serving_request_seconds",
-            objective=metrics.env_float("PIO_SLO_LATENCY_OBJECTIVE", 0.99),
-            threshold_ms=metrics.env_float("PIO_SLO_LATENCY_MS", 100.0),
+            objective=float(config.get(
+                "latency_objective",
+                metrics.env_float("PIO_SLO_LATENCY_OBJECTIVE", 0.99))),
+            threshold_ms=float(config.get(
+                "latency_ms",
+                metrics.env_float("PIO_SLO_LATENCY_MS", 100.0))),
         ),
         SLO(
             name="http-availability",
             kind="availability",
             metric="pio_http_requests_total",
-            objective=metrics.env_float("PIO_SLO_AVAILABILITY_OBJECTIVE", 0.999),
+            objective=float(config.get(
+                "availability_objective",
+                metrics.env_float("PIO_SLO_AVAILABILITY_OBJECTIVE", 0.999))),
         ),
     ]
+
+
+# -- alert transition listeners ------------------------------------------------
+
+_alert_listeners: List[Any] = []
+_alert_listeners_lock = threading.Lock()
+
+
+def add_alert_listener(fn) -> None:
+    """Register ``fn(slo_name, firing, entry_dict)`` to run on every
+    alert transition any monitor evaluates (the delivery seam the
+    webhook sink plugs into)."""
+    with _alert_listeners_lock:
+        if fn not in _alert_listeners:
+            _alert_listeners.append(fn)
+
+
+def remove_alert_listener(fn) -> None:
+    with _alert_listeners_lock:
+        if fn in _alert_listeners:
+            _alert_listeners.remove(fn)
+
+
+def _notify_alert(name: str, firing: bool, entry: Dict[str, Any]) -> None:
+    with _alert_listeners_lock:
+        listeners = list(_alert_listeners)
+    for fn in listeners:
+        try:
+            fn(name, firing, entry)
+        except Exception:  # noqa: BLE001 — a broken sink must not break evaluation
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "SLO alert listener failed for %s", name)
 
 
 def burn_rate(samples: List[Tuple[float, float, float]],
@@ -184,17 +249,40 @@ class SLOMonitor:
 
     def __init__(self, slos: Optional[List[SLO]] = None):
         self._lock = threading.Lock()
+        # serializes transition detection + listener notification so
+        # concurrent evaluations (snapshot cadence vs /admin/slo reads)
+        # can never deliver firing/resolved to a sink out of order
+        self._transition_lock = threading.Lock()
         self._slos: Dict[str, SLO] = {}
         self._samples: Dict[str, "collections.deque"] = {}
+        self._firing: Dict[str, bool] = {}
         self._last_tick = 0.0
         for slo in (slos if slos is not None else default_slos()):
             self.add(slo)
 
     def add(self, slo: SLO) -> None:
         with self._lock:
+            prior = self._slos.get(slo.name)
             self._slos[slo.name] = slo
-            self._samples.setdefault(
+            series = self._samples.setdefault(
                 slo.name, collections.deque(maxlen=SAMPLE_CAPACITY))
+            if prior is not None and prior != slo:
+                # a changed objective invalidates the old samples' good
+                # counts (good is threshold-dependent for latency SLOs)
+                series.clear()
+
+    def replace(self, slos: List[SLO]) -> None:
+        """Swap the monitored SLO set (declarative reconfiguration);
+        series for unchanged SLOs are kept."""
+        with self._lock:
+            keep = {s.name for s in slos}
+            for name in list(self._slos):
+                if name not in keep:
+                    del self._slos[name]
+                    self._samples.pop(name, None)
+                    self._firing.pop(name, None)
+        for slo in slos:
+            self.add(slo)
 
     def slos(self) -> List[SLO]:
         with self._lock:
@@ -263,6 +351,19 @@ class SLOMonitor:
             if slo.threshold_ms is not None:
                 entry["threshold_ms"] = slo.threshold_ms
             out.append(entry)
+            # transition detection: notify listeners on ok->firing and
+            # firing->resolved edges only (no_data never resolves a
+            # page). The compare-set-notify triple is atomic under the
+            # transition lock: two racing evaluations with opposite
+            # verdicts still deliver a sequence consistent with the
+            # recorded state, never resolved-before-firing.
+            with self._transition_lock:
+                with self._lock:
+                    was = self._firing.get(slo.name, False)
+                    if state != "no_data":
+                        self._firing[slo.name] = firing
+                if state != "no_data" and firing != was:
+                    _notify_alert(slo.name, firing, entry)
         return {"generated_unix": round(now, 3), "slos": out}
 
     def report(self, now: Optional[float] = None) -> Dict[str, Any]:
@@ -274,6 +375,7 @@ class SLOMonitor:
         with self._lock:
             for series in self._samples.values():
                 series.clear()
+            self._firing.clear()
             self._last_tick = 0.0
 
 
@@ -292,6 +394,48 @@ def _pair_firing(windows: Dict[str, Optional[float]],
 #: the process-global monitor every server's /admin/slo reads
 MONITOR = SLOMonitor()
 
+
+def configure(config: Dict[str, Any]) -> None:
+    """Apply a declarative SLO block (see module docstring) to the
+    process-global monitor. The ``shed`` sub-block is NOT consumed
+    here — the engine server's admission controller reads it."""
+    MONITOR.replace(slos_from_config(config or {}))
+
+
+_file_config: Optional[Dict[str, Any]] = None
+_file_config_path: Optional[str] = None
+_file_lock = threading.Lock()
+
+
+def configure_from_env() -> Optional[Dict[str, Any]]:
+    """Load ``PIO_SLO_FILE`` (once per path) into the global monitor
+    and return the parsed block — callers that own shedding thresholds
+    (the engine server) read the ``shed`` key off the result. Called
+    by every server's ``start()``; a malformed file fails LOUDLY (a
+    silently ignored objectives file means paging on the wrong
+    numbers)."""
+    import json as _json
+    import os as _os
+
+    global _file_config, _file_config_path
+    path = _os.environ.get("PIO_SLO_FILE")
+    if not path:
+        return None
+    with _file_lock:
+        if path == _file_config_path:
+            return _file_config
+        with open(path) as f:
+            config = _json.load(f)
+        if not isinstance(config, dict):
+            raise ValueError(f"PIO_SLO_FILE {path}: expected a JSON object")
+        configure(config)
+        _file_config, _file_config_path = config, path
+        return config
+
 # ride the flight recorder's snapshot cadence: one sample per interval
-# while traffic flows, without a thread of our own
-flight.add_snapshot_listener(lambda: MONITOR.tick())
+# while traffic flows, without a thread of our own. EVALUATE on the
+# same cadence — evaluation is what refreshes the burn-rate gauges
+# (the admission controller's shed signal) and fires alert transitions
+# (the webhook sink); sampling alone would leave both dead on an
+# unattended server until someone happened to poll /admin/slo.
+flight.add_snapshot_listener(lambda: (MONITOR.tick(), MONITOR.evaluate()))

@@ -39,8 +39,12 @@ from urllib.parse import urlparse
 
 from predictionio_tpu.core.engine import Engine
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.obs import flight, health, metrics, trace
+from predictionio_tpu.obs import flight, health, metrics, slo as slo_mod, trace
 from predictionio_tpu.parallel.mesh import MeshContext
+from predictionio_tpu.resilience import chaos
+from predictionio_tpu.resilience.admission import AdmissionController
+from predictionio_tpu.resilience.policy import CLOSED as _BREAKER_CLOSED
+from predictionio_tpu.resilience.policy import breaker_for
 from predictionio_tpu.serving.http import HTTPServerBase, JSONRequestHandler
 from predictionio_tpu.workflow.deploy import Deployment, prepare_deploy
 
@@ -63,6 +67,19 @@ _SERVING_SECONDS = metrics.histogram(
 #: dispatches have built a trailing median, fires when one exceeds
 #: PIO_STALL_FACTOR x that median (floor 1s x factor)
 _DISPATCH_WATCHDOG = health.Watchdog("serving_dispatch")
+
+
+def _http_inflight() -> float:
+    """Requests currently inside this engine server (the shared HTTP
+    layer's in-flight gauge) — the admission controller's concurrency
+    signal. The label is derived from the handler's server_version the
+    same way serving/http.py derives it, so a rename cannot silently
+    point this at an untouched gauge child reading 0.0 forever."""
+    family = metrics.REGISTRY.get("pio_http_requests_in_flight")
+    if family is None:
+        return 0.0
+    label = _EngineRequestHandler.server_version.split("/", 1)[0]
+    return family.labels(label).value
 
 
 class ServingStats:
@@ -261,6 +278,11 @@ class MicroBatcher:
                     except _queue.Empty:
                         break
                 with _DISPATCH_WATCHDOG.watch():
+                    # chaos seam: injected latency/hangs land INSIDE the
+                    # dispatch watchdog's watch window (a chaos hang is
+                    # what tier-1 uses to prove the watchdog still
+                    # fires), injected errors fail this batch's waiters
+                    chaos.inject("batcher")
                     self._answer(batch)
             except Exception as e:  # noqa: BLE001 — a dead worker starves
                 # every future submitter silently; log, fail THIS batch's
@@ -397,6 +419,11 @@ class MicroBatcher:
             items = list(self._splits)
         return items[-n:]
 
+    def queue_depth(self) -> int:
+        """Requests waiting for the worker right now (the admission
+        controller's primary shed signal)."""
+        return self._queue.qsize()
+
 
 class EngineServer(HTTPServerBase):
     """One deployed engine behind HTTP (ref: CreateServer.scala:100,106)."""
@@ -417,6 +444,7 @@ class EngineServer(HTTPServerBase):
         bind_retries: int = 3,
         micro_batch: bool = True,
         max_batch: int = 64,
+        slo_conf: Optional[dict] = None,
     ):
         self.engine = engine
         self.engine_id = engine_id
@@ -429,12 +457,43 @@ class EngineServer(HTTPServerBase):
         self.log_url = log_url
         self.stats = ServingStats(engine_id)
         self._deployment_lock = threading.Lock()
+        # degraded-mode circuit: fed by readiness storage probes and
+        # reloads. While not closed, queries keep answering from the
+        # last-loaded model with an X-PIO-Degraded stamp and /readyz
+        # reports DEGRADED (not FAILED) — losing storage must not read
+        # as losing the server.
+        self._storage_breaker = breaker_for(f"storage:{engine_id}",
+                                            failure_threshold=2)
         self.deployment: Deployment = self._load_latest()
         self._batcher: Optional[MicroBatcher] = (
             MicroBatcher(self._query_batch_now, self._query_now,
                          max_batch=max_batch)
             if micro_batch else None
         )
+
+        # admission control (resilience tentpole): shed with 429 +
+        # Retry-After from queue depth / in-flight / SLO burn signals
+        # BEFORE queueing collapse. Thresholds: env defaults, then the
+        # PIO_SLO_FILE "shed" block, then the engine.json "slo.shed"
+        # block (most specific wins).
+        file_conf = slo_mod.configure_from_env() or {}
+        if slo_conf:
+            # layer the variant block OVER the file's objectives — a
+            # variant that only overrides availability must not silently
+            # drop the file's latency threshold back to env defaults
+            slo_mod.configure({**file_conf, **slo_conf})
+        self.admission = AdmissionController(
+            "engine",
+            queue_depth=lambda: (self._batcher.queue_depth()
+                                 if self._batcher is not None else None),
+            inflight=_http_inflight,
+            max_queue_depth=metrics.env_int("PIO_SHED_QUEUE_DEPTH",
+                                            max_batch * 4),
+        )
+        for conf in (file_conf, slo_conf or {}):
+            shed = conf.get("shed") if isinstance(conf, dict) else None
+            if shed:
+                self.admission.configure(shed)
 
         # daily version check, no-op unless PIO_UPDATE_URL is configured
         # (ref: UpgradeActor, CreateServer.scala:163-170,246)
@@ -476,11 +535,60 @@ class EngineServer(HTTPServerBase):
     def reload(self) -> str:
         """Hot-swap to the latest completed instance (ref: /reload :592).
         The swap happens only after the new deployment is warm — live
-        traffic never waits on the new model's compiles."""
-        deployment = self._load_latest()
+        traffic never waits on the new model's compiles. A reload that
+        fails on storage feeds the degraded-mode circuit; one that
+        succeeds closes it (recovery path)."""
+        from predictionio_tpu.data.storage import StorageError
+
+        try:
+            deployment = self._load_latest()
+        except (StorageError, ConnectionError):
+            self._storage_breaker.record_failure()
+            raise
+        self._storage_breaker.record_success()
         with self._deployment_lock:
             self.deployment = deployment
         return deployment.instance.id
+
+    # -- degraded mode ------------------------------------------------------
+    def degraded_reason(self) -> Optional[str]:
+        """Non-None while serving degraded: the storage circuit is not
+        closed, so the last-loaded model answers queries but reloads
+        and feedback durability cannot be trusted. The string is the
+        ``X-PIO-Degraded`` response header."""
+        if self._storage_breaker.state == _BREAKER_CLOSED:
+            return None
+        with self._deployment_lock:
+            instance_id = self.deployment.instance.id
+        return ("storage unavailable; serving last-loaded instance "
+                f"{instance_id}")
+
+    def storage_readyz_probe(self) -> health.ProbeResult:
+        """The engine server's ``/readyz`` storage probe (the shared
+        handler prefers this hook over the default
+        ``health.storage_probe``): storage loss while a model is loaded
+        is DEGRADED, not FAILED — the server can still do its one job
+        (answer queries); it cannot reload or verify freshness. The
+        probe feeds the degraded-mode circuit: consecutive failures
+        open it (after which probes fail FAST instead of stalling every
+        readiness check on a dead backend), and the half-open probe's
+        eventual success closes it — recovery needs no restart."""
+        breaker = self._storage_breaker
+        if not breaker.allow():
+            return health.degraded(
+                f"storage circuit open (next probe in "
+                f"{breaker.retry_after():.0f}s); {self.degraded_reason()}")
+        try:
+            result = health.storage_probe(self.storage)
+        except Exception as e:  # noqa: BLE001 — a raising probe IS the finding
+            result = health.failed(f"{type(e).__name__}: {e}")
+        if result.status == health.FAILED:
+            breaker.record_failure()
+            return health.degraded(
+                f"{result.reason}; serving degraded from the last-loaded "
+                "model")
+        breaker.record_success()
+        return result
 
     # -- query path ---------------------------------------------------------
     def _query_now(self, payload: Any) -> Any:
@@ -588,6 +696,10 @@ class EngineServer(HTTPServerBase):
             # (None when micro-batching is disabled)
             "batcher": (self._batcher.histogram()
                         if self._batcher is not None else None),
+            # resilience surface: shed limits/counters + degraded mode
+            "admission": self.admission.snapshot(),
+            "degraded": self.degraded_reason(),
+            "storageCircuit": self._storage_breaker.snapshot(),
         }
 
 
@@ -655,12 +767,34 @@ class _EngineRequestHandler(JSONRequestHandler):
             except RuntimeError as e:
                 self.server_ref.remote_log(f"reload failed: {e}")
                 self._send(404, {"message": str(e)})
+            except Exception as e:  # noqa: BLE001 — a dead backend must
+                # answer 503, not crash the keep-alive connection; the
+                # failure already fed the degraded-mode circuit
+                log.exception("reload failed")
+                self.server_ref.remote_log(
+                    f"reload failed: {type(e).__name__}: {e}")
+                self._send(503, {"message": f"reload failed: {e}"})
         else:
             self._send(404, {"message": "Not Found"})
 
     def do_POST(self):
         path = urlparse(self.path).path
         if path == "/queries.json":
+            # admission control FIRST — before the body parse, before
+            # any queue time: an overloaded server's cheapest work is
+            # saying no (429 + Retry-After), and the shed must be
+            # reconstructable (counter + flight record)
+            decision = self.server_ref.admission.check()
+            if decision is not None:
+                flight.note_field("shed", decision.reason)
+                self._send(
+                    429,
+                    {"message": "overloaded — retry after the advised "
+                                "delay", "reason": decision.reason,
+                     "detail": decision.detail,
+                     "retryAfterSec": decision.retry_after},
+                    extra_headers={"Retry-After": str(decision.retry_after)})
+                return
             try:
                 payload = self._read_json()
             except json.JSONDecodeError as e:
@@ -683,7 +817,10 @@ class _EngineRequestHandler(JSONRequestHandler):
                 )
                 self._send(500, {"message": str(e)})
                 return
-            self._send(200, result)
+            degraded = self.server_ref.degraded_reason()
+            self._send(200, result,
+                       extra_headers=({"X-PIO-Degraded": degraded}
+                                      if degraded else None))
         elif path == "/stop":
             self._send(200, {"message": "stopping"})
             self.server_ref.stop()

@@ -16,7 +16,9 @@ Every server also inherits the shared operator surface from the
   GET  /admin/flight     flight-recorder dump        } bearer-token
   POST /admin/profile    on-demand profiler window   } guarded when
   GET  /admin/slo        SLO burn-rate evaluation    } PIO_ADMIN_TOKEN
-                                                       is set
+  GET/POST /admin/chaos  fault-injection rule set    } is set
+  GET  /admin/resilience breaker/admission/chaos     }
+                         snapshot                    }
 
 ``/healthz``, ``/readyz`` and ``/metrics`` stay unauthenticated — a
 liveness prober or scraper holds no operator secrets; the ``/admin/*``
@@ -40,6 +42,8 @@ from urllib.parse import parse_qs, urlparse
 
 from predictionio_tpu.obs import (flight, health, metrics, profiler, push,
                                   slo, trace)
+from predictionio_tpu.resilience import alerts, chaos
+from predictionio_tpu.resilience import policy as respolicy
 
 log = logging.getLogger(__name__)
 
@@ -117,10 +121,17 @@ def _serve_readyz(handler) -> None:
     """``GET /readyz``: run the process health probes plus THIS
     server's storage probe; 200 while nothing FAILED (DEGRADED still
     serves — readiness is "can answer", not "is pristine"), 503 with
-    the same per-probe detail otherwise."""
+    the same per-probe detail otherwise. A server may override its
+    storage probe via a ``storage_readyz_probe`` method — the engine
+    server does, mapping storage loss to DEGRADED (it can still answer
+    queries from the last-loaded model)."""
     health.install_default_probes()
-    storage = _server_storage(handler.server_ref)
-    extra = {"storage": lambda: health.storage_probe(storage)}
+    override = getattr(handler.server_ref, "storage_readyz_probe", None)
+    if override is not None:
+        extra = {"storage": override}
+    else:
+        storage = _server_storage(handler.server_ref)
+        extra = {"storage": lambda: health.storage_probe(storage)}
     overall, detail = health.REGISTRY.run(extra=extra)
     status = 503 if overall == health.FAILED else 200
     handler._send(status, {"status": overall, "probes": detail})
@@ -183,6 +194,28 @@ def _serve_admin_profile(handler, query: str) -> None:
                         "backend": profiler.backend()})
 
 
+def _serve_admin_chaos(handler) -> None:
+    """``GET /admin/chaos``: the active fault-injection rule set.
+    ``POST /admin/chaos``: mutate it — ``{"spec": "..."}`` replaces,
+    ``{"add": "..."}`` appends, ``{"clear": true | "site"}`` drops
+    (resilience/chaos.py spec grammar). Admin-token-guarded like every
+    ``/admin/*`` route: fault injection against a production server is
+    an operator action, not a drive-by."""
+    if handler.command == "GET":
+        handler._send(200, chaos.describe())
+        return
+    if handler.command != "POST":
+        handler._send(405, {"message": "GET or POST"})
+        return
+    try:
+        payload = handler._read_json()
+        result = chaos.apply_admin(payload)
+    except (json.JSONDecodeError, ValueError) as e:
+        handler._send(400, {"message": str(e)})
+        return
+    handler._send(200, result)
+
+
 def _instrument(fn):
     """Wrap a do_METHOD handler: serve the shared routes (``GET
     /metrics``, ``GET /admin/flight``, ``POST /admin/profile``),
@@ -229,6 +262,21 @@ def _instrument(fn):
                 return
             if self.command == "GET" and path == "/admin/slo":
                 self._send(200, slo.MONITOR.report())
+                return
+            if path == "/admin/chaos":
+                _serve_admin_chaos(self)
+                return
+            if self.command == "GET" and path == "/admin/resilience":
+                # breaker states + admission snapshot (when the server
+                # has one) + active chaos: the one-stop degraded-mode
+                # diagnosis surface
+                admission = getattr(self.server_ref, "admission", None)
+                self._send(200, {
+                    "circuits": respolicy.breakers_snapshot(),
+                    "admission": (admission.snapshot()
+                                  if admission is not None else None),
+                    "chaos": chaos.describe(),
+                })
                 return
         # the inbound id is untrusted: anything not id-shaped (header
         # injection attempts, oversized strings) is re-minted, never
@@ -430,12 +478,23 @@ class HTTPServerBase:
     def port(self) -> int:
         return self.httpd.server_address[1]
 
+    @staticmethod
+    def _start_env_services() -> None:
+        """Env-driven process services every server boot wires up:
+        the metrics pusher, the SLO alert webhook sink, declarative
+        SLO objectives, and the chaos harness (all no-ops without
+        their env vars)."""
+        push.start_from_env()
+        alerts.start_from_env()
+        slo.configure_from_env()
+        chaos.configure_from_env()
+
     def start(self):
         # flag set BEFORE the thread is scheduled so a stop() racing
         # start() still runs shutdown() (which blocks until the serve
         # loop has run and exited) instead of closing the socket under it
         self._serving = True
-        push.start_from_env()  # no-op unless PIO_PUSH_URL is set
+        self._start_env_services()
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         self._thread.start()
         log.info("%s listening on %s", type(self).__name__, self.port)
@@ -443,7 +502,7 @@ class HTTPServerBase:
 
     def serve_forever(self) -> None:
         self._serving = True
-        push.start_from_env()
+        self._start_env_services()
         self.httpd.serve_forever()
 
     def stop(self) -> None:

@@ -258,6 +258,8 @@ def cmd_deploy(args) -> int:
         feedback_url=args.feedback_url,
         feedback_access_key=args.accesskey,
         log_url=args.log_url,
+        # the variant's declarative objectives + shedding thresholds
+        slo_conf=variant.slo_conf(),
     )
     _p(f"Engine {engine_id} deployed on {args.ip}:{server.port}")
     server.serve_forever()
@@ -655,6 +657,53 @@ def cmd_slo(args) -> int:
     return 1 if firing else 0
 
 
+def cmd_chaos(args) -> int:
+    """Inspect or toggle a live server's fault injection
+    (``/admin/chaos``, resilience/chaos.py): with no mutation flags,
+    print the active rule set; ``--set``/``--add``/``--clear`` change
+    it. The server applies changes process-wide — every seam (storage,
+    batcher, train) sees them immediately."""
+    import urllib.error
+    import urllib.request
+
+    body = {}
+    if args.clear is not None:
+        body["clear"] = args.clear
+    if args.set_spec is not None:
+        body["spec"] = args.set_spec
+    if args.add is not None:
+        body["add"] = args.add
+    url = args.url.rstrip("/") + "/admin/chaos"
+    if body:
+        req = urllib.request.Request(
+            url, data=json.dumps(body).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+    else:
+        req = urllib.request.Request(url)
+    _add_admin_auth(req)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            state = json.load(resp)
+    except urllib.error.HTTPError as e:
+        raise CommandError(
+            f"chaos request failed ({e.code}): "
+            f"{e.read().decode(errors='replace')[:200]}")
+    except urllib.error.URLError as e:
+        raise CommandError(f"cannot reach {args.url}: {e.reason}")
+    if args.json:
+        json.dump(state, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+        return 0
+    if not state["enabled"]:
+        _p("chaos: no active rules")
+        return 0
+    _p(f"chaos ACTIVE ({len(state['rules'])} rule(s)): {state['spec']}")
+    for rule in state["rules"]:
+        unit = "" if rule["kind"] == "error" else "s"
+        _p(f"  {rule['site']:>10} {rule['kind']:<8} {rule['amount']:g}{unit}")
+    return 0
+
+
 def cmd_bench_compare(args) -> int:
     """Per-metric deltas across the bench trajectory (BENCH_r*.json):
     newest round vs the previous (or --against first), REGRESSION/
@@ -669,7 +718,7 @@ def cmd_bench_compare(args) -> int:
 
 def cmd_lint(args) -> int:
     """graftlint: the JAX/TPU-aware static analysis over the tree
-    (rules JT01-JT09; tier-1 CI runs the same pass via tests/test_lint_clean.py)."""
+    (rules JT01-JT10; tier-1 CI runs the same pass via tests/test_lint_clean.py)."""
     from predictionio_tpu.tools.lint import run_cli
 
     try:
@@ -926,6 +975,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(func=cmd_slo)
 
     p = sub.add_parser(
+        "chaos",
+        help="inspect or toggle fault injection on a live server "
+             "(GET/POST /admin/chaos; resilience/chaos.py spec grammar "
+             "like storage:latency:50ms,storage:error:0.1)",
+    )
+    p.add_argument("--url", required=True,
+                   help="base URL of any PIO server (sends the "
+                        "PIO_ADMIN_TOKEN bearer header when set)")
+    p.add_argument("--set", dest="set_spec", default=None, metavar="SPEC",
+                   help="replace the active rule set with SPEC "
+                        "('' clears everything)")
+    p.add_argument("--add", default=None, metavar="SPEC",
+                   help="append SPEC's rules to the active set")
+    p.add_argument("--clear", nargs="?", const=True, default=None,
+                   metavar="SITE",
+                   help="drop every rule, or only SITE's")
+    p.add_argument("--json", action="store_true",
+                   help="dump the raw rule-set JSON")
+    p.set_defaults(func=cmd_chaos)
+
+    p = sub.add_parser(
         "bench-compare",
         help="compare the newest BENCH_r*.json round against a baseline; "
              "print per-metric deltas, exit 1 on regressions beyond the "
@@ -944,7 +1014,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(func=cmd_bench_compare)
 
     p = sub.add_parser("lint", help="run graftlint (JAX/TPU-aware static "
-                                    "analysis, rules JT01-JT09) over the tree")
+                                    "analysis, rules JT01-JT10) over the tree")
     p.add_argument("paths", nargs="*", default=[],
                    help="files/dirs (default: the installed package)")
     p.add_argument("--format", choices=["human", "json"], default="human")

@@ -20,6 +20,10 @@ reference — operator views of this process's diagnostics:
                            (obs/slo.py) — per SLO, the burn in every
                            window and whether the fast/slow page is
                            firing. JSON at /admin/slo.
+  GET /resilience       -> HTML panel of the resilience subsystem:
+                           circuit breaker states, shed counters and
+                           the active chaos rules of THIS process.
+                           JSON at /admin/resilience.
 """
 
 from __future__ import annotations
@@ -64,6 +68,10 @@ class _DashboardRequestHandler(JSONRequestHandler):
             return
         if path == "/slo":
             self._send_cors(200, self.server_ref.slo_html(),
+                            "text/html; charset=UTF-8")
+            return
+        if path == "/resilience":
+            self._send_cors(200, self.server_ref.resilience_html(),
                             "text/html; charset=UTF-8")
             return
         parts = [p for p in path.split("/") if p]
@@ -132,6 +140,7 @@ class DashboardServer(HTTPServerBase):
             '<a href="/flight?slow=1">slow/errored requests</a> · '
             '<a href="/admin/flight">JSON dump</a> · '
             '<a href="/slo">SLO burn rates</a> · '
+            '<a href="/resilience">resilience</a> · '
             '<a href="/metrics">metrics</a> · '
             '<a href="/readyz">readiness</a></p>'
             "</body></html>"
@@ -216,6 +225,57 @@ class DashboardServer(HTTPServerBase):
             "<table border='1'><tr><th>SLO</th><th>Kind</th>"
             f"<th>Objective</th>{header}<th>State</th></tr>"
             f"{''.join(rows)}</table></body></html>"
+        )
+
+
+    def resilience_html(self) -> str:
+        """Breaker states, shed counters and chaos rules of THIS
+        process (each serving process owns its breakers — fleet views
+        scrape ``pio_circuit_state`` instead)."""
+        from predictionio_tpu.obs import metrics as _metrics
+        from predictionio_tpu.resilience import chaos as _chaos
+        from predictionio_tpu.resilience import policy as _policy
+
+        color = {"closed": "#27ae60", "half_open": "#e67e22",
+                 "open": "#c0392b"}
+        circuit_rows = "".join(
+            '<tr><td>{t}</td><td style="color:{c};font-weight:bold">{s}'
+            "</td><td>{f}/{th}</td><td>{r:.0f}s</td></tr>".format(
+                t=html.escape(b["target"]),
+                c=color.get(b["state"], "#888"),
+                s=html.escape(b["state"]),
+                f=b["consecutive_failures"], th=b["failure_threshold"],
+                r=b["reset_timeout_sec"])
+            for b in _policy.breakers_snapshot()
+        ) or "<tr><td colspan='4'>no circuits yet</td></tr>"
+        shed_family = _metrics.REGISTRY.get("pio_shed_total")
+        shed_rows = ""
+        if shed_family is not None:
+            shed_rows = "".join(
+                f"<tr><td>{html.escape('/'.join(values))}</td>"
+                f"<td>{int(child.value)}</td></tr>"
+                for values, child in shed_family.children())
+        shed_rows = shed_rows or ("<tr><td colspan='2'>nothing shed"
+                                  "</td></tr>")
+        state = _chaos.describe()
+        chaos_line = (html.escape(state["spec"]) if state["enabled"]
+                      else "inactive")
+        return (
+            "<!DOCTYPE html><html><head><title>Resilience</title></head>"
+            "<body><h1>Resilience</h1>"
+            "<h2>Circuit breakers</h2>"
+            "<table border='1'><tr><th>Target</th><th>State</th>"
+            "<th>Failures</th><th>Reset</th></tr>"
+            f"{circuit_rows}</table>"
+            "<h2>Admission control (shed counters)</h2>"
+            "<table border='1'><tr><th>server/reason</th><th>shed</th>"
+            f"</tr>{shed_rows}</table>"
+            f"<h2>Chaos</h2><p><code>{chaos_line}</code> — toggle via "
+            "<code>pio chaos --url ... --set SPEC</code> or "
+            "<code>POST /admin/chaos</code>.</p>"
+            '<p><a href="/admin/resilience">JSON</a> · '
+            '<a href="/">index</a></p>'
+            "</body></html>"
         )
 
 

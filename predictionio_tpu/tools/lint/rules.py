@@ -1,4 +1,4 @@
-"""graftlint rules JT01-JT09: the TPU hazards this codebase has hit.
+"""graftlint rules JT01-JT10: the TPU hazards this codebase has hit.
 
 Each rule encodes a failure class with a concrete precedent in this
 tree's history (the bf16-Gramian divergence behind JT03 is recorded in
@@ -998,3 +998,52 @@ class UnsupervisedDaemonThread(Rule):
                 return True
             cur = parents.get(cur)
         return False
+
+
+# -- JT10 ----------------------------------------------------------------------
+
+@register
+class OutboundCallWithoutTimeout(Rule):
+    id = "JT10"
+    name = "outbound-call-without-timeout"
+    rationale = (
+        "An outbound network call with no explicit timeout blocks its "
+        "thread for as long as the peer cares to hold the socket: a "
+        "hung storage server strands a serving handler, a dead "
+        "metrics sink strands its daemon thread, and the watchdog "
+        "fires on a stall a deadline would have bounded. Every "
+        "urlopen/HTTPConnection/create_connection call must pass "
+        "timeout= (ideally from a resilience Policy's deadline)."
+    )
+
+    #: callable's last name component -> index of the positional slot
+    #: that carries the timeout (passing it positionally also counts)
+    _TIMEOUT_SLOT = {
+        "urlopen": 2,             # urlopen(url, data, timeout)
+        "HTTPConnection": 2,      # HTTPConnection(host, port, timeout)
+        "HTTPSConnection": 2,
+        "create_connection": 1,   # create_connection(address, timeout)
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func).rsplit(".", 1)[-1]
+            slot = self._TIMEOUT_SLOT.get(name)
+            if slot is None:
+                continue
+            if any(kw.arg == "timeout" for kw in node.keywords):
+                continue
+            if len(node.args) > slot:
+                continue  # timeout passed positionally
+            if any(isinstance(a, ast.Starred) for a in node.args) or any(
+                kw.arg is None for kw in node.keywords
+            ):
+                continue  # *args/**kwargs may carry it; not decidable
+            yield Finding(
+                self.id, ctx.path, node.lineno, node.col_offset,
+                f"`{name}` call without an explicit timeout — a hung "
+                "peer strands this thread forever; pass timeout= "
+                "(e.g. a resilience Policy's .deadline)",
+            )

@@ -215,6 +215,12 @@ def run_train(
         # and an all-thread stack dump (PIO_FLIGHT_DIR) while the hang
         # is still alive — not after the eventual kill
         with health.TRAIN_WATCHDOG.deadman(), _maybe_profile(instance.id):
+            # chaos seam: an injected train fault exercises the FAILED
+            # instance path below; an injected hang sits under the
+            # deadman (once step beats have built its history)
+            from predictionio_tpu.resilience import chaos
+
+            chaos.inject("train")
             result: TrainResult = engine.train(ctx, engine_params, wp)
         # whole-train wall time + post-train device memory (the peak a
         # donation/HBM regression would move) on /metrics and `pio

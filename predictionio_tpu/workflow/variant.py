@@ -124,3 +124,15 @@ class EngineVariant:
 
     def runtime_conf(self) -> Dict[str, str]:
         return dict(self.raw.get("runtimeConf") or self.raw.get("sparkConf") or {})
+
+    def slo_conf(self) -> Optional[Dict[str, Any]]:
+        """The variant's declarative ``"slo"`` block (objectives +
+        shedding thresholds, obs/slo.py module docstring), applied by
+        `pio deploy` so operators page — and shed — on their own
+        numbers. None when the variant declares none."""
+        block = self.raw.get("slo")
+        if block is None:
+            return None
+        if not isinstance(block, dict):
+            raise ValueError('engine variant "slo" must be a JSON object')
+        return dict(block)

@@ -24,6 +24,28 @@ import pytest
 from predictionio_tpu.data.storage import Storage, set_storage
 
 
+@pytest.fixture(autouse=True)
+def _reset_resilience():
+    """Per-test isolation for the resilience subsystem's process-global
+    state: circuit breakers are keyed by endpoint (ephemeral test ports
+    recycle!), chaos rules are process-wide, and the SLO monitor's burn
+    gauges feed admission control — a previous test's open circuit,
+    active fault, or deliberately-slow traffic must never shed the next
+    test's requests."""
+    from predictionio_tpu.obs import slo
+    from predictionio_tpu.resilience import chaos, policy
+
+    def reset():
+        policy.reset_breakers()
+        chaos.reset()
+        slo.MONITOR.clear()
+        slo.MONITOR.evaluate()  # no samples -> burn gauges back to 0
+
+    reset()
+    yield
+    reset()
+
+
 @pytest.fixture()
 def memory_storage():
     """Fresh in-memory storage installed as the process singleton."""

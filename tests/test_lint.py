@@ -726,3 +726,75 @@ def test_jt09_supervised_loop_does_not_mask_sibling(tmp_path):
     """)
     assert rule_ids(findings) == ["JT09"]
     assert findings[0].line == 12  # the drain loop, not the main one
+
+
+# -- JT10 outbound-call-without-timeout ----------------------------------------
+
+def test_jt10_positive_urlopen_without_timeout(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import urllib.request
+
+        def fetch(url):
+            with urllib.request.urlopen(url) as resp:
+                return resp.read()
+    """)
+    assert rule_ids(findings) == ["JT10"]
+    assert "timeout" in findings[0].message
+
+
+def test_jt10_positive_httpconnection_and_create_connection(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import http.client
+        import socket
+
+        def a(host, port):
+            return http.client.HTTPConnection(host, port)
+
+        def b(addr):
+            return socket.create_connection(addr)
+    """)
+    assert rule_ids(findings) == ["JT10", "JT10"]
+
+
+def test_jt10_negative_timeout_kwarg_or_positional(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import http.client
+        import socket
+        import urllib.request
+        from urllib.request import urlopen
+
+        def a(req, deadline):
+            with urllib.request.urlopen(req, timeout=deadline) as r:
+                return r.read()
+
+        def b(req, body):
+            return urlopen(req, body, 10)  # positional timeout
+
+        def c(host, port):
+            return http.client.HTTPSConnection(host, port, 30)
+
+        def d(addr):
+            return socket.create_connection(addr, 5)
+    """)
+    assert findings == []
+
+
+def test_jt10_star_args_not_decidable(tmp_path):
+    # *args / **kwargs may carry the timeout: conservative silence
+    findings = lint_src(tmp_path, """\
+        import urllib.request
+
+        def fetch(req, *args, **kwargs):
+            return urllib.request.urlopen(req, *args, **kwargs)
+    """)
+    assert findings == []
+
+
+def test_jt10_suppressible_with_justification(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import urllib.request
+
+        def fetch(url):
+            return urllib.request.urlopen(url)  # graftlint: disable=JT10 — fixture: interactive CLI, user can ^C
+    """)
+    assert findings == []

@@ -32,7 +32,7 @@ UTC = _dt.timezone.utc
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _client_storage(port: int, auth_key=None) -> Storage:
+def _client_env(port: int, auth_key=None) -> dict:
     env = {
         "PIO_STORAGE_SOURCES_CENTRAL_TYPE": "rest",
         "PIO_STORAGE_SOURCES_CENTRAL_HOSTS": "127.0.0.1",
@@ -46,7 +46,11 @@ def _client_storage(port: int, auth_key=None) -> Storage:
     }
     if auth_key:
         env["PIO_STORAGE_SOURCES_CENTRAL_AUTH_KEY"] = auth_key
-    return Storage.from_env(env)
+    return env
+
+
+def _client_storage(port: int, auth_key=None) -> Storage:
+    return Storage.from_env(_client_env(port, auth_key))
 
 
 @pytest.fixture()
@@ -617,11 +621,17 @@ def test_idempotent_reads_retry_through_transient_outage(tmp_path):
     with pytest.raises(StorageUnavailableError):
         client.apps().get_all()
 
-    # bring the server up concurrently with the retried call
+    # bring the server up concurrently with the retried call. Backoff
+    # is FULL-jitter now (resilience Policy): individual delays can be
+    # ~0, so a generous retry budget — not delay arithmetic — is what
+    # makes "comes back inside the budget" deterministic here.
+    retry_env = dict(_client_env(port))
+    retry_env["PIO_STORAGE_SOURCES_CENTRAL_RETRIES"] = "6"
+    client = Storage.from_env(retry_env)
     started = {}
 
     def bring_up():
-        time.sleep(0.35)  # inside the ~0.2/0.4/0.8s backoff budget
+        time.sleep(0.05)
         started["server"] = StorageServer(
             storage=server_storage, host="127.0.0.1", port=port).start()
 
