@@ -102,10 +102,15 @@ import time
 
 import numpy as np
 
-# public TPU v5e per-chip peaks (cloud.google.com/tpu/docs/v5e):
-# 197 TFLOP/s bf16, 819 GB/s HBM bandwidth
-V5E_PEAK_BF16_FLOPS = 197e12
-V5E_PEAK_HBM_BYTES = 819e9
+# the chip peaks live in ONE place (obs/perfacct.py — the live
+# pio_train_mfu gauge divides by the same numbers, so a bench capture
+# and a production dashboard can never disagree on the denominator);
+# perfacct imports no jax at module level, so the orchestrating parent
+# stays chip-free
+from predictionio_tpu.obs.perfacct import (  # noqa: E402
+    PEAK_BF16_FLOPS as V5E_PEAK_BF16_FLOPS,
+    PEAK_HBM_BYTES as V5E_PEAK_HBM_BYTES,
+)
 
 DEFAULT_KNOBS = (138_493, 26_744, 20_000_000, 64, 5)  # ML-20M + rank/iters
 # absolute held-out RMSE band for the DEFAULT synthetic generator at the
@@ -1008,11 +1013,20 @@ def stage_twotower(base_dir, out_path):
     breakdown = _step_device_breakdown(trace, steps)
     if breakdown is not None:
         detail["step_device_breakdown"] = breakdown
+    # matmul_flops_per_step delegates to the ONE shared formula
+    # (obs/perfacct.twotower_matmul_flops — the same count the live
+    # pio_train_mfu gauge uses), and the peak is the shared imported
+    # constant: the driver-captured twotower_mfu and the production
+    # gauge cannot drift apart. The division stays against the v5e
+    # CONSTANT (not perfacct.mfu(), which honors the PIO_PEAK_FLOPS
+    # live-accounting override): a bench capture must be comparable
+    # across rounds regardless of the operator's gauge configuration.
     matmul_flops = trainer.matmul_flops_per_step() * steps
     detail["matmul_flops_per_step"] = trainer.matmul_flops_per_step()
     device_sec = trace.get("device_time_sec") or steady
     detail["mfu_basis"] = (
-        "analytic matmul FLOPs (logits fwd+bwd + MLP) over "
+        "analytic matmul FLOPs (logits fwd+bwd + MLP, "
+        "obs/perfacct.twotower_matmul_flops) over "
         f"{'TRACED device time' if trace.get('device_time_sec') else 'steady epoch wall'}"
         " vs 197 TFLOP/s public TPU v5e bf16 peak")
     achieved = matmul_flops / device_sec

@@ -144,25 +144,41 @@ class Engine:
         engine_params: EngineParams,
         workflow_params: Optional[WorkflowParams] = None,
     ) -> TrainResult:
+        import time as _time
+
+        from predictionio_tpu.obs import perfacct
+
         wp = workflow_params or WorkflowParams()
         data_source = self.make_data_source(engine_params)
+        # freshness horizon at read START: an event landing while the
+        # scan is in flight may miss the snapshot, so the model is only
+        # guaranteed to cover ingests up to this instant — capturing at
+        # read end would mark mid-read arrivals as servable when they
+        # are not (conservative staleness, never false freshness)
+        perfacct.LEDGER.note_train_read()
+        t0 = _time.perf_counter()
         td = data_source.read_training(ctx)
+        perfacct.LEDGER.note_stage("read", _time.perf_counter() - t0)
         _sanity(td, wp, "training data")
         if wp.stop_after_read:
             return TrainResult(stopped_after="read", training_data=td)
 
         preparator = self.make_preparator(engine_params)
+        t0 = _time.perf_counter()
         pd = preparator.prepare(ctx, td)
+        perfacct.LEDGER.note_stage("prepare", _time.perf_counter() - t0)
         _sanity(pd, wp, "prepared data")
         if wp.stop_after_prepare:
             return TrainResult(stopped_after="prepare", training_data=td, prepared_data=pd)
 
         algorithms = self.make_algorithms(engine_params)
         models = []
+        t0 = _time.perf_counter()
         for i, algo in enumerate(algorithms):
             model = algo.train(ctx, pd)  # HOT LOOP (ref: Engine.scala:650)
             _sanity(model, wp, f"model {i}")
             models.append(model)
+        perfacct.LEDGER.note_stage("fit", _time.perf_counter() - t0)
         return TrainResult(models=models, training_data=td, prepared_data=pd)
 
     # -- evaluation (ref: object Engine.eval:688) ---------------------------

@@ -368,6 +368,11 @@ class EventLogEventStore(S.EventStore):
         n = self._lib.el_append_batch(h, buf, len(buf), 1 if fresh else 0)
         if n != len(events):
             raise S.StorageError(f"append failed ({n} of {len(events)} written)")
+        if out_ids:
+            # freshness clock: these rows now wait for a model publish
+            from predictionio_tpu.obs import perfacct
+
+            perfacct.note_ingest()
         return out_ids
 
     def insert_json_batch(
@@ -440,6 +445,10 @@ class EventLogEventStore(S.EventStore):
             hex_all[32 * i:32 * i + 32] if codes[i] == 0 else None
             for i in range(n)
         ]
+        if any(c == 0 for c in codes):
+            from predictionio_tpu.obs import perfacct
+
+            perfacct.note_ingest()
         return ids, codes, names, etypes
 
     def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
@@ -700,6 +709,10 @@ class EventLogEventStore(S.EventStore):
                     f"columnar append failed ({wrote} of {m} written)"
                 )
             total += m
+        if total:
+            from predictionio_tpu.obs import perfacct
+
+            perfacct.note_ingest()
         return total
 
     def data_fingerprint(self, app_id, channel_id=None) -> str:

@@ -241,6 +241,12 @@ class SqliteEventStore(S.EventStore):
                     for e in stamped
                 ],
             )
+        if stamped:
+            # freshness clock (obs/perfacct.py): like every other bulk
+            # storage writer, once per committed batch
+            from predictionio_tpu.obs import perfacct
+
+            perfacct.note_ingest()
         return [e.event_id for e in stamped]
 
     def _row_to_event(self, row: sqlite3.Row) -> Event:

@@ -377,7 +377,15 @@ class EventStore(abc.ABC):
     ) -> List[str]:
         """Bulk append (ref: PEvents.write:124). Backends with
         transactions override this to commit once."""
-        return [self.insert(e, app_id, channel_id) for e in events]
+        ids = [self.insert(e, app_id, channel_id) for e in events]
+        if ids:
+            # freshness clock (obs/perfacct.py): one note per accepted
+            # batch — pio_model_staleness_seconds measures how long
+            # these rows wait for a servable model
+            from predictionio_tpu.obs import perfacct
+
+            perfacct.note_ingest()
+        return ids
 
     @abc.abstractmethod
     def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:
@@ -545,6 +553,10 @@ class EventStore(abc.ABC):
                     )
                 )
             self.insert_batch(events, app_id, channel_id)
+        if n:
+            from predictionio_tpu.obs import perfacct
+
+            perfacct.note_ingest()
         return n
 
     def compact(self, app_id: int, channel_id: Optional[int] = None):

@@ -28,13 +28,20 @@ PR), one registry:
                  (``GET /admin/slo``, ``pio slo``, dashboard ``/slo``)
   obs.push     — PIO_PUSH_URL background OpenMetrics pusher with
                  retry/backoff (the push-gateway path)
+  obs.perfacct — performance accounting: live MFU/roofline gauges from
+                 cost_analysis (analytic fallback), the data-path
+                 ledger + ``pio_model_staleness_seconds``, and the
+                 tail-latency attribution behind ``GET /admin/tail``
+  obs.timeline — bounded in-process metric time-series rings behind
+                 ``GET /admin/timeline``, the dashboard sparklines and
+                 ``pio top``
 
 Import cost is stdlib-only; jax is touched lazily inside jaxmon,
-profiler and the health device probe.
+profiler, perfacct's cost-analysis helpers and the health device probe.
 """
 
-from predictionio_tpu.obs import (flight, health, jaxmon, metrics, profiler,
-                                  push, slo, trace)
+from predictionio_tpu.obs import (flight, health, jaxmon, metrics, perfacct,
+                                  profiler, push, slo, timeline, trace)
 from predictionio_tpu.obs import logging as obs_logging
 from predictionio_tpu.obs.metrics import (
     CONTENT_TYPE,
@@ -57,9 +64,11 @@ __all__ = [
     "jaxmon",
     "metrics",
     "obs_logging",
+    "perfacct",
     "profiler",
     "push",
     "slo",
     "span",
+    "timeline",
     "trace",
 ]

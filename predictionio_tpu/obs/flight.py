@@ -79,6 +79,18 @@ _DUMPS_EVICTED_TOTAL = metrics.counter(
     "the count/byte caps",
 )
 
+_NEGATIVE_REMAINDER_TOTAL = metrics.counter(
+    "pio_flight_negative_remainder_total",
+    "Requests whose attributed stage time exceeded the measured total "
+    "(clock skew, overlapping stage notes): the unattributed remainder "
+    "was clamped to 0 so tail attribution never sees a negative share",
+)
+
+#: attributed-over-total slack before a clamp counts as a negative
+#: remainder: per-stage ms are rounded to 3 decimals, so honest sums
+#: can overshoot the total by fractions of a microsecond
+_NEGATIVE_REMAINDER_TOLERANCE_MS = 0.01
+
 DEFAULT_MAX_DUMPS = 64
 DEFAULT_MAX_DUMP_BYTES = 64 * 1024 * 1024
 
@@ -280,8 +292,14 @@ class FlightRecorder:
         attributed = sum(stages.values())
         # the remainder (header parse, thread scheduling, GIL waits)
         # keeps sum(stages) == duration_ms by construction, so a stage
-        # breakdown can always be read as a complete account
-        stages["unattributed"] = round(max(0.0, total_ms - attributed), 3)
+        # breakdown can always be read as a complete account; a NEGATIVE
+        # remainder (attributed stages overlapped, or their clocks
+        # skewed past the wall total) clamps to 0 and is counted — tail
+        # attribution must never report a negative stage share
+        remainder = total_ms - attributed
+        if remainder < -_NEGATIVE_REMAINDER_TOLERANCE_MS:
+            _NEGATIVE_REMAINDER_TOTAL.inc()
+        stages["unattributed"] = round(max(0.0, remainder), 3)
         # precedence: an exception that escaped the handler, then an
         # error the handler noted itself (the engine server's answered
         # 500 path), then the bare status

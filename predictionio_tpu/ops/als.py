@@ -751,6 +751,8 @@ class ALSTrainer:
             item_side.groups_per_shard, val_affine=item_side.affine,
         )
         self._run_cache = {}
+        # MFU/roofline accounting (obs/perfacct.py), built on first step
+        self._acct = None
 
     def _put_side(self, side: SideLayout):
         if not hasattr(self, "put_start"):
@@ -861,6 +863,12 @@ class ALSTrainer:
         t0 = time.perf_counter()
         _force(out[0])
         self.compile_run_sec = time.perf_counter() - t0
+        # data-path ledger (obs/perfacct.py): the compile tax of this
+        # run, beside the read/prepare/train stages the workflow notes
+        from predictionio_tpu.obs import perfacct
+
+        perfacct.LEDGER.note_stage(
+            "compile", self.compile_host_sec + self.compile_run_sec)
         return self
 
     def step_n(self, iterations: Optional[int] = None) -> None:
@@ -868,8 +876,20 @@ class ALSTrainer:
         stay device-resident (materialize with `factors()`)."""
         n = iterations if iterations is not None else self.cfg.iterations
         fn = self._run_compiled(n)
+        t0 = time.perf_counter()
         self._X, self._Y = fn(self._X, self._Y, *self._ud, *self._it)
         _force(self._X)
+        # live MFU/roofline gauges (obs/perfacct.py): the analytic
+        # work_model is the cost basis — AOT cost_analysis is
+        # deliberately NOT attempted here (compile() documents why
+        # lower().compile() misbehaves on tunneled backends)
+        if self._acct is None:
+            from predictionio_tpu.obs import perfacct
+
+            wm = self.work_model()
+            self._acct = perfacct.StepAccountant(
+                "als", wm["flops_per_iter"], wm["hbm_bytes_per_iter"])
+        self._acct.observe(time.perf_counter() - t0, steps=n)
 
     def run(self, iterations: Optional[int] = None) -> ALSFactors:
         self.step_n(iterations)

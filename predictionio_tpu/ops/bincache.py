@@ -89,6 +89,11 @@ def save(key: str, arrays: Dict[str, np.ndarray],
     """Atomic write (tmp + rename) so a crashed save never leaves a
     half-written layout a later load would trust. After the write, the
     cache is pruned to ``PIO_BIN_CACHE_KEEP`` entries (default 4)."""
+    import time as _time
+
+    from predictionio_tpu.obs import perfacct
+
+    t0 = _time.perf_counter()
     npz_path, meta_path = _paths(key)
     os.makedirs(cache_dir(), exist_ok=True)
     try:
@@ -103,9 +108,17 @@ def save(key: str, arrays: Dict[str, np.ndarray],
     except OSError as e:  # a full disk must not fail the training run
         log.warning("bin-cache save failed (%s) — continuing uncached", e)
     _prune(max(1, int(os.environ.get("PIO_BIN_CACHE_KEEP", "4"))))
+    # data-path ledger: the bin stage's cache cost sits beside the
+    # read/prepare/compile/train stages (obs/perfacct.py)
+    perfacct.LEDGER.note_stage("bin_cache_save", _time.perf_counter() - t0)
 
 
 def load(key: str) -> Optional[Tuple[Dict[str, np.ndarray], Dict[str, Any]]]:
+    import time as _time
+
+    from predictionio_tpu.obs import perfacct
+
+    t0 = _time.perf_counter()
     npz_path, meta_path = _paths(key)
     try:
         with open(meta_path) as f:
@@ -113,6 +126,8 @@ def load(key: str) -> Optional[Tuple[Dict[str, np.ndarray], Dict[str, Any]]]:
         data = np.load(npz_path)
         arrays = {k: data[k] for k in data.files}
         os.utime(npz_path)  # LRU touch for _prune
+        perfacct.LEDGER.note_stage("bin_cache_load",
+                                   _time.perf_counter() - t0)
         return arrays, meta
     except (OSError, ValueError, KeyError):
         return None

@@ -530,6 +530,9 @@ class TwoTowerTrainer:
         self._epoch_fn = self._make_epoch()
         self._epochs_done = 0
         self._losses: List[float] = []
+        # MFU accounting (obs/perfacct.py): built lazily after the
+        # first dispatch so cost_analysis can reuse the compiled step
+        self._acct = None
 
         # mid-training checkpoint/resume (core.checkpoint — beyond the
         # reference's train-to-completion-or-nothing, SURVEY.md §5.4)
@@ -727,7 +730,21 @@ class TwoTowerTrainer:
             self._losses.append(float(mean_loss))
             # per-dispatch wall time onto pio_train_step_seconds; also
             # beats the train-step stall watchdog (obs/health.py)
-            jaxmon.observe_train_step(_time.perf_counter() - t_step)
+            epoch_sec = _time.perf_counter() - t_step
+            jaxmon.observe_train_step(epoch_sec)
+            if self._acct is None:
+                # one dispatch = one epoch (the jitted lax.scan), so
+                # the cost basis is per-EPOCH: cost_analysis of the
+                # compiled epoch when the backend reports one, else the
+                # shared analytic matmul count x steps (obs/perfacct —
+                # the same formula bench.py's twotower_mfu divides by)
+                from predictionio_tpu.obs import perfacct
+
+                self._acct = perfacct.StepAccountant.from_jitted(
+                    "twotower", self._epoch_fn, (*self._state, key),
+                    fallback_flops=(self.matmul_flops_per_step()
+                                    * self.steps_per_epoch))
+            self._acct.observe(epoch_sec)
             self._epochs_done += 1
             if self._ckpt is not None:
                 tables, acc, dense, opt_state = self._state
@@ -764,16 +781,14 @@ class TwoTowerTrainer:
     # -- bench hooks --------------------------------------------------------
 
     def matmul_flops_per_step(self) -> float:
-        """Analytic matmul FLOPs per training step (fwd + bwd): the
-        [B, B] logits einsum and its two rank-D backward products, plus
-        the tail MLP matmuls — the basis the bench's MFU cross-checks
-        against the xplane trace's XLA cost-model count."""
-        B, D = self.batch, self.cfg.dim
-        flops = 3 * 2.0 * B * B * D          # logits fwd + dL/du + dL/dv
-        widths = _tail_widths(self.cfg)
-        per_row = sum(2.0 * a * b for a, b in zip(widths[:-1], widths[1:]))
-        flops += 2 * 3 * per_row * B         # two towers, fwd+bwd(x2)
-        return flops
+        """Analytic matmul FLOPs per training step (fwd + bwd) — the
+        ONE shared formula (obs/perfacct.twotower_matmul_flops), so the
+        live ``pio_train_mfu`` gauge and the bench's driver-captured
+        ``twotower_mfu`` can never drift apart."""
+        from predictionio_tpu.obs import perfacct
+
+        return perfacct.twotower_matmul_flops(
+            self.batch, self.cfg.dim, _tail_widths(self.cfg))
 
 
 def twotower_train(

@@ -37,7 +37,7 @@ from urllib.parse import parse_qs, urlparse
 from predictionio_tpu.data.backends.eventlog import _ROW_ERRORS, JsonRowsUnsupported
 from predictionio_tpu.data.event import Event, EventValidationError, validate_event, _parse_time
 from predictionio_tpu.data.storage import UNSET, Storage, StorageError, get_storage
-from predictionio_tpu.obs import flight
+from predictionio_tpu.obs import flight, perfacct
 from predictionio_tpu.obs import logging as obs_logging
 from predictionio_tpu.serving.http import HTTPServerBase, JSONRequestHandler
 from predictionio_tpu.serving.stats import Stats
@@ -110,6 +110,9 @@ class EventServerCore:
         except StorageError as e:
             return 500, {"message": str(e)}
         self.stats.update(auth.app_id, 201, event.event, event.entity_type)
+        # freshness clock (obs/perfacct.py): the single-event front-door
+        # lane notes here — bulk lanes note inside their storage writers
+        perfacct.note_ingest()
         return 201, {"eventId": event_id}
 
     def create_events_batch(self, auth: AuthData, raw_body: bytes) -> Tuple[int, Any]:

@@ -19,6 +19,10 @@ Every server also inherits the shared operator surface from the
   GET/POST /admin/chaos  fault-injection rule set    } is set
   GET  /admin/resilience breaker/admission/chaos     }
                          snapshot                    }
+  GET  /admin/timeline   metric timelines + the      }
+                         data-path ledger            }
+  GET  /admin/tail       tail-latency attribution    }
+                         (above-p95 stage shares)    }
 
 ``/healthz``, ``/readyz`` and ``/metrics`` stay unauthenticated — a
 liveness prober or scraper holds no operator secrets; the ``/admin/*``
@@ -40,8 +44,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 from urllib.parse import parse_qs, urlparse
 
-from predictionio_tpu.obs import (flight, health, metrics, profiler, push,
-                                  slo, trace)
+from predictionio_tpu.obs import (flight, health, metrics, perfacct,
+                                  profiler, push, slo, timeline, trace)
 from predictionio_tpu.resilience import alerts, chaos
 from predictionio_tpu.resilience import policy as respolicy
 
@@ -216,6 +220,31 @@ def _serve_admin_chaos(handler) -> None:
     handler._send(200, result)
 
 
+def _serve_admin_timeline(handler) -> None:
+    """``GET /admin/timeline``: the bounded metric-timeline rings
+    (obs/timeline.py) plus the data-path ledger + staleness clock
+    (obs/perfacct.py). The read itself ticks the sampler (rate-limited
+    by the cadence), so watching a server builds its history."""
+    timeline.TIMELINE.sample()
+    payload = timeline.TIMELINE.series()
+    payload["datapath"] = perfacct.LEDGER.snapshot()
+    handler._send(200, payload)
+
+
+def _serve_admin_tail(handler, query: str) -> None:
+    """``GET /admin/tail``: tail-latency attribution over the flight
+    recorder's stage timings — for requests above ``?q=`` (default
+    0.95), which stage dominates vs the median request."""
+    params = parse_qs(query)
+    try:
+        q = float((params.get("q") or ["0.95"])[0])
+        report = perfacct.tail_report(q=q)
+    except ValueError as e:
+        handler._send(400, {"message": str(e)})
+        return
+    handler._send(200, report)
+
+
 def _instrument(fn):
     """Wrap a do_METHOD handler: serve the shared routes (``GET
     /metrics``, ``GET /admin/flight``, ``POST /admin/profile``),
@@ -265,6 +294,12 @@ def _instrument(fn):
                 return
             if path == "/admin/chaos":
                 _serve_admin_chaos(self)
+                return
+            if self.command == "GET" and path == "/admin/timeline":
+                _serve_admin_timeline(self)
+                return
+            if self.command == "GET" and path == "/admin/tail":
+                _serve_admin_tail(self, parsed.query)
                 return
             if self.command == "GET" and path == "/admin/resilience":
                 # breaker states + admission snapshot (when the server

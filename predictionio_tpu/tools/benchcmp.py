@@ -33,7 +33,7 @@ _HIGHER_BETTER = re.compile(r"(per_sec|_qps|qps$|throughput|mfu|"
                             r"_per_chip|hit)")
 #: metric-name fragments where a LOWER value is better
 _LOWER_BETTER = re.compile(r"(_ms$|_ms_|_sec$|_sec_|_seconds|latency|"
-                           r"_bytes$|p50|p99|debt)")
+                           r"_bytes$|p50|p99|debt|rmse)")
 
 #: detail keys that are run configuration, not performance — a change
 #: is reported as CONFIG-CHANGED (never a regression verdict: comparing
@@ -59,7 +59,10 @@ class Delta:
 def load_metrics(path: str) -> Dict[str, float]:
     """The numeric metrics of one bench round: the headline
     ``{metric, value}`` pair plus every numeric scalar under
-    ``parsed.detail`` (as ``detail.<key>``)."""
+    ``parsed.detail`` (as ``detail.<key>``) and ``parsed.key`` (as
+    ``key.<name>`` — the compact headline block real rounds carry, so
+    ``twotower_mfu``, the serve percentiles and the data-path seconds
+    all sit in the direction-aware gate set)."""
     with open(path, "r", encoding="utf-8") as f:
         doc = json.load(f)
     parsed = doc.get("parsed") or {}
@@ -69,11 +72,12 @@ def load_metrics(path: str) -> Dict[str, float]:
     if name and isinstance(value, (int, float)) and not isinstance(
             value, bool):
         out[str(name)] = float(value)
-    detail = parsed.get("detail") or {}
-    if isinstance(detail, dict):
-        for key, v in detail.items():
-            if isinstance(v, (int, float)) and not isinstance(v, bool):
-                out[f"detail.{key}"] = float(v)
+    for block, prefix in ((parsed.get("detail"), "detail"),
+                          (parsed.get("key"), "key")):
+        if isinstance(block, dict):
+            for key, v in block.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    out[f"{prefix}.{key}"] = float(v)
     return out
 
 

@@ -25,6 +25,11 @@ Console.scala:128-735 command surface; bin/pio:17-42 wrapper):
   bench-compare         (per-metric deltas across the BENCH_r*.json
                          trajectory; exit 1 on regressions beyond the
                          tolerance band)
+  top                   (live terminal view of the metric timelines:
+                         MFU, staleness, serving p50/p99, request rate
+                         — sparklines from a server's /admin/timeline
+                         or the in-process rings; --once --json for
+                         scripts)
 
 Run as ``python -m predictionio_tpu.tools.cli <command> ...``.
 """
@@ -704,6 +709,108 @@ def cmd_chaos(args) -> int:
     return 0
 
 
+def _fetch_timeline(url: Optional[str]) -> dict:
+    """One timeline payload: a server's ``GET /admin/timeline`` when
+    ``url`` is given (PIO_ADMIN_TOKEN bearer attached when set), else
+    the in-process rings (sampled now, so a bare `pio top --once` after
+    an in-process train still shows data)."""
+    if url:
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(url.rstrip("/") + "/admin/timeline")
+        _add_admin_auth(req)
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.load(resp)
+        except urllib.error.HTTPError as e:
+            raise CommandError(
+                f"timeline request failed ({e.code}): "
+                f"{e.read().decode(errors='replace')[:200]}")
+        except urllib.error.URLError as e:
+            raise CommandError(f"cannot reach {url}: {e.reason}")
+    from predictionio_tpu.obs import perfacct, timeline
+
+    timeline.TIMELINE.sample(force=True)
+    payload = timeline.TIMELINE.series()
+    payload["datapath"] = perfacct.LEDGER.snapshot()
+    return payload
+
+
+def _render_top_frame(payload: dict) -> str:
+    """One `pio top` frame: a sparkline + latest value per series,
+    then the data-path ledger summary."""
+    from predictionio_tpu.obs.timeline import sparkline
+
+    lines = []
+    series = payload.get("series") or {}
+    if not series:
+        lines.append("(no samples yet — traffic or a train run feeds "
+                     "the timeline)")
+    width = max((len(n) for n in series), default=0)
+    for name in sorted(series):
+        points = series[name]
+        if not points:
+            continue
+        values = [p[1] for p in points]
+        lines.append(f"{name:>{width}}  {sparkline(values, 40):<40} "
+                     f"{values[-1]:>12.4g}  "
+                     f"(min {min(values):.4g} max {max(values):.4g}, "
+                     f"n={len(values)})")
+    datapath = payload.get("datapath") or {}
+    if datapath:
+        lines.append("")
+        lines.append(f"model staleness: "
+                     f"{datapath.get('staleness_seconds', 0.0):.1f}s")
+        runs = datapath.get("runs") or []
+        if runs:
+            last = runs[-1]
+            stages = " ".join(f"{k}={v:.2f}s"
+                              for k, v in sorted(last["stages"].items()))
+            lines.append(f"last run {last['run']}: {stages or '(no stages)'}")
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> int:
+    """Live performance view (obs/timeline.py + obs/perfacct.py): the
+    tracked gauge/quantile timelines as terminal sparklines, refreshed
+    every ``--interval`` seconds; ``--once`` prints a single frame and
+    exits; ``--json`` (with --once) dumps the raw timeline payload."""
+    if args.json and not args.once:
+        raise CommandError("--json requires --once (one machine-readable "
+                           "frame; stream consumers should poll "
+                           "/admin/timeline)")
+    if args.once:
+        payload = _fetch_timeline(args.url)
+        if args.json:
+            json.dump(payload, sys.stdout, indent=1, sort_keys=True)
+            sys.stdout.write("\n")
+        else:
+            _p(_render_top_frame(payload))
+        return 0
+    try:
+        while True:
+            # a transient fetch failure (server restarting, one poll
+            # timing out) shows in the frame and the watch continues —
+            # only --once hard-fails
+            try:
+                payload = _fetch_timeline(args.url)
+                frame = _render_top_frame(payload)
+            except CommandError as e:
+                frame = f"(fetch failed, retrying: {e})"
+            # ANSI clear + home, like every terminal top
+            sys.stdout.write("\x1b[2J\x1b[H")
+            _p(f"pio top — {args.url or 'in-process'} "
+               f"(interval {args.interval:g}s, ctrl-c to quit)\n")
+            _p(frame)
+            sys.stdout.flush()
+            import time as _time
+
+            _time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_bench_compare(args) -> int:
     """Per-metric deltas across the bench trajectory (BENCH_r*.json):
     newest round vs the previous (or --against first), REGRESSION/
@@ -718,7 +825,7 @@ def cmd_bench_compare(args) -> int:
 
 def cmd_lint(args) -> int:
     """graftlint: the JAX/TPU-aware static analysis over the tree
-    (rules JT01-JT10; tier-1 CI runs the same pass via tests/test_lint_clean.py)."""
+    (rules JT01-JT11; tier-1 CI runs the same pass via tests/test_lint_clean.py)."""
     from predictionio_tpu.tools.lint import run_cli
 
     try:
@@ -996,6 +1103,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(func=cmd_chaos)
 
     p = sub.add_parser(
+        "top",
+        help="live terminal view of the metric timelines (MFU, "
+             "staleness, serving quantiles, request rate) from a "
+             "server's /admin/timeline or the in-process rings",
+    )
+    p.add_argument("--url", default=None,
+                   help="base URL of any PIO server (sends the "
+                        "PIO_ADMIN_TOKEN bearer header when set); "
+                        "default: this process's own timeline")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh cadence in seconds (default 2)")
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit")
+    p.add_argument("--json", action="store_true",
+                   help="with --once: dump the raw timeline payload")
+    p.set_defaults(func=cmd_top)
+
+    p = sub.add_parser(
         "bench-compare",
         help="compare the newest BENCH_r*.json round against a baseline; "
              "print per-metric deltas, exit 1 on regressions beyond the "
@@ -1014,7 +1139,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(func=cmd_bench_compare)
 
     p = sub.add_parser("lint", help="run graftlint (JAX/TPU-aware static "
-                                    "analysis, rules JT01-JT10) over the tree")
+                                    "analysis, rules JT01-JT11) over the tree")
     p.add_argument("paths", nargs="*", default=[],
                    help="files/dirs (default: the installed package)")
     p.add_argument("--format", choices=["human", "json"], default="human")

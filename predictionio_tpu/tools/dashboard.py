@@ -24,6 +24,12 @@ reference — operator views of this process's diagnostics:
                            circuit breaker states, shed counters and
                            the active chaos rules of THIS process.
                            JSON at /admin/resilience.
+  GET /timeline         -> HTML panel of the metric timelines
+                           (obs/timeline.py): per-series sparklines of
+                           MFU, model staleness, serving p50/p99 and
+                           request rate, plus the data-path ledger's
+                           per-run stage table. JSON at
+                           /admin/timeline.
 """
 
 from __future__ import annotations
@@ -72,6 +78,10 @@ class _DashboardRequestHandler(JSONRequestHandler):
             return
         if path == "/resilience":
             self._send_cors(200, self.server_ref.resilience_html(),
+                            "text/html; charset=UTF-8")
+            return
+        if path == "/timeline":
+            self._send_cors(200, self.server_ref.timeline_html(),
                             "text/html; charset=UTF-8")
             return
         parts = [p for p in path.split("/") if p]
@@ -141,6 +151,7 @@ class DashboardServer(HTTPServerBase):
             '<a href="/admin/flight">JSON dump</a> · '
             '<a href="/slo">SLO burn rates</a> · '
             '<a href="/resilience">resilience</a> · '
+            '<a href="/timeline">timelines</a> · '
             '<a href="/metrics">metrics</a> · '
             '<a href="/readyz">readiness</a></p>'
             "</body></html>"
@@ -227,6 +238,63 @@ class DashboardServer(HTTPServerBase):
             f"{''.join(rows)}</table></body></html>"
         )
 
+
+    def timeline_html(self) -> str:
+        """The metric timelines as an operator panel: one row per
+        tracked series with a unicode sparkline (the same renderer
+        `pio top` uses) and the latest/min/max values, followed by the
+        data-path ledger's per-run stage table and the staleness
+        clock."""
+        from predictionio_tpu.obs import perfacct
+        from predictionio_tpu.obs.timeline import TIMELINE, sparkline
+
+        TIMELINE.sample()  # watching the panel builds its history
+        payload = TIMELINE.series()
+        rows = []
+        for name in sorted(payload["series"]):
+            points = payload["series"][name]
+            if not points:
+                continue
+            values = [p[1] for p in points]
+            rows.append(
+                "<tr><td>{name}</td><td><code>{spark}</code></td>"
+                "<td>{last:.4g}</td><td>{lo:.4g}</td><td>{hi:.4g}</td>"
+                "<td>{n}</td></tr>".format(
+                    name=html.escape(name),
+                    spark=html.escape(sparkline(values, 48)),
+                    last=values[-1], lo=min(values), hi=max(values),
+                    n=len(values)))
+        series_rows = "".join(rows) or (
+            "<tr><td colspan='6'>no samples yet — traffic or a train "
+            "run feeds the timeline</td></tr>")
+        datapath = perfacct.LEDGER.snapshot()
+        run_rows = "".join(
+            "<tr><td>{run}</td><td><code>{stages}</code></td></tr>".format(
+                run=html.escape(str(r["run"])[:16]),
+                stages=html.escape(" ".join(
+                    f"{k}={v:.2f}s" for k, v in sorted(r["stages"].items()))
+                    or "(no stages)"))
+            for r in reversed(datapath["runs"])
+        ) or "<tr><td colspan='2'>no training runs recorded</td></tr>"
+        return (
+            "<!DOCTYPE html><html><head><title>Metric timelines</title>"
+            "</head><body><h1>Metric timelines</h1>"
+            "<p>Cadence {interval:g}s, {cap} samples/series "
+            "(PIO_TIMELINE_INTERVAL_SEC / PIO_TIMELINE_CAPACITY). "
+            '<a href="/admin/timeline">JSON</a> · '
+            '<a href="/admin/tail">tail attribution</a> · '
+            '<a href="/">index</a></p>'
+            "<table border='1'><tr><th>Series</th><th>Sparkline</th>"
+            "<th>Last</th><th>Min</th><th>Max</th><th>Samples</th></tr>"
+            "{series_rows}</table>"
+            "<h2>Data-path ledger</h2>"
+            "<p>Model staleness: {stale:.1f}s</p>"
+            "<table border='1'><tr><th>Run</th><th>Stage seconds</th>"
+            "</tr>{run_rows}</table>"
+            "</body></html>"
+        ).format(interval=payload["interval_sec"], cap=payload["capacity"],
+                 series_rows=series_rows,
+                 stale=datapath["staleness_seconds"], run_rows=run_rows)
 
     def resilience_html(self) -> str:
         """Breaker states, shed counters and chaos rules of THIS

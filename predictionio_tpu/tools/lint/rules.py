@@ -1,4 +1,4 @@
-"""graftlint rules JT01-JT10: the TPU hazards this codebase has hit.
+"""graftlint rules JT01-JT11: the TPU hazards this codebase has hit.
 
 Each rule encodes a failure class with a concrete precedent in this
 tree's history (the bf16-Gramian divergence behind JT03 is recorded in
@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 from predictionio_tpu.tools.lint.engine import (
@@ -1047,3 +1048,81 @@ class OutboundCallWithoutTimeout(Rule):
                 "peer strands this thread forever; pass timeout= "
                 "(e.g. a resilience Policy's .deadline)",
             )
+
+
+# -- JT11 ----------------------------------------------------------------------
+
+@register
+class UnboundedMetricLabelCardinality(Rule):
+    id = "JT11"
+    name = "unbounded-metric-label-cardinality"
+    rationale = (
+        "A metric label valued from per-request data (trace ids, "
+        "user/entity/item ids, raw query strings) mints one time "
+        "series per distinct value: the registry grows without bound, "
+        "every /metrics scrape re-renders the whole cemetery, and the "
+        "collector eventually OOMs. Label by bounded dimensions (route "
+        "template, status, engine id) and carry per-request data as "
+        "OpenMetrics exemplars, trace spans or flight-recorder fields "
+        "instead."
+    )
+
+    #: identifier tails that are per-request by construction in this
+    #: tree: trace/span/request/event/prediction ids, end-user and
+    #: catalog-entity ids, raw query payloads
+    _SUSPECT = re.compile(
+        r"(?:^|_)(?:trace|span|request|req|event|pr)_?id$"
+        r"|^(?:user|entity|item|session|uid|qid)(?:_id)?$"
+        r"|^(?:query|raw_query|query_string)$"
+    )
+
+    #: value-preserving wrappers to look through: str(user_id) is as
+    #: unbounded as user_id
+    _WRAPPERS = {"str", "repr", "format"}
+
+    def _suspect_name(self, node: ast.AST) -> Optional[str]:
+        """The per-request identifier a label-value expression derives
+        from, or None. Looks through Name/Attribute tails, str()/repr()
+        wrappers, and f-string interpolations."""
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            tail = dotted(node).rsplit(".", 1)[-1]
+            if tail and self._SUSPECT.search(tail):
+                return tail
+            return None
+        if isinstance(node, ast.Call):
+            fn = dotted(node.func).rsplit(".", 1)[-1]
+            if fn in self._WRAPPERS and node.args:
+                return self._suspect_name(node.args[0])
+            return None
+        if isinstance(node, ast.JoinedStr):
+            for part in node.values:
+                if isinstance(part, ast.FormattedValue):
+                    found = self._suspect_name(part.value)
+                    if found:
+                        return found
+            return None
+        if isinstance(node, ast.BinOp):  # "u-" + user_id concatenation
+            return (self._suspect_name(node.left)
+                    or self._suspect_name(node.right))
+        return None
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "labels"):
+                continue
+            values = list(node.args) + [kw.value for kw in node.keywords
+                                        if kw.arg is not None]
+            for value in values:
+                found = self._suspect_name(value)
+                if found:
+                    yield Finding(
+                        self.id, ctx.path, value.lineno, value.col_offset,
+                        f"metric label valued from per-request data "
+                        f"(`{found}`) — every distinct value mints a new "
+                        "time series and the registry grows without "
+                        "bound; label by a bounded dimension and put "
+                        "the id in an exemplar, span or flight record",
+                    )

@@ -25,7 +25,7 @@ from predictionio_tpu.core.params import EngineParams, params_to_dict
 from predictionio_tpu.core.persistent_model import PersistentModel, manifest_for
 from predictionio_tpu.data.metadata import EngineInstance, Model
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.obs import health, jaxmon, profiler
+from predictionio_tpu.obs import health, jaxmon, perfacct, profiler
 from predictionio_tpu.parallel.mesh import MeshContext
 from predictionio_tpu.workflow.config import WorkflowParams
 
@@ -201,6 +201,10 @@ def run_train(
         storage.engine_instances().insert(instance)
         inserted = True
     log.info("training instance %s (engine %s)", instance.id, engine_id)
+    # data-path ledger: this run's stage wall-times accumulate under
+    # the instance id (Engine.train notes read/prepare/fit, the ALS
+    # trainer notes compile, bincache notes its loads/saves)
+    perfacct.LEDGER.start_run(instance.id)
 
     try:
         instance.status = "TRAINING"
@@ -226,8 +230,9 @@ def run_train(
         # donation/HBM regression would move) on /metrics and `pio
         # metrics`; step-level timing comes from the training loops
         # themselves via jaxmon.observe_train_step
-        jaxmon.TRAIN_SECONDS.labels(engine_id).observe(
-            _time.perf_counter() - t_train)
+        train_sec = _time.perf_counter() - t_train
+        jaxmon.TRAIN_SECONDS.labels(engine_id).observe(train_sec)
+        perfacct.LEDGER.note_stage("train", train_sec)
         jaxmon.update_device_memory_gauges()
         if result.stopped_after:
             # debug interruption (ref: Engine.scala:624-648): no model persisted
@@ -249,6 +254,10 @@ def run_train(
         instance.end_time = _now()
         if writer:
             storage.engine_instances().update(instance)
+        # the model is now servable: move the freshness horizon —
+        # pio_model_staleness_seconds drops to the age of whatever
+        # arrived during the train (0 when nothing did)
+        perfacct.LEDGER.note_publish()
         # every host sees the COMPLETED row before anyone deploys from it
         mh.barrier("pio_train_" + instance.id)
         log.info("training completed: instance %s", instance.id)

@@ -798,3 +798,80 @@ def test_jt10_suppressible_with_justification(tmp_path):
             return urllib.request.urlopen(url)  # graftlint: disable=JT10 — fixture: interactive CLI, user can ^C
     """)
     assert findings == []
+
+# -- JT11 unbounded-metric-label-cardinality -----------------------------------
+
+def test_jt11_positive_trace_id_label(tmp_path):
+    findings = lint_src(tmp_path, """\
+        from predictionio_tpu.obs import metrics
+
+        REQS = metrics.counter("pio_reqs_total", "requests", ("trace",))
+
+        def record(trace_id):
+            REQS.labels(trace_id).inc()
+    """)
+    assert rule_ids(findings) == ["JT11"]
+    assert "trace_id" in findings[0].message
+
+
+def test_jt11_positive_entity_id_attribute_and_fstring(tmp_path):
+    findings = lint_src(tmp_path, """\
+        from predictionio_tpu.obs import metrics
+
+        LAT = metrics.histogram("pio_lat_seconds", "latency", ("who", "q"))
+
+        def record(event, query, seconds):
+            LAT.labels(event.entity_id, f"q-{query}").observe(seconds)
+    """)
+    assert rule_ids(findings) == ["JT11", "JT11"]
+
+
+def test_jt11_positive_str_wrapped_user_id(tmp_path):
+    findings = lint_src(tmp_path, """\
+        from predictionio_tpu.obs import metrics
+
+        HITS = metrics.counter("pio_hits_total", "hits", ("user",))
+
+        def record(user_id):
+            HITS.labels(str(user_id)).inc()
+    """)
+    assert rule_ids(findings) == ["JT11"]
+
+
+def test_jt11_negative_bounded_labels(tmp_path):
+    # route templates, engine ids, status codes, device ids: bounded
+    findings = lint_src(tmp_path, """\
+        from predictionio_tpu.obs import metrics
+
+        REQS = metrics.counter(
+            "pio_http_requests_total", "requests",
+            ("server", "method", "route", "status"))
+        MEM = metrics.gauge("pio_mem_bytes", "memory", ("device", "kind"))
+
+        def record(server, method, route, status, dev, engine_id):
+            REQS.labels(server, method, route, str(status)).inc()
+            MEM.labels(str(dev.id), "bytes_in_use").set(1.0)
+    """)
+    assert findings == []
+
+
+def test_jt11_negative_non_metric_labels_method(tmp_path):
+    # a .labels() on something that is not a metric family still only
+    # fires on per-request-shaped values — plot axes etc. stay silent
+    findings = lint_src(tmp_path, """\
+        def draw(ax, names):
+            ax.labels(names)
+    """)
+    assert findings == []
+
+
+def test_jt11_suppressible_with_justification(tmp_path):
+    findings = lint_src(tmp_path, """\
+        from predictionio_tpu.obs import metrics
+
+        REQS = metrics.counter("pio_reqs_total", "requests", ("trace",))
+
+        def record(trace_id):
+            REQS.labels(trace_id).inc()  # graftlint: disable=JT11 — fixture: bounded test registry
+    """)
+    assert findings == []
