@@ -363,6 +363,58 @@ def _roofline(trainer, train_sec, iterations):
     }
 
 
+def _pct(sorted_vals, q):
+    """Percentile by index over an already-sorted sample (shared by the
+    serve and fleet stages — their quantile arithmetic must agree)."""
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(len(sorted_vals) * q))]
+
+
+def _run_loadgen(port, users_file, threads, per_thread, on_warmup=None):
+    """One out-of-process loadgen run against ``port`` (the separate
+    process keeps the clients' CPU off the server's GIL/tail): returns
+    the parsed result dict, asserting a clean exit and zero errors.
+    Shared by the serve and fleet sweeps — the invocation protocol and
+    output parsing must not drift between them.
+
+    ``on_warmup`` runs in THIS process at the loadgen's WARMUP_DONE
+    marker — the instant every connection's warm-up requests have
+    finished and the timed region begins. The fleet sweep snapshots
+    per-replica request counters there to exclude warm-up traffic from
+    server-side percentiles exactly (warm-ups strictly precede the
+    marker; any timed request racing the snapshot only shrinks the
+    measured window, it can never let a warm-up in)."""
+    argv = [sys.executable, os.path.abspath(__file__),
+            "--stage", "loadgen",
+            "--base", json.dumps({
+                "port": port, "users_file": users_file,
+                "threads": threads, "per_thread": per_thread})]
+    if on_warmup is None:
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=600)
+        returncode, stdout, stderr = (proc.returncode, proc.stdout,
+                                      proc.stderr)
+    else:
+        proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+        head = []
+        # bounded by the loadgen's own internal deadlines (barrier
+        # abort at 120s, worker joins at 540s): it always reaches EOF
+        for line in proc.stdout:
+            head.append(line)
+            if line.strip() == "WARMUP_DONE":
+                on_warmup()
+                break
+        rest, stderr = proc.communicate(timeout=600)
+        returncode, stdout = proc.returncode, "".join(head) + rest
+    lines = [l for l in stdout.splitlines() if l.startswith("{")]
+    assert returncode == 0 and lines, (
+        returncode, stdout[-500:], stderr[-500:])
+    load = json.loads(lines[-1])
+    assert load["errors"] == 0, load
+    return load
+
+
 def _serve_stage(storage, factors, pd, cfg, detail):
     """Persist the trained model through the models repo, deploy it via
     the REAL EngineServer (prepare_deploy + warm-up), and measure the
@@ -453,19 +505,24 @@ def _serve_stage(storage, factors, pd, cfg, detail):
         def worker(tid):
             try:
                 c = connect()
-                for j in range(per_thread):
+                for j in range(per_thread):  # graftlint: disable=JT09 — except below hands the error to errs[]; the stage fails loudly on it
                     one(c, users[(tid * per_thread + j) % len(users)])
                 c.close()
             except Exception as e:  # noqa: BLE001
                 errs.append(e)
 
-        threads = [threading.Thread(target=worker, args=(t,))
+        # daemon: a wedged worker must not block interpreter shutdown
+        # after the bounded join already failed the stage loudly
+        threads = [threading.Thread(target=worker, args=(t,), daemon=True)
                    for t in range(n_threads)]
         t0 = time.perf_counter()
         for t in threads:
             t.start()
         for t in threads:
-            t.join()
+            # bounded join (JT12): a wedged worker must fail the stage
+            # loudly, not hang the whole bench run
+            t.join(timeout=600)
+            assert not t.is_alive(), "serve worker wedged past 600s"
         wall = time.perf_counter() - t0
         assert not errs, errs[0]
 
@@ -491,39 +548,23 @@ def _serve_stage(storage, factors, pd, cfg, detail):
             json.dump(users, uf)
             users_file = uf.name
 
-        def pct(sorted_vals, q):
-            return sorted_vals[min(len(sorted_vals) - 1,
-                                   int(len(sorted_vals) * q))]
-
         def load_point(conns, per_thread):
             count_before = server.stats.request_count
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__),
-                 "--stage", "loadgen",
-                 "--base", json.dumps({
-                     "port": server.port, "users_file": users_file,
-                     "threads": conns, "per_thread": per_thread})],
-                capture_output=True, text=True, timeout=600,
-            )
-            lines = [l for l in proc.stdout.splitlines()
-                     if l.startswith("{")]
-            assert proc.returncode == 0 and lines, (
-                proc.returncode, proc.stdout[-500:], proc.stderr[-500:])
-            load = json.loads(lines[-1])
-            assert load["errors"] == 0, load
+            load = _run_loadgen(server.port, users_file, conns,
+                                per_thread)
             n_timed = conns * per_thread
             assert server.stats.request_count - count_before >= n_timed
             srv_lat = sorted(server.stats.recent(n_timed))
-            load["srv_p50_ms"] = round(pct(srv_lat, 0.5) * 1e3, 2)
-            load["srv_p99_ms"] = round(pct(srv_lat, 0.99) * 1e3, 2)
+            load["srv_p50_ms"] = round(_pct(srv_lat, 0.5) * 1e3, 2)
+            load["srv_p99_ms"] = round(_pct(srv_lat, 0.99) * 1e3, 2)
             if server._batcher is not None:
                 splits = server._batcher.recent_splits(n_timed)
                 waits = sorted(s[0] for s in splits)
                 disp = sorted(s[1] for s in splits)
-                load["srv_queue_p50_ms"] = round(pct(waits, 0.5) * 1e3, 2)
-                load["srv_queue_p99_ms"] = round(pct(waits, 0.99) * 1e3, 2)
-                load["srv_dispatch_p50_ms"] = round(pct(disp, 0.5) * 1e3, 2)
-                load["srv_dispatch_p99_ms"] = round(pct(disp, 0.99) * 1e3, 2)
+                load["srv_queue_p50_ms"] = round(_pct(waits, 0.5) * 1e3, 2)
+                load["srv_queue_p99_ms"] = round(_pct(waits, 0.99) * 1e3, 2)
+                load["srv_dispatch_p50_ms"] = round(_pct(disp, 0.5) * 1e3, 2)
+                load["srv_dispatch_p99_ms"] = round(_pct(disp, 0.99) * 1e3, 2)
             return load
 
         sweep = []
@@ -582,6 +623,121 @@ def _serve_stage(storage, factors, pd, cfg, detail):
             best["srv_p99_ms"] < 25.0 and batched > 0)
     finally:
         server.stop()
+
+
+def _fleet_stage(storage, cfg, detail):
+    """serve_128conn fleet sweep: the SAME trained instance behind
+    1/2/4 threaded engine-server replicas and the health-routed query
+    router (serving/fleet.py + serving/router.py), hammered by the
+    out-of-process load generator at 128 keep-alive connections —
+    qps + client p99 + the merged SERVER-side p99 per replica count.
+
+    Honesty note: on a single-vCPU bench host threaded replicas share
+    one core, so scaling here measures the router's overhead + the
+    redundancy story, not multi-core speedup — per-process replicas on
+    a serving host are where the qps curve moves. The gate metric is
+    the 128-conn router-path server-side p99 at the best replica
+    count (key.fleet_srv_p99_ms_128conn, lower-better in
+    `pio bench-compare`)."""
+    import tempfile as _tf
+
+    from predictionio_tpu.serving.engine_server import EngineServer
+    from predictionio_tpu.serving.fleet import (FleetSupervisor,
+                                                threaded_fleet)
+    from predictionio_tpu.serving.router import QueryRouter
+    from predictionio_tpu.templates.recommendation import (
+        recommendation_engine,
+    )
+
+    rng = np.random.default_rng(11)
+
+    # the instance/model _serve_stage published; user ids re-derived
+    # from the stored model blob so this stage stands alone
+    import pickle as _pickle
+
+    instance = storage.engine_instances().get_latest_completed(
+        "bench_reco", "0", "default")
+    assert instance is not None, "fleet stage needs the serve stage's instance"
+    blob = storage.models().get(instance.id)
+    model = _pickle.loads(blob.models)[0]
+    inv = model.user_ids.inverse()
+    users = [inv[int(u)]
+             for u in rng.integers(0, len(model.user_ids), size=512)]
+    with _tf.NamedTemporaryFile("w", suffix=".json", delete=False) as uf:
+        json.dump(users, uf)
+        users_file = uf.name
+
+    # env-tunable for constrained hosts (defaults are the real sweep)
+    replica_counts = [int(x) for x in os.environ.get(
+        "PIO_BENCH_FLEET_REPLICAS", "1,2,4").split(",") if x.strip()]
+    conns = int(os.environ.get("PIO_BENCH_FLEET_CONNS", "128"))
+    sweep = []
+    try:
+        for n_replicas in replica_counts:
+            engine = recommendation_engine()
+
+            def factory(name, _engine=engine):
+                return EngineServer(_engine, "bench_reco",
+                                    host="127.0.0.1", port=0,
+                                    storage=storage, chaos_tag=name)
+
+            fleet = FleetSupervisor(
+                threaded_fleet(n_replicas, factory),
+                probe_interval=0.2).start()
+            router = None
+            try:
+                assert fleet.wait_ready(timeout=120), "fleet not ready"
+                router = QueryRouter(fleet, host="127.0.0.1",
+                                     port=0).start()
+                per_thread = max(20, 4800 // conns)
+                warm_counts = {}
+
+                def _snap_warmup(_fleet=fleet, _counts=warm_counts):
+                    for r in _fleet.replicas:
+                        _counts[r.name] = r.server.stats.request_count
+
+                load = _run_loadgen(router.port, users_file, conns,
+                                    per_thread, on_warmup=_snap_warmup)
+                # merged server-side serving times across replicas,
+                # warm-ups excluded exactly: the per-replica counter
+                # snapshot at the loadgen's warm-up barrier bounds each
+                # replica's timed-sample window (a warm-up burst of
+                # conns simultaneous fresh connections would otherwise
+                # outnumber the p99 cohort of the merged samples)
+                srv = []
+                for r in fleet.replicas:
+                    timed = (r.server.stats.request_count
+                             - warm_counts.get(r.name, 0))
+                    if timed > 0:
+                        srv.extend(r.server.stats.recent(timed))
+                assert srv, "no post-warm-up server-side samples"
+                srv.sort()
+                point = {
+                    "replicas": n_replicas,
+                    "conns": conns,
+                    "qps": load["qps"],
+                    "p50_ms": load["p50_ms"],
+                    "p99_ms": load["p99_ms"],
+                    "srv_p50_ms": round(_pct(srv, 0.5) * 1e3, 2),
+                    "srv_p99_ms": round(_pct(srv, 0.99) * 1e3, 2),
+                }
+                sweep.append(point)
+            finally:
+                if router is not None:
+                    router.stop()
+                fleet.stop()
+    finally:
+        os.unlink(users_file)
+    detail["fleet_sweep"] = sweep
+    best = min(sweep, key=lambda p: p["srv_p99_ms"])
+    detail["fleet_best_replicas"] = best["replicas"]
+    detail["fleet_qps_128conn"] = best["qps"]
+    detail["fleet_p99_ms_128conn"] = best["p99_ms"]
+    detail["fleet_srv_p99_ms_128conn"] = best["srv_p99_ms"]
+    detail["fleet_note"] = (
+        "threaded replicas share the bench host's core(s): the sweep "
+        "prices the router hop + redundancy, not multi-core scaling; "
+        "server-side percentiles merge all replicas' serving times")
 
 
 def stage_loadgen(config_json):
@@ -646,16 +802,21 @@ def stage_loadgen(config_json):
             rfile = sock.makefile("rb")
             # per-connection warm-up OUTSIDE the timed region (TCP
             # setup + server thread spawn are connection costs)
-            for j in range(3):
+            for j in range(3):  # graftlint: disable=JT09 — except below records to errs[] and aborts the barrier; never silent
                 one(sock, rfile, reqs[(tid + j) % len(reqs)])
-            barrier.wait()
+            barrier.wait(timeout=120)  # a stuck peer aborts the barrier
+            if tid == 0:
+                # warm-up boundary marker: every connection's warm-ups
+                # are done once the barrier releases, so the parent can
+                # snapshot server-side counters HERE to exclude them
+                print("WARMUP_DONE", flush=True)
             t_start = time.perf_counter()
         except Exception as e:  # noqa: BLE001
             errs.append(repr(e))
             barrier.abort()  # fail fast, never hang the stage
             return
         try:
-            for j in range(per_thread):
+            for j in range(per_thread):  # graftlint: disable=JT09 — except below records to errs[]; the stage reports them in its output
                 t0 = time.perf_counter()
                 one(sock, rfile, reqs[(tid * per_thread + j) % len(reqs)])
                 lat[tid].append(time.perf_counter() - t0)
@@ -665,12 +826,21 @@ def stage_loadgen(config_json):
         except Exception as e:  # noqa: BLE001
             errs.append(repr(e))
 
-    threads = [threading.Thread(target=worker, args=(t,))
+    # daemon: after a timed-out join prints the error JSON, the process
+    # must still be able to exit (interpreter shutdown joins non-daemon
+    # threads, which would hang until the parent's subprocess timeout
+    # killed us and discarded the diagnostics)
+    threads = [threading.Thread(target=worker, args=(t,), daemon=True)
                for t in range(n_threads)]
     for t in threads:
         t.start()
     for t in threads:
-        t.join()
+        # bounded join (JT12): the orchestrator's 600s subprocess
+        # timeout would otherwise be the only thing ending a hung run
+        t.join(timeout=540)
+        if t.is_alive():
+            errs.append("loadgen worker wedged past 540s")
+            break
     if errs:
         print(json.dumps({"errors": len(errs), "first": errs[0]}))
         return
@@ -890,6 +1060,7 @@ def stage_cold(base_dir, out_path):
     del trainer
 
     _serve_stage(storage, factors, pd, cfg, detail)
+    _fleet_stage(storage, cfg, detail)
 
     # clean close persists the eventlog index snapshot, so the warm
     # stage's open skips the full-log replay (production parity: servers
@@ -1156,6 +1327,10 @@ def emit_headline(detail, detail_path=None):
         "serve_32_srv_p50_ms": detail.get("serve_p50_ms_32conn_serverside"),
         "serve_32_srv_p99_ms": detail.get("serve_p99_ms_32conn_serverside"),
         "serve_32_qps": detail.get("serve_qps_32conn"),
+        # the fleet sweep's 128-conn router-path numbers (best replica
+        # count; bench-compare gates the p99 lower-better, qps higher)
+        "fleet_qps_128conn": detail.get("fleet_qps_128conn"),
+        "fleet_srv_p99_ms_128conn": detail.get("fleet_srv_p99_ms_128conn"),
     }
     if "twotower" in detail:
         tt = detail["twotower"]

@@ -189,6 +189,16 @@ class Timeline:
             if fn not in self._collectors:
                 self._collectors.append(fn)
 
+    def remove_collector(self, fn: Collector) -> None:
+        """Deregister a collector (no-op when absent). Transient
+        sources (a fleet supervisor, a test fixture) must remove
+        themselves on stop, or the timeline pins them — and everything
+        they reference — for process lifetime while their dead series
+        clobber a successor's samples."""
+        with self._lock:
+            if fn in self._collectors:
+                self._collectors.remove(fn)
+
     def sample(self, now: Optional[float] = None,
                force: bool = False) -> bool:
         """Take one sample of every collector (rate-limited by the

@@ -15,7 +15,14 @@ on true hangs, and recovery closes the loop.
 
 Spec grammar (``PIO_CHAOS`` env var, or ``POST /admin/chaos``):
 
-    site:kind[:amount][,site:kind[:amount]...]
+    site[@tag]:kind[:amount][,site[@tag]:kind[:amount]...]
+
+The optional ``@tag`` scopes a rule to ONE instance of a seam that
+exists many times per fleet: every engine-server replica runs the same
+``batcher`` seam, and ``batcher@r1:hang:5s`` hangs only the replica
+whose chaos tag is ``r1`` (the fleet supervisor tags replicas by name;
+a standalone server tags itself via ``PIO_CHAOS_TAG``). An untagged
+rule matches every instance, tagged or not.
 
   kinds:
     latency:50ms   sleep that long at the seam (ms/s suffix; bare
@@ -180,10 +187,15 @@ def add(spec: str) -> List[ChaosRule]:
 
 
 def clear(site: Optional[str] = None) -> None:
-    """Drop every rule, or only ``site``'s."""
+    """Drop every rule, or only ``site``'s — INCLUDING its tagged
+    variants (``clear("batcher")`` drops ``batcher@r1`` too: an
+    operator clearing a seam means the whole seam, not just the
+    untagged spelling). An exact ``site@tag`` clears one instance."""
     with _lock:
         kept = (() if site is None
-                else tuple(r for r in _rules if r.site != site))
+                else tuple(r for r in _rules
+                           if r.site != site
+                           and not r.site.startswith(site + "@")))
     _install(kept, explicit=True)
 
 
@@ -252,26 +264,31 @@ def apply_admin(payload: Dict[str, Any]) -> Dict[str, Any]:
     return describe()
 
 
-def inject(site: str) -> None:
+def inject(site: str, tag: Optional[str] = None) -> None:
     """The seam hook. Applies every active rule for ``site``, in rule
     order: latency/hang sleep, error raises :class:`ChaosError` with
     its probability. No active rules = one tuple load and out — the
-    hot path cost of an idle harness is nil."""
+    hot path cost of an idle harness is nil.
+
+    ``tag`` names THIS instance of the seam (a fleet replica's name):
+    untagged rules (``site``) match every instance; tagged rules
+    (``site@tag``) match only the instance carrying that tag."""
     rules = _rules
     if not rules:
         _ensure_env_loaded()
         rules = _rules
         if not rules:
             return
+    qualified = f"{site}@{tag}" if tag else None
     for rule in rules:
-        if rule.site != site:
+        if rule.site != site and rule.site != qualified:
             continue
         if rule.kind in ("latency", "hang"):
-            _INJECTIONS.labels(site, rule.kind).inc()
+            _INJECTIONS.labels(rule.site, rule.kind).inc()
             time.sleep(rule.amount)
         elif rule.kind == "error":
             if _rng.random() < rule.amount:
-                _INJECTIONS.labels(site, rule.kind).inc()
+                _INJECTIONS.labels(rule.site, rule.kind).inc()
                 raise ChaosError(
                     f"chaos: injected {rule.spec()} fault at the "
                     f"{site} seam")

@@ -6,6 +6,13 @@ Maps the reference's server layer (SURVEY.md §1 L6):
                   (ref: data/.../api/EventAPI.scala)
   engine_server — deployed-engine query serving, port 8000
                   (ref: core/.../workflow/CreateServer.scala)
+  fleet         — replica supervisor: N engine-server replicas,
+                  readyz-driven rotation, backoff restarts, rolling
+                  zero-downtime hot-swap (beyond the reference's
+                  single process)
+  router        — the fleet's public front door: least-loaded
+                  placement, per-replica circuit breakers, hedged
+                  tail-latency requests, 429/degraded passthrough
   stats         — per-app operational counters
                   (ref: data/.../api/Stats.scala, StatsActor.scala)
   webhooks      — third-party payload connectors

@@ -30,6 +30,7 @@ from __future__ import annotations
 import datetime as _dt
 import json
 import logging
+import os
 import threading
 import time
 import urllib.request
@@ -85,12 +86,14 @@ def _http_inflight() -> float:
 class ServingStats:
     """Request bookkeeping (ref: CreateServer.scala:552-559).
 
-    Counts, totals and percentiles live in the shared
-    ``pio_serving_request_seconds{engine=...}`` histogram — the status
-    page and ``GET /metrics`` report from one source of truth. A
-    bounded window of raw per-request times is kept alongside for
-    ``recent()`` (bench.py reads exact server-side samples; histogram
-    buckets would quantize them)."""
+    Every record lands in the shared, engine-wide
+    ``pio_serving_request_seconds{engine=...}`` histogram — the
+    percentiles on the status page and ``GET /metrics`` report from
+    that one source of truth. Counts/totals are additionally tracked
+    per ServingStats (per server — fleet replicas need per-replica
+    numbers), and a bounded window of raw per-request times is kept
+    alongside for ``recent()`` (bench.py reads exact server-side
+    samples; histogram buckets would quantize them)."""
 
     WINDOW = 8192
 
@@ -98,25 +101,28 @@ class ServingStats:
         import collections
 
         self._lock = threading.Lock()
-        # a new ServingStats means a new server for this engine: its
-        # series restarts from zero (same as a process restart would).
-        # Last-created-wins: if an OLDER in-process server for the same
-        # engine_id is still alive, it keeps recording into an orphaned
-        # child that /metrics no longer renders — two live servers for
-        # one engine id have no per-server answer on a shared registry
-        _SERVING_SECONDS.remove(engine_id)
+        # the registry child is process-global per engine: every live
+        # server for this engine (N threaded fleet replicas included)
+        # records into the SAME series, so /metrics, the serving-latency
+        # SLO and burn-driven shedding see ALL traffic — a regression
+        # confined to one replica must still move the shared histogram.
+        # Per-SERVER bookkeeping (status page counts, recent()) lives
+        # locally: a new server starts its own counts from zero while
+        # the registry series stays cumulative, Prometheus-style.
         self._hist = _SERVING_SECONDS.labels(engine_id)
+        self._count = 0
+        self._sum = 0.0
         self.last_serving_sec = 0.0
         self.start_time = _dt.datetime.now(tz=UTC)
         self._window: collections.deque = collections.deque(maxlen=self.WINDOW)
 
     @property
     def request_count(self) -> int:
-        return self._hist.count
+        return self._count
 
     @property
     def total_serving_sec(self) -> float:
-        return self._hist.sum
+        return self._sum
 
     def record(self, seconds: float) -> None:
         # the serving request's trace id rides along as an OpenMetrics
@@ -126,6 +132,8 @@ class ServingStats:
             seconds,
             exemplar={"trace_id": trace_id} if trace_id else None)
         with self._lock:
+            self._count += 1
+            self._sum += seconds
             self.last_serving_sec = seconds
             self._window.append(seconds)
 
@@ -136,13 +144,16 @@ class ServingStats:
         return out if n is None else out[-n:]
 
     def snapshot(self) -> dict:
-        count, total = self._hist.snapshot()
+        with self._lock:
+            count, total = self._count, self._sum
         return {
             "startTime": self.start_time.isoformat(),
             "requestCount": count,
             "avgServingSec": total / count if count else 0.0,
             "lastServingSec": self.last_serving_sec,
-            # bucket-interpolated, the PromQL histogram_quantile estimate
+            # bucket-interpolated, the PromQL histogram_quantile
+            # estimate over the engine-wide shared series (all
+            # in-process servers for this engine, /metrics' view)
             "p50ServingSec": self._hist.quantile(0.50),
             "p99ServingSec": self._hist.quantile(0.99),
         }
@@ -190,13 +201,18 @@ class MicroBatcher:
     ``/readyz`` DEGRADED before callers start timing out.
     """
 
-    def __init__(self, run_batch, run_one, max_batch: int = 64):
+    def __init__(self, run_batch, run_one, max_batch: int = 64,
+                 chaos_tag: Optional[str] = None):
         import queue as _queue
         import weakref
 
         self._run_batch = run_batch
         self._run_one = run_one
         self._max_batch = max_batch
+        # names THIS batcher at the chaos seam: a fleet tags each
+        # replica's batcher by replica name, so `batcher@r1:hang:5s`
+        # hangs one replica while its peers keep answering
+        self._chaos_tag = chaos_tag
         self._queue: "_queue.Queue[_Pending]" = _queue.Queue()
         # readiness probe over the queue depth (weakref: a dropped
         # batcher must not be kept alive by the health registry)
@@ -207,7 +223,13 @@ class MicroBatcher:
             lambda: (q.qsize() if (q := queue_ref()) is not None
                      else None),
             max(1, depth_limit))
-        health.REGISTRY.register("serving_queue", self._queue_probe)
+        # namespaced per replica on the shared process registry:
+        # threaded fleet replicas each get their own probe (an
+        # un-namespaced name is last-registration-wins, which would
+        # hide every other replica's queue backlog from readiness)
+        self._probe_name = ("serving_queue" if chaos_tag is None
+                            else f"serving_queue:{chaos_tag}")
+        health.REGISTRY.register(self._probe_name, self._queue_probe)
         # batch-size histogram: the observable proof that amortization
         # actually happens under load (VERDICT r3 item 6) — exposed in
         # the server's status JSON
@@ -256,7 +278,7 @@ class MicroBatcher:
             self._queue.put(_Pending(None))  # wake the worker
         # remove only OUR probe: if a newer in-process batcher already
         # re-registered the name, its live probe must survive this stop
-        health.REGISTRY.unregister("serving_queue", self._queue_probe)
+        health.REGISTRY.unregister(self._probe_name, self._queue_probe)
         # the worker's shutdown drain answers everything still queued, so
         # no submitter blocks out its full timeout on a dying server
         self._worker.join(timeout=60)
@@ -282,7 +304,7 @@ class MicroBatcher:
                     # dispatch watchdog's watch window (a chaos hang is
                     # what tier-1 uses to prove the watchdog still
                     # fires), injected errors fail this batch's waiters
-                    chaos.inject("batcher")
+                    chaos.inject("batcher", tag=self._chaos_tag)
                     self._answer(batch)
             except Exception as e:  # noqa: BLE001 — a dead worker starves
                 # every future submitter silently; log, fail THIS batch's
@@ -445,6 +467,7 @@ class EngineServer(HTTPServerBase):
         micro_batch: bool = True,
         max_batch: int = 64,
         slo_conf: Optional[dict] = None,
+        chaos_tag: Optional[str] = None,
     ):
         self.engine = engine
         self.engine_id = engine_id
@@ -465,9 +488,13 @@ class EngineServer(HTTPServerBase):
         self._storage_breaker = breaker_for(f"storage:{engine_id}",
                                             failure_threshold=2)
         self.deployment: Deployment = self._load_latest()
+        # chaos identity: a fleet replica is tagged by its supervisor
+        # (subprocess replicas via PIO_CHAOS_TAG) so operators can fault
+        # ONE replica of a fleet; a standalone server stays untagged
+        self.chaos_tag = chaos_tag or os.environ.get("PIO_CHAOS_TAG") or None
         self._batcher: Optional[MicroBatcher] = (
             MicroBatcher(self._query_batch_now, self._query_now,
-                         max_batch=max_batch)
+                         max_batch=max_batch, chaos_tag=self.chaos_tag)
             if micro_batch else None
         )
 

@@ -39,7 +39,9 @@ from predictionio_tpu.data.event import Event, EventValidationError, validate_ev
 from predictionio_tpu.data.storage import UNSET, Storage, StorageError, get_storage
 from predictionio_tpu.obs import flight, perfacct
 from predictionio_tpu.obs import logging as obs_logging
-from predictionio_tpu.serving.http import HTTPServerBase, JSONRequestHandler
+from predictionio_tpu.serving.http import (HTTPServerBase,
+                                           JSONRequestHandler,
+                                           install_drain_handler)
 from predictionio_tpu.serving.stats import Stats
 from predictionio_tpu.serving import webhooks as webhook_registry
 from predictionio_tpu.serving.webhooks import ConnectorError
@@ -415,7 +417,11 @@ def main(argv=None) -> None:
     args = parser.parse_args(argv)
     # structured JSON log lines with trace-id correlation (obs/logging)
     obs_logging.setup(level=logging.INFO)
-    EventServer(host=args.ip, port=args.port).serve_forever()
+    server = EventServer(host=args.ip, port=args.port)
+    # SIGTERM closes the listening socket and drains in-flight events
+    # before exit — a kill mid-request must not drop the connection
+    install_drain_handler(server)
+    server.serve_forever()
 
 
 if __name__ == "__main__":

@@ -23,6 +23,9 @@ Every server also inherits the shared operator surface from the
                          data-path ledger            }
   GET  /admin/tail       tail-latency attribution    }
                          (above-p95 stage shares)    }
+  GET/POST /admin/fleet  replica fleet snapshot /    }
+                         rolling-swap control (404   }
+                         on servers without a fleet) }
 
 ``/healthz``, ``/readyz`` and ``/metrics`` stay unauthenticated — a
 liveness prober or scraper holds no operator secrets; the ``/admin/*``
@@ -245,6 +248,37 @@ def _serve_admin_tail(handler, query: str) -> None:
     handler._send(200, report)
 
 
+def _serve_admin_fleet(handler) -> None:
+    """``GET /admin/fleet``: the replica fleet's snapshot (states,
+    versions, restart counts, swap progress). ``POST /admin/fleet``:
+    control — ``{"reload": true}`` starts a rolling zero-downtime
+    hot-swap, ``{"drain"|"readmit": "<replica>"}`` takes a replica out
+    of / back into rotation. 404 on servers that supervise no fleet."""
+    fleet = getattr(handler.server_ref, "fleet", None)
+    if fleet is None:
+        handler._send(404, {"message": "no fleet supervised by this "
+                                       "server"})
+        return
+    if handler.command == "GET":
+        handler._send(200, fleet.snapshot())
+        return
+    if handler.command != "POST":
+        handler._send(405, {"message": "GET or POST"})
+        return
+    try:
+        result = fleet.apply_admin(handler._read_json())
+    except (json.JSONDecodeError, ValueError) as e:
+        handler._send(400, {"message": str(e)})
+        return
+    if "started" in result:
+        # mirror the router's GET /reload: 202 on a freshly started
+        # swap, 409 when one is already running (a 200 here read as
+        # "done" to callers probing either route)
+        handler._send(202 if result["started"] else 409, result)
+        return
+    handler._send(200, result)
+
+
 def _instrument(fn):
     """Wrap a do_METHOD handler: serve the shared routes (``GET
     /metrics``, ``GET /admin/flight``, ``POST /admin/profile``),
@@ -300,6 +334,9 @@ def _instrument(fn):
                 return
             if self.command == "GET" and path == "/admin/tail":
                 _serve_admin_tail(self, parsed.query)
+                return
+            if path == "/admin/fleet":
+                _serve_admin_fleet(self)
                 return
             if self.command == "GET" and path == "/admin/resilience":
                 # breaker states + admission snapshot (when the server
@@ -495,6 +532,10 @@ class HTTPServerBase:
 
     def __init__(self, host: str, port: int, handler_cls: type,
                  bind_retries: int = 1):
+        # the in-flight gauge's label for THIS server class — drain
+        # derives it the same way _instrument does, so a rename cannot
+        # silently point the drain wait at an untouched child
+        self._server_label = handler_cls.server_version.split("/", 1)[0]
         handler = type("Handler", (handler_cls,), {"server_ref": self})
         attempts = max(1, bind_retries)
         for attempt in range(attempts):
@@ -550,3 +591,94 @@ class HTTPServerBase:
             self.httpd.shutdown()
             self._serving = False
         self.httpd.server_close()
+
+    def inflight_count(self) -> float:
+        """Requests currently inside handlers of THIS server class
+        (shared-process caveat: the gauge is labeled per server CLASS,
+        so two same-class servers in one process read a joint count —
+        the drain then waits for both, which errs safe)."""
+        family = metrics.REGISTRY.get("pio_http_requests_in_flight")
+        if family is None:
+            return 0.0
+        return family.labels(self._server_label).value
+
+    def drain_stop(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: stop ACCEPTING first (serve loop halted,
+        listening socket closed so new connections are refused instead
+        of rotting in the backlog), then wait — bounded by ``timeout``
+        (default ``PIO_DRAIN_TIMEOUT``, 30s) — for in-flight handlers
+        to write their responses, then ``stop()`` (which also stops
+        per-server subsystems, e.g. the engine server's batcher).
+        Returns True when everything drained inside the window."""
+        if timeout is None:
+            timeout = drain_timeout()
+        if self._serving:
+            self.httpd.shutdown()
+            self._serving = False
+        self.httpd.server_close()
+        deadline = time.monotonic() + max(0.0, timeout)
+        while self.inflight_count() > 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        leftover = int(self.inflight_count())
+        if leftover:
+            log.warning(
+                "%s drain window (%.1fs) expired with %d request(s) "
+                "still in flight — stopping anyway", type(self).__name__,
+                timeout, leftover)
+        self.stop()
+        return leftover == 0
+
+
+DEFAULT_DRAIN_TIMEOUT_SEC = 30.0
+
+
+def drain_timeout() -> float:
+    """The SIGTERM drain window (``PIO_DRAIN_TIMEOUT`` seconds)."""
+    return max(0.0, metrics.env_float("PIO_DRAIN_TIMEOUT",
+                                      DEFAULT_DRAIN_TIMEOUT_SEC))
+
+
+def install_drain_handler(*servers, timeout: Optional[float] = None):
+    """SIGTERM -> drain-then-stop for every server of this process.
+
+    The one graceful-shutdown path shared by the engine, event and
+    storage server mains (previously a kill mid-request dropped the
+    connection on the floor): on SIGTERM each server stops accepting,
+    finishes what it already admitted (bounded by ``PIO_DRAIN_TIMEOUT``)
+    and stops — after which ``serve_forever`` returns and the main
+    exits normally. The drain runs on its OWN NON-daemon thread, and
+    both properties are load-bearing: the signal fires in the main
+    thread — usually the one blocked inside ``serve_forever`` — so
+    calling ``shutdown()`` there would deadlock waiting for a serve
+    loop that cannot advance under the handler; and the very first
+    thing ``drain_stop`` does is unblock that ``serve_forever``, after
+    which the main returns and the interpreter starts exiting — a
+    DAEMON drain thread (and the daemon handler threads still writing
+    responses) would be killed mid-drain, dropping exactly the
+    connections this handler exists to protect. Non-daemon, the
+    interpreter waits for the drain to finish before finalizing.
+
+    Returns the installed handler so tests can invoke it directly
+    (``handler()``) without delivering a real signal. Must be called
+    from the main thread (CPython signal contract)."""
+    import signal
+
+    def _drain(signum=None, frame=None):
+        def run():
+            log.info("SIGTERM: draining %d server(s), window %.1fs",
+                     len(servers),
+                     drain_timeout() if timeout is None else timeout)
+            for server in servers:
+                try:
+                    server.drain_stop(timeout)
+                except Exception:  # noqa: BLE001 — one server's failed
+                    # drain must not strand its siblings un-stopped
+                    log.exception("drain failed for %r", server)
+
+        # non-daemon: holds the interpreter open until the drain
+        # completes (see docstring) — bounded by drain_stop's window
+        threading.Thread(target=run, daemon=False,
+                         name="pio-drain").start()
+
+    signal.signal(signal.SIGTERM, _drain)
+    return _drain

@@ -71,7 +71,9 @@ from predictionio_tpu.data.storage import (
     get_storage,
     npz_to_columns,
 )
-from predictionio_tpu.serving.http import HTTPServerBase, JSONRequestHandler
+from predictionio_tpu.serving.http import (HTTPServerBase,
+                                           JSONRequestHandler,
+                                           install_drain_handler)
 
 log = logging.getLogger(__name__)
 
@@ -664,6 +666,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="require X-PIO-Storage-Key on every request")
     args = parser.parse_args(argv)
     server = StorageServer(host=args.host, port=args.port, auth_key=args.auth_key)
+    # SIGTERM closes the listening socket and drains in-flight scans
+    # before exit — a kill mid-request must not drop the connection
+    install_drain_handler(server)
     print(f"Storage server listening on {args.host}:{server.port}")
     try:
         server.serve_forever()

@@ -30,6 +30,9 @@ Console.scala:128-735 command surface; bin/pio:17-42 wrapper):
                          — sparklines from a server's /admin/timeline
                          or the in-process rings; --once --json for
                          scripts)
+  fleet                 (serving fleet via the router's /admin/fleet:
+                         replica states, rolling hot-swap, drain/
+                         readmit; `deploy --replicas N` runs one)
 
 Run as ``python -m predictionio_tpu.tools.cli <command> ...``.
 """
@@ -248,8 +251,14 @@ def cmd_eval(args) -> int:
 
 
 def cmd_deploy(args) -> int:
+    from predictionio_tpu.obs import metrics
     from predictionio_tpu.serving.engine_server import EngineServer
+    from predictionio_tpu.serving.http import install_drain_handler
 
+    replicas = (args.replicas if args.replicas is not None
+                else metrics.env_int("PIO_REPLICAS", 1))
+    if replicas > 1:
+        return _deploy_fleet(args, replicas)
     variant = _load_variant(args.engine_json)
     engine = variant.create_engine()
     engine_id = args.engine_id or variant.raw.get("engineId") or variant.engine_factory
@@ -266,8 +275,90 @@ def cmd_deploy(args) -> int:
         # the variant's declarative objectives + shedding thresholds
         slo_conf=variant.slo_conf(),
     )
+    # SIGTERM drains in-flight queries before the port closes (a fleet
+    # supervisor's terminate, or any orchestrator's stop, is graceful)
+    install_drain_handler(server)
     _p(f"Engine {engine_id} deployed on {args.ip}:{server.port}")
     server.serve_forever()
+    return 0
+
+
+def _deploy_fleet(args, replicas: int) -> int:
+    """`pio deploy --replicas N`: N single-server children on ephemeral
+    ports behind the query router on the public port (threaded replicas
+    with --replica-mode=thread — same wiring, one process)."""
+    from predictionio_tpu.serving.fleet import (
+        FleetSupervisor, deploy_fleet_argv, subprocess_fleet,
+        threaded_fleet)
+    from predictionio_tpu.serving.http import (drain_timeout,
+                                               install_drain_handler)
+    from predictionio_tpu.serving.router import QueryRouter
+    from predictionio_tpu.workflow.deploy import latest_completed_instance_id
+
+    variant = _load_variant(args.engine_json)
+    engine_id = (args.engine_id or variant.raw.get("engineId")
+                 or variant.engine_factory)
+    if args.replica_mode == "thread":
+        from predictionio_tpu.serving.engine_server import EngineServer
+
+        engine = variant.create_engine()
+
+        def factory(name):
+            return EngineServer(
+                engine, engine_id=engine_id,
+                engine_version=args.engine_version,
+                engine_variant=variant.id, host="127.0.0.1", port=0,
+                feedback_url=args.feedback_url,
+                feedback_access_key=args.accesskey,
+                log_url=args.log_url, slo_conf=variant.slo_conf(),
+                chaos_tag=name)
+
+        members = threaded_fleet(replicas, factory)
+    else:
+        argv = deploy_fleet_argv(args.engine_json)
+        if args.engine_id:
+            argv += ["--engine-id", args.engine_id]
+        if args.engine_version != "0":
+            argv += ["--engine-version", args.engine_version]
+        # the per-server wiring must survive the subprocess hop — a
+        # fleet with silently-dropped feedback/error-log plumbing is
+        # not the same deployment
+        if args.feedback_url:
+            argv += ["--feedback-url", args.feedback_url]
+        if args.accesskey:
+            argv += ["--accesskey", args.accesskey]
+        if args.log_url:
+            argv += ["--log-url", args.log_url]
+        members = subprocess_fleet(replicas, argv)
+
+    from predictionio_tpu.data.storage import get_storage
+
+    storage = get_storage()
+    fleet = FleetSupervisor(
+        members,
+        version_source=lambda: latest_completed_instance_id(
+            storage, engine_id, args.engine_version, variant.id),
+    ).start()
+    router = QueryRouter(fleet, host=args.ip, port=args.port)
+    install_drain_handler(router)
+    _p(f"Engine {engine_id} deployed: {replicas} "
+       f"{args.replica_mode} replica(s) behind router on "
+       f"{args.ip}:{router.port} (fleet status: /admin/fleet; rolling "
+       "hot-swap: GET /reload)")
+    try:
+        router.serve_forever()
+    finally:
+        # serve_forever returns the moment the SIGTERM drain stops the
+        # router ACCEPTING — its admitted requests are still draining
+        # on the pio-drain thread and need live replicas to answer, so
+        # the fleet must outlive them (bounded by the drain window)
+        import time as _time
+
+        deadline = _time.monotonic() + drain_timeout() + 5.0
+        while (router.inflight_count() > 0
+               and _time.monotonic() < deadline):
+            _time.sleep(0.05)
+        fleet.stop()
     return 0
 
 
@@ -286,8 +377,10 @@ def cmd_undeploy(args) -> int:
 
 def cmd_eventserver(args) -> int:
     from predictionio_tpu.serving.event_server import EventServer
+    from predictionio_tpu.serving.http import install_drain_handler
 
     server = EventServer(host=args.ip, port=args.port)
+    install_drain_handler(server)
     _p(f"Event server running on {args.ip}:{server.port}")
     server.serve_forever()
     return 0
@@ -314,9 +407,11 @@ def cmd_dashboard(args) -> int:
 def cmd_storageserver(args) -> int:
     """Serve this host's configured storage to `rest`-backend peers
     (the scale-out tier: HBase/ES/HDFS roles behind one HTTP service)."""
+    from predictionio_tpu.serving.http import install_drain_handler
     from predictionio_tpu.serving.storage_server import StorageServer
 
     server = StorageServer(host=args.ip, port=args.port, auth_key=args.auth_key)
+    install_drain_handler(server)
     _p(f"Storage server running on {args.ip}:{server.port}")
     server.serve_forever()
     return 0
@@ -709,6 +804,60 @@ def cmd_chaos(args) -> int:
     return 0
 
 
+def cmd_fleet(args) -> int:
+    """Inspect or control a serving fleet through its router's
+    ``/admin/fleet`` (serving/fleet.py): default output is one line per
+    replica (state, version, restarts, outstanding); ``--reload``
+    starts the rolling zero-downtime hot-swap, ``--drain``/``--readmit``
+    move one replica out of / into rotation."""
+    import urllib.error
+    import urllib.request
+
+    body = {}
+    if args.reload:
+        body["reload"] = True
+    if args.drain is not None:
+        body["drain"] = args.drain
+    if args.readmit is not None:
+        body["readmit"] = args.readmit
+    url = args.url.rstrip("/") + "/admin/fleet"
+    if body:
+        req = urllib.request.Request(
+            url, data=json.dumps(body).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+    else:
+        req = urllib.request.Request(url)
+    _add_admin_auth(req)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            state = json.load(resp)
+    except urllib.error.HTTPError as e:
+        raise CommandError(
+            f"fleet request failed ({e.code}): "
+            f"{e.read().decode(errors='replace')[:200]}")
+    except urllib.error.URLError as e:
+        raise CommandError(f"cannot reach {args.url}: {e.reason}")
+    if args.json:
+        json.dump(state, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+        return 0
+    if body:
+        _p(state.get("message") or json.dumps(state))
+        return 0
+    _p(f"fleet: {state['ready']}/{state['size']} ready, serving "
+       f"version {state['version'] or '(mixed/none)'}")
+    for r in state["replicas"]:
+        _p(f"  {r['name']:>6} {r['state']:<9} port={r['port'] or '-':<6} "
+           f"version={r['version'] or '-':<34} restarts={r['restarts']} "
+           f"outstanding={r['outstanding']}")
+    from predictionio_tpu.serving.fleet import format_swap
+
+    swap = state.get("swap") or {}
+    if swap.get("active") or swap.get("last"):
+        _p(format_swap(swap))
+    return 0
+
+
 def _fetch_timeline(url: Optional[str]) -> dict:
     """One timeline payload: a server's ``GET /admin/timeline`` when
     ``url`` is given (PIO_ADMIN_TOKEN bearer attached when set), else
@@ -825,7 +974,7 @@ def cmd_bench_compare(args) -> int:
 
 def cmd_lint(args) -> int:
     """graftlint: the JAX/TPU-aware static analysis over the tree
-    (rules JT01-JT11; tier-1 CI runs the same pass via tests/test_lint_clean.py)."""
+    (rules JT01-JT12; tier-1 CI runs the same pass via tests/test_lint_clean.py)."""
     from predictionio_tpu.tools.lint import run_cli
 
     try:
@@ -960,6 +1109,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-url", default=None,
                    help="POST serve errors to this URL "
                         "(ref: CreateServer.scala:413-424)")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="serve from N engine-server replicas behind a "
+                        "health-routed query router on --port "
+                        "(default: PIO_REPLICAS or 1 = the classic "
+                        "single server)")
+    p.add_argument("--replica-mode", choices=["subprocess", "thread"],
+                   default="subprocess",
+                   help="replica isolation: subprocesses on ephemeral "
+                        "ports (production) or in-process threaded "
+                        "servers (single-host / tests)")
     p.set_defaults(func=cmd_deploy)
 
     p = sub.add_parser("undeploy", help="stop a deployed engine server")
@@ -1101,6 +1260,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", action="store_true",
                    help="dump the raw rule-set JSON")
     p.set_defaults(func=cmd_chaos)
+
+    p = sub.add_parser(
+        "fleet",
+        help="inspect or control a serving fleet through its router "
+             "(GET/POST /admin/fleet; serving/fleet.py): replica "
+             "states, rolling hot-swap, drain/readmit",
+    )
+    p.add_argument("--url", default="http://127.0.0.1:8000",
+                   help="base URL of the fleet's router (sends the "
+                        "PIO_ADMIN_TOKEN bearer header when set)")
+    p.add_argument("--reload", action="store_true",
+                   help="start a rolling zero-downtime hot-swap onto "
+                        "the newest COMPLETED instance")
+    p.add_argument("--drain", default=None, metavar="REPLICA",
+                   help="take REPLICA out of rotation")
+    p.add_argument("--readmit", default=None, metavar="REPLICA",
+                   help="put REPLICA back into rotation (readiness "
+                        "probes permitting)")
+    p.add_argument("--json", action="store_true",
+                   help="dump the raw fleet snapshot JSON")
+    p.set_defaults(func=cmd_fleet)
 
     p = sub.add_parser(
         "top",

@@ -30,6 +30,14 @@ reference — operator views of this process's diagnostics:
                            request rate, plus the data-path ledger's
                            per-run stage table. JSON at
                            /admin/timeline.
+  GET /fleet            -> HTML panel of the serving fleet(s)
+                           supervised IN THIS PROCESS
+                           (serving/fleet.py ACTIVE registry —
+                           `pio deploy --replicas` / threaded tests;
+                           a remote fleet's JSON lives on its router
+                           at /admin/fleet): per-replica state,
+                           version, restarts, outstanding load, and
+                           rolling-swap progress.
 """
 
 from __future__ import annotations
@@ -82,6 +90,10 @@ class _DashboardRequestHandler(JSONRequestHandler):
             return
         if path == "/timeline":
             self._send_cors(200, self.server_ref.timeline_html(),
+                            "text/html; charset=UTF-8")
+            return
+        if path == "/fleet":
+            self._send_cors(200, self.server_ref.fleet_html(),
                             "text/html; charset=UTF-8")
             return
         parts = [p for p in path.split("/") if p]
@@ -152,6 +164,7 @@ class DashboardServer(HTTPServerBase):
             '<a href="/slo">SLO burn rates</a> · '
             '<a href="/resilience">resilience</a> · '
             '<a href="/timeline">timelines</a> · '
+            '<a href="/fleet">fleet</a> · '
             '<a href="/metrics">metrics</a> · '
             '<a href="/readyz">readiness</a></p>'
             "</body></html>"
@@ -295,6 +308,56 @@ class DashboardServer(HTTPServerBase):
         ).format(interval=payload["interval_sec"], cap=payload["capacity"],
                  series_rows=series_rows,
                  stale=datapath["staleness_seconds"], run_rows=run_rows)
+
+    def fleet_html(self) -> str:
+        """The serving fleet(s) supervised in THIS process as an
+        operator panel: one table per supervisor — replica state
+        (colored), version, restarts, outstanding router requests —
+        plus the last rolling-swap verdict. A fleet running in another
+        process is one `pio fleet --url <router>` away."""
+        from predictionio_tpu.serving import fleet as _fleet
+
+        color = {"ready": "#27ae60", "starting": "#e67e22",
+                 "evicted": "#e67e22", "draining": "#2980b9",
+                 "dead": "#c0392b", "stopped": "#888"}
+        sections = []
+        for i, supervisor in enumerate(list(_fleet.ACTIVE)):
+            snap = supervisor.snapshot()
+            rows = "".join(
+                '<tr><td>{name}</td><td style="color:{c};'
+                'font-weight:bold">{state}</td><td>{port}</td>'
+                "<td><code>{version}</code></td><td>{restarts}</td>"
+                "<td>{outstanding}</td></tr>".format(
+                    name=html.escape(r["name"]),
+                    c=color.get(r["state"], "#888"),
+                    state=html.escape(r["state"]),
+                    port=r["port"] or "–",
+                    version=html.escape(str(r["version"] or "–")[:16]),
+                    restarts=r["restarts"],
+                    outstanding=r["outstanding"])
+                for r in snap["replicas"])
+            swap_line = _fleet.format_swap(snap["swap"])
+            sections.append(
+                f"<h2>Fleet {i}: {snap['ready']}/{snap['size']} ready, "
+                f"version <code>"
+                f"{html.escape(str(snap['version'] or 'mixed/none'))}"
+                "</code></h2>"
+                "<table border='1'><tr><th>Replica</th><th>State</th>"
+                "<th>Port</th><th>Version</th><th>Restarts</th>"
+                f"<th>Outstanding</th></tr>{rows}</table>"
+                f"<p>{html.escape(swap_line)}</p>")
+        body = "".join(sections) or (
+            "<p>No fleet supervised in this process — "
+            "<code>pio deploy --replicas N</code> runs one, and a "
+            "remote fleet answers <code>pio fleet --url "
+            "&lt;router&gt;</code>.</p>")
+        return (
+            "<!DOCTYPE html><html><head><title>Serving fleet</title>"
+            "</head><body><h1>Serving fleet</h1>"
+            f"{body}"
+            '<p><a href="/admin/fleet">JSON (on the router)</a> · '
+            '<a href="/">index</a></p></body></html>'
+        )
 
     def resilience_html(self) -> str:
         """Breaker states, shed counters and chaos rules of THIS

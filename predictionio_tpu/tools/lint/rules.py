@@ -1,4 +1,4 @@
-"""graftlint rules JT01-JT11: the TPU hazards this codebase has hit.
+"""graftlint rules JT01-JT12: the TPU hazards this codebase has hit.
 
 Each rule encodes a failure class with a concrete precedent in this
 tree's history (the bf16-Gramian divergence behind JT03 is recorded in
@@ -1126,3 +1126,79 @@ class UnboundedMetricLabelCardinality(Rule):
                         "bound; label by a bounded dimension and put "
                         "the id in an exemplar, span or flight record",
                     )
+
+
+# -- JT12 ----------------------------------------------------------------------
+
+@register
+class JoinWaitWithoutTimeout(Rule):
+    id = "JT12"
+    name = "join-wait-without-timeout"
+    rationale = (
+        "A bare Thread.join() / Process.join() / Event.wait() / "
+        "Popen.wait() blocks its caller for as long as the other side "
+        "cares to stay stuck: a fleet supervisor joining a dead "
+        "replica's thread, a main waiting on a wedged child process, "
+        "or a shutdown path waiting on an event nobody will ever set "
+        "hangs FOREVER — precisely during the crash it exists to "
+        "clean up after. Pass timeout= (and handle the expiry) so a "
+        "dead peer costs a bounded wait, never a hung supervisor. "
+        "Receivers with NO timeout parameter (queue.Queue.join, "
+        "multiprocessing Pool.join, os.wait) are exempted by receiver-"
+        "name heuristic; anything the heuristic misses documents "
+        "itself with a suppression comment."
+    )
+
+    #: receiver name fragments whose join()/wait() take no timeout at
+    #: all — flagging them would demand an impossible fix
+    _NO_TIMEOUT_RECEIVERS = ("queue", "pool")
+
+    @staticmethod
+    def _is_none(n: ast.AST) -> bool:
+        return isinstance(n, ast.Constant) and n.value is None
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        _is_none = self._is_none
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr not in ("join", "wait"):
+                continue
+            # any argument can carry the timeout: str.join(iterable),
+            # thread.join(5), futures.wait(fs, 10) all pass — but a
+            # literal None (join(None) / wait(timeout=None)) is the
+            # bare unbounded wait spelled out, not a bound
+            if (any(not _is_none(a) for a in node.args)
+                    or any(kw.arg == "timeout" and not _is_none(kw.value)
+                           for kw in node.keywords)):
+                continue
+            if any(kw.arg is None for kw in node.keywords):
+                continue  # **kwargs may carry it; not decidable
+            # receiver-is-a-call: Pallas DMA descriptors
+            # (`make_async_copy(...).wait()`) and friends — a device-
+            # side completion wait with no timeout concept, not a
+            # thread join
+            if isinstance(func.value, ast.Call):
+                continue
+            # receivers whose join/wait signature has no timeout:
+            # os.wait(), queue.join(), pool.join() — "pass timeout="
+            # would be a TypeError, so the rule must stay silent
+            receiver = dotted(func.value).lower()
+            tail = receiver.rsplit(".", 1)[-1]
+            # the exempting noun must be the receiver's HEAD word (the
+            # last underscore segment: work_queue, worker_pool) — a
+            # substring test would also swallow queue_drained_evt.wait()
+            # / pool_ready.wait(), which are exactly the hazard class
+            if receiver == "os" or (tail.rsplit("_", 1)[-1]
+                                    in self._NO_TIMEOUT_RECEIVERS):
+                continue
+            yield Finding(
+                self.id, ctx.path, node.lineno, node.col_offset,
+                f"bare `.{func.attr}()` with no timeout — a dead/"
+                "wedged peer blocks this thread forever (a supervisor "
+                "must never hang on a dead replica); pass timeout= "
+                "and handle the expiry",
+            )

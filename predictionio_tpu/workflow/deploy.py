@@ -91,6 +91,23 @@ class Deployment:
         ]
 
 
+def latest_completed_instance_id(
+    storage: Storage,
+    engine_id: str,
+    engine_version: str = "0",
+    engine_variant: str = "default",
+) -> Optional[str]:
+    """The newest COMPLETED instance id for an engine, or None.
+
+    The fleet supervisor's swap trigger: a train run publishing a new
+    COMPLETED instance moves this id, and the fleet rolls replicas onto
+    it one at a time (serving/fleet.py) — the multi-replica analogue of
+    the single server's ``GET /reload``."""
+    instance = storage.engine_instances().get_latest_completed(
+        engine_id, engine_version, engine_variant)
+    return None if instance is None else instance.id
+
+
 def prepare_deploy(
     engine: Engine,
     instance: EngineInstance,

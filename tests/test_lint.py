@@ -37,7 +37,7 @@ def rule_ids(findings):
 
 def test_all_rules_registered():
     assert {"JT01", "JT02", "JT03", "JT04", "JT05", "JT06",
-            "JT07", "JT08", "JT09"} <= set(RULES)
+            "JT07", "JT08", "JT09", "JT10", "JT11", "JT12"} <= set(RULES)
 
 
 def test_syntax_error_is_reported_not_raised(tmp_path):
@@ -875,3 +875,109 @@ def test_jt11_suppressible_with_justification(tmp_path):
             REQS.labels(trace_id).inc()  # graftlint: disable=JT11 — fixture: bounded test registry
     """)
     assert findings == []
+
+
+# -- JT12 join-wait-without-timeout --------------------------------------------
+
+def test_jt12_positive_bare_thread_join_and_event_wait(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import threading
+
+        def stop(worker, done):
+            worker.join()
+            done.wait()
+    """)
+    assert rule_ids(findings) == ["JT12", "JT12"]
+    assert "timeout" in findings[0].message
+
+
+def test_jt12_positive_popen_wait(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import subprocess
+
+        def reap(proc):
+            proc.wait()
+    """)
+    assert rule_ids(findings) == ["JT12"]
+
+
+def test_jt12_negative_timeout_passed(tmp_path):
+    # keyword, positional, and any-arg forms all bound the wait
+    findings = lint_src(tmp_path, """\
+        def stop(worker, done, barrier, proc):
+            worker.join(timeout=60)
+            done.wait(5.0)
+            barrier.wait(timeout=10)
+            proc.wait(timeout=30)
+    """)
+    assert findings == []
+
+
+def test_jt12_positive_literal_none_timeout(tmp_path):
+    # join(None) / wait(timeout=None) is the bare unbounded wait
+    # spelled out — passing it must not satisfy the rule
+    findings = lint_src(tmp_path, """\
+        def stop(worker, done):
+            worker.join(None)
+            done.wait(timeout=None)
+    """)
+    assert rule_ids(findings) == ["JT12", "JT12"]
+
+
+def test_jt12_negative_string_join_and_module_wait(tmp_path):
+    # str.join(iterable) and futures.wait(fs) carry arguments; the
+    # bare-name `wait(fs)` is a module-level call, not a method
+    findings = lint_src(tmp_path, """\
+        from concurrent.futures import wait
+
+        def fmt(parts, futures):
+            text = ",".join(parts)
+            wait(futures)
+            return text
+    """)
+    assert findings == []
+
+
+def test_jt12_negative_dma_descriptor_wait(tmp_path):
+    # Pallas async-copy descriptors: `make_copy(...).wait()` is a
+    # device-side completion wait with no timeout concept — the
+    # receiver-is-a-call shape stays silent
+    findings = lint_src(tmp_path, """\
+        def kernel(copy_fn, k):
+            copy_fn(k).wait()
+    """)
+    assert findings == []
+
+
+def test_jt12_suppressible_with_justification(tmp_path):
+    findings = lint_src(tmp_path, """\
+        def stop(worker):
+            worker.join()  # graftlint: disable=JT12 — fixture: joined thread is provably short-lived
+    """)
+    assert findings == []
+
+
+def test_jt12_negative_timeoutless_receivers(tmp_path):
+    # queue.Queue.join / Pool.join / os.wait HAVE no timeout parameter:
+    # "pass timeout=" would be a TypeError, so the rule stays silent
+    findings = lint_src(tmp_path, """\
+        import os
+
+        def drain(work_queue, worker_pool):
+            work_queue.join()
+            worker_pool.join()
+            os.wait()
+    """)
+    assert findings == []
+
+
+def test_jt12_positive_queue_adjacent_names_still_flagged(tmp_path):
+    # the exemption is for receivers that ARE queues/pools (head word),
+    # not for anything queue-adjacent: a bare Event.wait() named after
+    # a queue is exactly the forever-hang the rule exists to catch
+    findings = lint_src(tmp_path, """\
+        def stop(queue_drained_evt, pool_ready):
+            queue_drained_evt.wait()
+            pool_ready.wait()
+    """)
+    assert rule_ids(findings) == ["JT12", "JT12"]

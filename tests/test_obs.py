@@ -552,10 +552,17 @@ def test_serving_stats_reports_from_shared_histogram():
     assert st.recent(3) == [0.2, 0.2, 0.2]
     child = _SERVING_SECONDS.labels("obs-hist-engine")
     assert child.count == 100
-    # a new ServingStats for the same engine restarts the series
+    # a second ServingStats for the same engine (a fleet replica, or a
+    # restarted in-process server) starts ITS OWN counts from zero but
+    # keeps recording into the SAME engine-wide registry series — the
+    # SLO burn rate and shedding must see every replica's traffic
     fresh = ServingStats("obs-hist-engine")
     assert fresh.request_count == 0
-    assert _SERVING_SECONDS.labels("obs-hist-engine").count == 0
+    assert _SERVING_SECONDS.labels("obs-hist-engine").count == 100
+    fresh.record(0.2)
+    assert fresh.request_count == 1
+    assert st.request_count == 100  # the older server's view is per-server
+    assert _SERVING_SECONDS.labels("obs-hist-engine").count == 101
 
 
 # ---------------------------------------------------------------------------
