@@ -625,6 +625,112 @@ def _serve_stage(storage, factors, pd, cfg, detail):
         server.stop()
 
 
+def stage_stream(base_dir, out_path):
+    """Streaming freshness stage (ROADMAP item C / PR 9), run LAST in
+    its own process: the stream bench APPENDS events, which advances
+    the event-log fingerprint — run before the warm stage, those
+    appends would evict the unchanged-data layout-cache fast path the
+    warm stage exists to price. Reopening the store here also exercises
+    the delta cursor's restart contract on the real bench log."""
+    from predictionio_tpu.data.storage import set_storage
+    from predictionio_tpu.serving.engine_server import EngineServer
+    from predictionio_tpu.templates.recommendation import recommendation_engine
+
+    storage = _storage(base_dir)
+    detail = {}
+    engine = recommendation_engine()
+    server = EngineServer(
+        engine, "bench_reco", host="127.0.0.1", port=0, storage=storage,
+    ).start()
+    try:
+        item_ids = server.deployment.models[0].item_ids
+        _stream_stage(storage, engine, server, item_ids, detail)
+    finally:
+        server.stop()
+    storage.events().close()
+    set_storage(None)
+    with open(out_path, "w") as f:
+        json.dump(detail, f)
+
+
+def _stream_stage(storage, engine, server, item_ids, detail):
+    """event_to_servable: append→changed-prediction latency through the
+    streaming fold-in path (ROADMAP item C / PR 9) against a LIVE
+    engine server serving the bench instance — plus fold-in throughput.
+
+    The timed region is the full freshness loop a production stream
+    daemon runs per cycle: raw event append into the native log, delta
+    tail read (find_columnar_since), ALS fold-in solves, model patch
+    over HTTP to the serving process, and a confirming /queries.json
+    answer carrying the folded user's predictions. Jit buckets are
+    warmed by the preceding folds (steady-state freshness is the
+    metric, same stance as the serve warm-up)."""
+    import datetime as dt
+    import urllib.request
+
+    from predictionio_tpu.data.event import Event
+    from predictionio_tpu.workflow.stream import StreamUpdater
+
+    updater = StreamUpdater(engine, "bench_reco", storage=storage,
+                            patch_servers=[server])
+    app = storage.apps().get_by_name("bench")
+    events = storage.events()
+    inv_items = item_ids.inverse()
+    rng = np.random.default_rng(11)
+
+    def rate(user, item, r):
+        return Event(
+            event="rate", entity_type="user", entity_id=user,
+            target_entity_type="item", target_entity_id=item,
+            properties={"rating": float(r)},
+            event_time=dt.datetime.now(tz=dt.timezone.utc))
+
+    def query(user):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/queries.json",
+            data=json.dumps({"user": user, "num": 5}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    # warm the fold path's compiled buckets with one tiny fold
+    events.insert_batch([rate("stream_warm_u", inv_items[0], 4.0)], app.id)
+    updater.poll_once()
+
+    # fold-in throughput: 1000 events from 100 new users over 8 distinct
+    # existing items (bounds the per-item history scans)
+    hot_items = [inv_items[int(i)]
+                 for i in rng.integers(0, len(item_ids), size=8)]
+    batch = [rate(f"stream_tp_u{k % 100}", hot_items[k % 8],
+                  float(rng.integers(1, 11)) / 2.0)
+             for k in range(1000)]
+    events.insert_batch(batch, app.id)
+    stats = updater.poll_once()
+    assert stats["events"] == 1000 and stats["published"], stats
+    detail["foldin_events_per_sec"] = round(
+        stats["events"] / max(stats["seconds"], 1e-9), 1)
+
+    # append -> servable changed prediction, measured end to end: the
+    # fresh user answers empty before the fold and with scores after
+    user = "stream_fresh_u"
+    assert query(user)["itemScores"] == []
+    t0 = time.perf_counter()
+    events.insert_batch([rate(user, inv_items[1], 5.0)], app.id)
+    stats = updater.poll_once()
+    answer = query(user)
+    elapsed_ms = (time.perf_counter() - t0) * 1e3
+    assert stats["published"] and answer["itemScores"], (stats, answer)
+    detail["event_to_servable_ms"] = round(elapsed_ms, 1)
+    detail["stream_fold_stats"] = {
+        k: stats[k] for k in ("events", "touched_users", "touched_items",
+                              "seconds")}
+    detail["event_to_servable_note"] = (
+        "append -> delta tail -> ALS fold-in -> HTTP /model/patch -> "
+        "confirmed changed /queries.json answer, steady-state (fold jit "
+        "warmed); the batch warm path re-ships the world in "
+        "warm_events_to_model_sec instead")
+
+
 def _fleet_stage(storage, cfg, detail):
     """serve_128conn fleet sweep: the SAME trained instance behind
     1/2/4 threaded engine-server replicas and the health-routed query
@@ -729,6 +835,9 @@ def _fleet_stage(storage, cfg, detail):
     finally:
         os.unlink(users_file)
     detail["fleet_sweep"] = sweep
+    if not sweep:  # PIO_BENCH_FLEET_REPLICAS= disables the sweep
+        detail["fleet_note"] = "fleet sweep disabled via env"
+        return
     best = min(sweep, key=lambda p: p["srv_p99_ms"])
     detail["fleet_best_replicas"] = best["replicas"]
     detail["fleet_qps_128conn"] = best["qps"]
@@ -1331,6 +1440,11 @@ def emit_headline(detail, detail_path=None):
         # count; bench-compare gates the p99 lower-better, qps higher)
         "fleet_qps_128conn": detail.get("fleet_qps_128conn"),
         "fleet_srv_p99_ms_128conn": detail.get("fleet_srv_p99_ms_128conn"),
+        # streaming freshness (PR 9): append->servable-changed-prediction
+        # through the fold-in path (benchcmp: _ms suffix = lower-better)
+        # and fold-in throughput (per_sec = higher-better)
+        "event_to_servable_ms": detail.get("event_to_servable_ms"),
+        "foldin_events_per_sec": detail.get("foldin_events_per_sec"),
     }
     if "twotower" in detail:
         tt = detail["twotower"]
@@ -1377,7 +1491,7 @@ def orchestrate():
     env["PIO_BIN_CACHE_DIR"] = os.path.join(base_dir, "bin_cache")
     try:
         stages = {}
-        for stage in ("cold", "warm", "twotower"):
+        for stage in ("cold", "warm", "twotower", "stream"):
             out = os.path.join(base_dir, f"{stage}.json")
             # child stdout -> our stderr: the stdout contract is ONE line
             proc = subprocess.run(
@@ -1394,6 +1508,9 @@ def orchestrate():
         detail = stages["cold"]
         detail["warm"] = stages["warm"]
         detail["twotower"] = stages["twotower"]
+        # stream keys land at top level: emit_headline reads
+        # detail["event_to_servable_ms"] / ["foldin_events_per_sec"]
+        detail.update(stages["stream"])
         print(json.dumps(emit_headline(detail)))
     finally:
         shutil.rmtree(base_dir, ignore_errors=True)
@@ -1402,7 +1519,7 @@ def orchestrate():
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--stage",
-                        choices=["cold", "warm", "twotower",
+                        choices=["cold", "warm", "twotower", "stream",
                                  "parse_profile", "loadgen"])
     parser.add_argument("--base")
     parser.add_argument("--out")
@@ -1413,6 +1530,8 @@ def main() -> None:
         stage_warm(args.base, args.out)
     elif args.stage == "twotower":
         stage_twotower(args.base, args.out)
+    elif args.stage == "stream":
+        stage_stream(args.base, args.out)
     elif args.stage == "parse_profile":
         _parse_train_profile(args.base)
     elif args.stage == "loadgen":

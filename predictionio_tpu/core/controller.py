@@ -149,6 +149,18 @@ class Algorithm(Doer, Generic[PD, M, Q, P]):
         same compiled functions ``predict`` uses, at the default
         (B, k, ...) buckets, and must tolerate empty models."""
 
+    def apply_patch(self, model: M, patch: dict) -> bool:
+        """Apply a streaming model patch (workflow/stream.py fold-in)
+        to the LIVE model in place — the lightweight alternative to a
+        full ``/reload`` when only a few rows of the model moved.
+
+        Returns False when this algorithm does not support patching
+        (the default): the engine server then answers 400 and the
+        streaming path falls back to the rolling-reload lane. An
+        implementation must leave concurrent ``predict`` calls
+        consistent (copy-on-write swaps, never torn in-place rows)."""
+        return False
+
 
 class Serving(Doer, Generic[Q, P]):
     """Combines the per-algorithm predictions into one response."""

@@ -123,6 +123,25 @@ def _load():
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
         ctypes.POINTER(ctypes.c_double), ctypes.c_char_p,
     ]
+    lib.el_find_columnar_since.restype = ctypes.c_int64
+    lib.el_find_columnar_since.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(_FindReq), ctypes.c_char_p,
+        ctypes.c_uint64, ctypes.c_uint64,                 # since gen/rec
+        ctypes.POINTER(ctypes.c_uint64),                  # out gen
+        ctypes.POINTER(ctypes.c_uint64),                  # out rec
+        ctypes.POINTER(ctypes.c_int32),                   # out rebased
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),   # ent codes
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),   # tgt codes
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),   # name codes
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),  # values
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),   # times_us
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),  # ent dict offsets
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),  # tgt dict offsets
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),  # name dict offsets
+    ]
     lib.el_fingerprint.argtypes = [ctypes.c_void_p,
                                    ctypes.POINTER(ctypes.c_uint64)]
     lib.el_fingerprint.restype = None
@@ -296,6 +315,82 @@ _ROW_ERRORS = {
     17: "event must be a JSON object",
     18: "a string field exceeds the 65534-byte wire-format limit",
 }
+
+
+class _ColumnarOut:
+    """The columnar out-params of ``el_find_columnar[_since]`` plus the
+    unpack/free plumbing both lanes share: 5 row arrays, 3 dictionaries
+    with exact prefix offsets, and their counts."""
+
+    def __init__(self, lib):
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        self._lib = lib
+        self.ent = ctypes.POINTER(ctypes.c_int32)()
+        self.tgt = ctypes.POINTER(ctypes.c_int32)()
+        self.nam = ctypes.POINTER(ctypes.c_int32)()
+        self.val = ctypes.POINTER(ctypes.c_double)()
+        self.tim = ctypes.POINTER(ctypes.c_int64)()
+        self.ent_d, self.tgt_d, self.nam_d = u8p(), u8p(), u8p()
+        self.ent_db = ctypes.c_uint64()
+        self.tgt_db = ctypes.c_uint64()
+        self.nam_db = ctypes.c_uint64()
+        self.n_ent, self.n_tgt, self.n_nam = (
+            ctypes.c_int64(), ctypes.c_int64(), ctypes.c_int64())
+        self.ent_o, self.tgt_o, self.nam_o = u64p(), u64p(), u64p()
+
+    def argrefs(self):
+        return tuple(ctypes.byref(p) for p in (
+            self.ent, self.tgt, self.nam, self.val, self.tim,
+            self.ent_d, self.ent_db, self.n_ent,
+            self.tgt_d, self.tgt_db, self.n_tgt,
+            self.nam_d, self.nam_db, self.n_nam,
+            self.ent_o, self.tgt_o, self.nam_o))
+
+    def take(self, n: int) -> S.EventColumns:
+        """Copy the native buffers into a Python-owned EventColumns and
+        free them (always frees, even when the copy raises)."""
+        import numpy as np
+
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+
+        def arr(ptr, ctype, count, np_dtype):
+            a = np.ctypeslib.as_array(
+                ctypes.cast(ptr, ctypes.POINTER(ctype)), shape=(count,)
+            ).copy() if count else np.empty(0, np_dtype)
+            return a.astype(np_dtype, copy=False)
+
+        def vocab(ptr, nbytes, offs_ptr, count):
+            if not count:
+                return []
+            raw = ctypes.string_at(ptr, nbytes)
+            offs = ctypes.cast(offs_ptr, u64p)
+            return [raw[offs[i]:offs[i + 1]].decode("utf-8")
+                    for i in range(count)]
+
+        try:
+            return S.EventColumns(
+                entity_codes=arr(self.ent, ctypes.c_int32, n, np.int32),
+                target_codes=arr(self.tgt, ctypes.c_int32, n, np.int32),
+                name_codes=arr(self.nam, ctypes.c_int32, n, np.int32),
+                values=arr(self.val, ctypes.c_double, n, np.float64),
+                times_us=arr(self.tim, ctypes.c_int64, n, np.int64),
+                entity_vocab=vocab(self.ent_d, self.ent_db.value,
+                                   self.ent_o, self.n_ent.value),
+                target_vocab=vocab(self.tgt_d, self.tgt_db.value,
+                                   self.tgt_o, self.n_tgt.value),
+                names=vocab(self.nam_d, self.nam_db.value,
+                            self.nam_o, self.n_nam.value),
+            )
+        finally:
+            self.free()
+
+    def free(self) -> None:
+        for p in (self.ent, self.tgt, self.nam, self.val, self.tim,
+                  self.ent_d, self.tgt_d, self.nam_d,
+                  self.ent_o, self.tgt_o, self.nam_o):
+            if p:
+                self._lib.el_free(p)
 
 
 # ---------------------------------------------------------------------------
@@ -547,8 +642,6 @@ class EventLogEventStore(S.EventStore):
         scan still reads the whole log (it is local disk), but only the
         shard's rows are materialized as Python-owned arrays (and, via
         the storage server, only they travel the wire)."""
-        import numpy as np
-
         S.EventStore.check_shard_params(shard_index, shard_count)
         sharding = shard_count is not None and shard_count > 1
         # shard filter precedes any row limit (find's order-then-
@@ -574,70 +667,105 @@ class EventLogEventStore(S.EventStore):
             find_kwargs.get("target_entity_id", S.UNSET),
             find_kwargs.get("limit"), find_kwargs.get("reversed", False),
         )
-        u8p = ctypes.POINTER(ctypes.c_uint8)
-        ent = ctypes.POINTER(ctypes.c_int32)()
-        tgt = ctypes.POINTER(ctypes.c_int32)()
-        nam = ctypes.POINTER(ctypes.c_int32)()
-        val = ctypes.POINTER(ctypes.c_double)()
-        tim = ctypes.POINTER(ctypes.c_int64)()
-        ent_d, tgt_d, nam_d = u8p(), u8p(), u8p()
-        ent_db, tgt_db, nam_db = ctypes.c_uint64(), ctypes.c_uint64(), ctypes.c_uint64()
-        n_ent, n_tgt, n_nam = ctypes.c_int64(), ctypes.c_int64(), ctypes.c_int64()
-        u64p = ctypes.POINTER(ctypes.c_uint64)
-        ent_o, tgt_o, nam_o = u64p(), u64p(), u64p()
+        out = _ColumnarOut(self._lib)
         n = self._lib.el_find_columnar(
             h, ctypes.byref(req),
             value_property.encode() if value_property is not None else None,
             1 if time_ordered else 0,
-            ctypes.byref(ent), ctypes.byref(tgt), ctypes.byref(nam),
-            ctypes.byref(val), ctypes.byref(tim),
-            ctypes.byref(ent_d), ctypes.byref(ent_db), ctypes.byref(n_ent),
-            ctypes.byref(tgt_d), ctypes.byref(tgt_db), ctypes.byref(n_tgt),
-            ctypes.byref(nam_d), ctypes.byref(nam_db), ctypes.byref(n_nam),
-            ctypes.byref(ent_o), ctypes.byref(tgt_o), ctypes.byref(nam_o),
+            *out.argrefs(),
         )
         if n < 0:
             raise S.StorageError("columnar find failed in native event log")
-
-        def take(ptr, ctype, count, np_dtype):
-            arr = np.ctypeslib.as_array(
-                ctypes.cast(ptr, ctypes.POINTER(ctype)), shape=(count,)
-            ).copy() if count else np.empty(0, np_dtype)
-            return arr.astype(np_dtype, copy=False)
-
-        def vocab(ptr, nbytes, offs_ptr, count):
-            # exact prefix offsets: ids containing ANY byte (incl. NUL)
-            # round-trip, matching the npz wire format of the REST tier
-            if not count:
-                return []
-            raw = ctypes.string_at(ptr, nbytes)
-            offs = ctypes.cast(offs_ptr, u64p)
-            return [
-                raw[offs[i]:offs[i + 1]].decode("utf-8")
-                for i in range(count)
-            ]
-
-        try:
-            cols = S.EventColumns(
-                entity_codes=take(ent, ctypes.c_int32, n, np.int32),
-                target_codes=take(tgt, ctypes.c_int32, n, np.int32),
-                name_codes=take(nam, ctypes.c_int32, n, np.int32),
-                values=take(val, ctypes.c_double, n, np.float64),
-                times_us=take(tim, ctypes.c_int64, n, np.int64),
-                entity_vocab=vocab(ent_d, ent_db.value, ent_o, n_ent.value),
-                target_vocab=vocab(tgt_d, tgt_db.value, tgt_o, n_tgt.value),
-                names=vocab(nam_d, nam_db.value, nam_o, n_nam.value),
-            )
-        finally:
-            for p in (ent, tgt, nam, val, tim, ent_d, tgt_d, nam_d,
-                      ent_o, tgt_o, nam_o):
-                self._lib.el_free(p)
+        cols = out.take(n)
         if sharding:
             cols = S.shard_columns(cols, shard_index, shard_count)
             cols = S.limit_columns(
                 cols, shard_limit,
                 newest_first=bool(find_kwargs.get("reversed", False)))
         return cols
+
+    # -- streaming delta reads (ROADMAP item C) -----------------------------
+    @staticmethod
+    def _parse_cursor(cursor: str) -> Tuple[int, int]:
+        try:
+            gen_s, rec_s = cursor.split(":", 1)
+            if gen_s[0] != "g" or rec_s[0] != "r":
+                raise ValueError
+            return int(gen_s[1:]), int(rec_s[1:])
+        except (ValueError, IndexError):
+            raise ValueError(
+                f"malformed delta cursor {cursor!r} (expected 'g<gen>:r<rec>')"
+            ) from None
+
+    def delta_cursor(self, app_id, channel_id=None) -> str:
+        """The current tail position as an opaque cursor string —
+        ``find_columnar_since`` from here returns only rows appended
+        AFTER this call. Built on el_fingerprint's generation/record
+        counters, so it stays valid across process restarts."""
+        h = self._handle(app_id, channel_id)
+        out = (ctypes.c_uint64 * 4)()
+        self._lib.el_fingerprint(h, out)
+        return f"g{out[0]}:r{out[2]}"
+
+    def find_columnar_since(
+        self,
+        app_id,
+        channel_id=None,
+        *,
+        cursor: str,
+        value_property: Optional[str] = None,
+        **find_kwargs,
+    ) -> Tuple[S.EventColumns, str, bool]:
+        """Delta read: the live rows appended since ``cursor`` that
+        match the filters, dict-encoded, in ARRIVAL order (one native
+        pass over only the new records — the streaming tailer's lane).
+
+        Returns ``(columns, new_cursor, rebased)``. ``rebased=True``
+        means the cursor could not be mapped onto this log (a
+        compaction renumbered records, or a crash truncated appends the
+        cursor had seen): the returned columns are then a RESYNC of the
+        entire live row set, not a delta — callers should treat it as
+        "full retrain needed", not fold it in."""
+        unknown = set(find_kwargs) - {
+            "start_time", "until_time", "entity_type", "entity_id",
+            "event_names", "target_entity_type", "target_entity_id",
+        }
+        if unknown:
+            # same loud-failure contract as find_columnar (a typo'd
+            # filter must never silently widen the delta); limit /
+            # reversed are deliberately NOT accepted — a delta is
+            # exactly-the-new-rows by definition
+            raise TypeError(
+                f"find_columnar_since() got unexpected filters {sorted(unknown)}"
+            )
+        gen, rec = self._parse_cursor(cursor)
+        h = self._handle(app_id, channel_id)
+        req = self._build_req(
+            find_kwargs.get("start_time"), find_kwargs.get("until_time"),
+            find_kwargs.get("entity_type"), find_kwargs.get("entity_id"),
+            find_kwargs.get("event_names"),
+            find_kwargs.get("target_entity_type", S.UNSET),
+            find_kwargs.get("target_entity_id", S.UNSET),
+            None, False,
+        )
+        out_gen = ctypes.c_uint64()
+        out_rec = ctypes.c_uint64()
+        out_rebased = ctypes.c_int32()
+        out = _ColumnarOut(self._lib)
+        n = self._lib.el_find_columnar_since(
+            h, ctypes.byref(req),
+            value_property.encode() if value_property is not None else None,
+            gen, rec,
+            ctypes.byref(out_gen), ctypes.byref(out_rec),
+            ctypes.byref(out_rebased),
+            *out.argrefs(),
+        )
+        if n < 0:
+            raise S.StorageError("delta columnar read failed in native "
+                                 "event log")
+        cols = out.take(n)
+        return (cols, f"g{out_gen.value}:r{out_rec.value}",
+                bool(out_rebased.value))
 
     def insert_columnar(
         self,

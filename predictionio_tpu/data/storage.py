@@ -358,6 +358,13 @@ class EventStore(abc.ABC):
     reads, storage/PEvents.scala:30). Without Spark the split is
     unnecessary: one store serves both the server CRUD path and the
     bulk training-read path (which feeds host numpy buffers).
+
+    OPTIONAL capability — streaming delta reads: backends with an
+    append-order sequence expose ``delta_cursor(app_id, channel_id)``
+    and ``find_columnar_since(app_id, channel_id, cursor=...)`` →
+    ``(EventColumns, new_cursor, rebased)`` returning exactly the live
+    rows appended since the cursor (the eventlog backend implements
+    this natively; workflow/stream.py feature-detects via hasattr).
     """
 
     @abc.abstractmethod

@@ -121,6 +121,76 @@ class ALSModel:
         self._scorer = ShardedTopKScorer(self.item_factors, mesh, axis=axis)
         self.sharded_axis = axis
 
+    def upsert_rows(
+        self,
+        user_rows: Sequence[Tuple[str, "np.ndarray"]] = (),
+        item_rows: Sequence[Tuple[str, "np.ndarray"]] = (),
+    ) -> Tuple[int, int]:
+        """Apply a streaming fold-in patch: overwrite (or append) the
+        named factor rows. COPY-ON-WRITE — new arrays are built and the
+        attribute references swapped last, so a concurrent ``predict``
+        reading ``self.user_factors`` once sees either the old or the
+        new table, never a torn row. Any item change invalidates the
+        cached scorer (it holds a device copy of the item table); a
+        same-shape re-put hits the compile cache, only NEW items change
+        shapes. Returns (n_new_users, n_new_items)."""
+        rank = self.user_factors.shape[1] if self.user_factors.size else (
+            self.item_factors.shape[1])
+        if item_rows and self.sharded_axis is not None:
+            # the sharded scorer's row placement can't be patched from
+            # here (no mesh at hand) — silently downgrading to the
+            # single-device scorer would change serving capacity; the
+            # rolling /reload lane is the supported swap for these
+            raise ValueError(
+                "item-row patches are not supported on a sharded-serving "
+                "model; use the rolling /reload fallback")
+        new_users = new_items = 0
+        if user_rows:
+            ids, factors = self.user_ids, self.user_factors
+            fresh = [uid for uid, _ in user_rows if uid not in ids]
+            if fresh:
+                vocab = list(ids.keys()) + fresh
+                ids = BiMap.from_vocab(vocab)
+                factors = np.vstack(
+                    [factors, np.zeros((len(fresh), rank), np.float32)])
+                new_users = len(fresh)
+            else:
+                factors = factors.copy()
+            for uid, vec in user_rows:
+                vec = np.asarray(vec, np.float32)
+                if vec.shape != (rank,):
+                    raise ValueError(
+                        f"user row {uid!r}: expected a length-{rank} "
+                        f"vector, got shape {vec.shape}")
+                factors[ids[uid]] = vec
+            # factors FIRST: a reader holding the new id map but the old
+            # (shorter) table would index past its end on a fresh user
+            self.user_factors = factors
+            self.user_ids = ids
+        if item_rows:
+            ids, factors = self.item_ids, self.item_factors
+            fresh = [iid for iid, _ in item_rows if iid not in ids]
+            if fresh:
+                vocab = list(ids.keys()) + fresh
+                ids = BiMap.from_vocab(vocab)
+                factors = np.vstack(
+                    [factors, np.zeros((len(fresh), rank), np.float32)])
+                new_items = len(fresh)
+            else:
+                factors = factors.copy()
+            for iid, vec in item_rows:
+                vec = np.asarray(vec, np.float32)
+                if vec.shape != (rank,):
+                    raise ValueError(
+                        f"item row {iid!r}: expected a length-{rank} "
+                        f"vector, got shape {vec.shape}")
+                factors[ids[iid]] = vec
+            self.item_factors = factors
+            self.item_ids = ids
+            # the scorer holds a DEVICE copy of the old item table
+            self._scorer = None
+        return new_users, new_items
+
     def recommend(
         self,
         user_id: str,
@@ -156,11 +226,40 @@ class ALSModel:
         ]
 
 
+def apply_rows_patch(model: ALSModel, patch: dict) -> bool:
+    """The one factor-row patch decoder every factor-backed algorithm
+    shares (ALS and two-tower models both serve from ALSModel factor
+    tables): ``patch`` carries ``userRows`` / ``itemRows`` as
+    ``[[id, [floats...]], ...]`` and lands via
+    :meth:`ALSModel.upsert_rows` (copy-on-write, scorer invalidation).
+    Malformed rows raise ValueError — the engine server maps that to
+    400 with nothing partially applied for the failing side."""
+
+    def rows(key):
+        out = []
+        for entry in patch.get(key) or ():
+            if (not isinstance(entry, (list, tuple)) or len(entry) != 2
+                    or not isinstance(entry[0], str)):
+                raise ValueError(
+                    f"{key}: each row must be [id, [floats...]]")
+            out.append((entry[0], np.asarray(entry[1], np.float32)))
+        return out
+
+    model.upsert_rows(user_rows=rows("userRows"),
+                      item_rows=rows("itemRows"))
+    return True
+
+
 class ALSAlgorithm(Algorithm):
     """DASE wrapper over ops.als (ref template: ALSAlgorithm.scala)."""
 
     def __init__(self, params: ALSParams):
         super().__init__(params)
+
+    def apply_patch(self, model: ALSModel, patch: dict) -> bool:
+        """Streaming fold-in rows land in the live factor tables
+        (workflow/stream.py's model-patch lane)."""
+        return apply_rows_patch(model, patch)
 
     def train(self, ctx: MeshContext, pd: PreparedRatings) -> ALSModel:
         p: ALSParams = self.params

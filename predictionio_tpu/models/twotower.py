@@ -102,7 +102,9 @@ class TwoTowerAlgorithm(Algorithm):
         return model
 
     # identical model/query surface -> share ALS's serve and batched
-    # (matmul + top-k) evaluation paths, and its deploy-time warmup
+    # (matmul + top-k) evaluation paths, its deploy-time warmup, and
+    # the streaming model-patch lane (same factor-table container)
     predict = ALSAlgorithm.predict
     batch_predict = ALSAlgorithm.batch_predict
     warmup = ALSAlgorithm.warmup
+    apply_patch = ALSAlgorithm.apply_patch

@@ -589,6 +589,71 @@ struct DictEncoder {
   }
 };
 
+// Copy accumulated column vectors + dictionaries into malloc'd outputs
+// (the shared tail of el_find_columnar / el_find_columnar_since). On
+// allocation failure everything allocated so far is freed and -1 comes
+// back; otherwise the row count.
+int64_t finish_columns(
+    const DictEncoder& ents, const DictEncoder& tgts, const DictEncoder& names,
+    const std::vector<int32_t>& ent_v, const std::vector<int32_t>& tgt_v,
+    const std::vector<int32_t>& name_v, const std::vector<double>& val_v,
+    const std::vector<int64_t>& time_v,
+    int32_t** ent_codes_out, int32_t** tgt_codes_out,
+    int32_t** name_codes_out, double** values_out, int64_t** times_us_out,
+    uint8_t** ent_dict_out, uint64_t* ent_dict_bytes, int64_t* n_ent,
+    uint8_t** tgt_dict_out, uint64_t* tgt_dict_bytes, int64_t* n_tgt,
+    uint8_t** name_dict_out, uint64_t* name_dict_bytes, int64_t* n_names,
+    uint64_t** ent_offsets_out, uint64_t** tgt_offsets_out,
+    uint64_t** name_offsets_out) {
+  auto copy_out = [](const auto& v, auto** out) {
+    using T = typename std::remove_reference_t<decltype(v)>::value_type;
+    T* buf = static_cast<T*>(malloc(sizeof(T) * (v.size() ? v.size() : 1)));
+    if (!buf) return false;
+    memcpy(buf, v.data(), sizeof(T) * v.size());
+    *out = buf;
+    return true;
+  };
+  int32_t* ent_codes = nullptr;
+  int32_t* tgt_codes = nullptr;
+  int32_t* name_codes = nullptr;
+  double* values = nullptr;
+  int64_t* times_us = nullptr;
+  if (!copy_out(ent_v, &ent_codes) || !copy_out(tgt_v, &tgt_codes) ||
+      !copy_out(name_v, &name_codes) || !copy_out(val_v, &values) ||
+      !copy_out(time_v, &times_us)) {
+    free(ent_codes); free(tgt_codes); free(name_codes); free(values); free(times_us);
+    return -1;
+  }
+
+  uint64_t* ent_offs = nullptr;
+  uint64_t* tgt_offs = nullptr;
+  uint64_t* name_offs = nullptr;
+  uint8_t* ent_dict = ents.dump(ent_dict_bytes, &ent_offs);
+  uint8_t* tgt_dict = tgts.dump(tgt_dict_bytes, &tgt_offs);
+  uint8_t* name_dict = names.dump(name_dict_bytes, &name_offs);
+  if (!ent_dict || !tgt_dict || !name_dict) {
+    free(ent_codes); free(tgt_codes); free(name_codes); free(values); free(times_us);
+    free(ent_dict); free(tgt_dict); free(name_dict);
+    free(ent_offs); free(tgt_offs); free(name_offs);
+    return -1;
+  }
+  *ent_codes_out = ent_codes;
+  *tgt_codes_out = tgt_codes;
+  *name_codes_out = name_codes;
+  *values_out = values;
+  *times_us_out = times_us;
+  *ent_dict_out = ent_dict;
+  *tgt_dict_out = tgt_dict;
+  *name_dict_out = name_dict;
+  *ent_offsets_out = ent_offs;
+  *tgt_offsets_out = tgt_offs;
+  *name_offsets_out = name_offs;
+  *n_ent = static_cast<int64_t>(ents.order.size());
+  *n_tgt = static_cast<int64_t>(tgts.order.size());
+  *n_names = static_cast<int64_t>(names.order.size());
+  return static_cast<int64_t>(ent_v.size());
+}
+
 // ---------------------------------------------------------------------------
 // persisted index snapshot: header + the raw RecMeta array. A local
 // cache file (same-machine, same-build reader — sizeof(RecMeta) is
@@ -2014,54 +2079,77 @@ int64_t el_find_columnar(
     }
   }
 
-  const uint64_t n = ent_v.size();
-  auto copy_out = [](const auto& v, auto** out) {
-    using T = typename std::remove_reference_t<decltype(v)>::value_type;
-    T* buf = static_cast<T*>(malloc(sizeof(T) * (v.size() ? v.size() : 1)));
-    if (!buf) return false;
-    memcpy(buf, v.data(), sizeof(T) * v.size());
-    *out = buf;
-    return true;
-  };
-  int32_t* ent_codes = nullptr;
-  int32_t* tgt_codes = nullptr;
-  int32_t* name_codes = nullptr;
-  double* values = nullptr;
-  int64_t* times_us = nullptr;
-  if (!copy_out(ent_v, &ent_codes) || !copy_out(tgt_v, &tgt_codes) ||
-      !copy_out(name_v, &name_codes) || !copy_out(val_v, &values) ||
-      !copy_out(time_v, &times_us)) {
-    free(ent_codes); free(tgt_codes); free(name_codes); free(values); free(times_us);
-    return -1;
-  }
+  return finish_columns(
+      ents, tgts, names, ent_v, tgt_v, name_v, val_v, time_v,
+      ent_codes_out, tgt_codes_out, name_codes_out, values_out, times_us_out,
+      ent_dict_out, ent_dict_bytes, n_ent,
+      tgt_dict_out, tgt_dict_bytes, n_tgt,
+      name_dict_out, name_dict_bytes, n_names,
+      ent_offsets_out, tgt_offsets_out, name_offsets_out);
+}
 
-  uint64_t* ent_offs = nullptr;
-  uint64_t* tgt_offs = nullptr;
-  uint64_t* name_offs = nullptr;
-  uint8_t* ent_dict = ents.dump(ent_dict_bytes, &ent_offs);
-  uint8_t* tgt_dict = tgts.dump(tgt_dict_bytes, &tgt_offs);
-  uint8_t* name_dict = names.dump(name_dict_bytes, &name_offs);
-  if (!ent_dict || !tgt_dict || !name_dict) {
-    free(ent_codes); free(tgt_codes); free(name_codes); free(values); free(times_us);
-    free(ent_dict); free(tgt_dict); free(name_dict);
-    free(ent_offs); free(tgt_offs); free(name_offs);
-    return -1;
+// Sequence-offset columnar read — the streaming delta lane (ROADMAP
+// item C): live records [since_rec, end) of generation ``since_gen``
+// matching ``req``, dict-encoded like el_find_columnar but in ARRIVAL
+// order with no sort and no limit (the tailer's contract is "exactly
+// the live rows appended since the cursor"). The advancing cursor
+// comes back as (*out_gen, *out_rec) = (generation, record count) —
+// the same primitives el_fingerprint exposes — so a cursor survives
+// process restarts: reopening replays/loads the index to the same
+// record count (a torn tail truncates PAST records away, which the
+// past-the-end check below turns into a rebase, never silent loss).
+// A cursor from another generation (a compaction renumbered records)
+// or past the current end (a crash dropped unsynced appends) cannot be
+// mapped onto this log: the scan restarts from record 0 with
+// *out_rebased = 1, telling the caller these rows are a RESYNC of the
+// whole live set, not a delta.
+int64_t el_find_columnar_since(
+    void* h, const FindReq* req, const char* value_prop,
+    uint64_t since_gen, uint64_t since_rec,
+    uint64_t* out_gen, uint64_t* out_rec, int32_t* out_rebased,
+    int32_t** ent_codes_out, int32_t** tgt_codes_out,
+    int32_t** name_codes_out, double** values_out, int64_t** times_us_out,
+    uint8_t** ent_dict_out, uint64_t* ent_dict_bytes, int64_t* n_ent,
+    uint8_t** tgt_dict_out, uint64_t* tgt_dict_bytes, int64_t* n_tgt,
+    uint8_t** name_dict_out, uint64_t* name_dict_bytes, int64_t* n_names,
+    uint64_t** ent_offsets_out, uint64_t** tgt_offsets_out,
+    uint64_t** name_offsets_out) {
+  Log* log = static_cast<Log*>(h);
+  ensure_index_for_scan(log);
+  std::shared_lock lk(log->mu);
+  if (log->broken) return -1;
+
+  uint64_t start = since_rec;
+  *out_rebased = 0;
+  if (since_gen != log->generation || since_rec > log->recs.size()) {
+    start = 0;
+    *out_rebased = 1;
   }
-  *ent_codes_out = ent_codes;
-  *tgt_codes_out = tgt_codes;
-  *name_codes_out = name_codes;
-  *values_out = values;
-  *times_us_out = times_us;
-  *ent_dict_out = ent_dict;
-  *tgt_dict_out = tgt_dict;
-  *name_dict_out = name_dict;
-  *ent_offsets_out = ent_offs;
-  *tgt_offsets_out = tgt_offs;
-  *name_offsets_out = name_offs;
-  *n_ent = static_cast<int64_t>(ents.order.size());
-  *n_tgt = static_cast<int64_t>(tgts.order.size());
-  *n_names = static_cast<int64_t>(names.order.size());
-  return static_cast<int64_t>(n);
+  const double nan = std::numeric_limits<double>::quiet_NaN();
+  DictEncoder ents, tgts, names;
+  std::vector<int32_t> ent_v, tgt_v, name_v;
+  std::vector<double> val_v;
+  std::vector<int64_t> time_v;
+  FilterCtx ctx = make_filter_ctx(req);
+  Header hd;
+  const uint64_t nrec = log->recs.size();
+  for (uint64_t i = start; i < nrec; ++i) {
+    if (!match_rec(log, req, ctx, i, &hd)) continue;
+    ent_v.push_back(ents.encode(hd.eid, hd.len_eid));
+    tgt_v.push_back(hd.tid ? tgts.encode(hd.tid, hd.len_tid) : -1);
+    name_v.push_back(names.encode(hd.event, hd.len_event));
+    time_v.push_back(hd.time_us);
+    val_v.push_back(value_prop ? header_value(hd, value_prop) : nan);
+  }
+  *out_gen = log->generation;
+  *out_rec = nrec;
+  return finish_columns(
+      ents, tgts, names, ent_v, tgt_v, name_v, val_v, time_v,
+      ent_codes_out, tgt_codes_out, name_codes_out, values_out, times_us_out,
+      ent_dict_out, ent_dict_bytes, n_ent,
+      tgt_dict_out, tgt_dict_bytes, n_tgt,
+      name_dict_out, name_dict_bytes, n_names,
+      ent_offsets_out, tgt_offsets_out, name_offsets_out);
 }
 
 // Columnar bulk append: the native ingest path behind pio import /

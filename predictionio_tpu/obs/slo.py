@@ -108,6 +108,13 @@ class SLO:
     metric: str
     objective: float
     threshold_ms: Optional[float] = None
+    #: optional counter whose cumulative value ADDS to the good count
+    #: (clamped at total). The serving-latency SLO points this at
+    #: ``pio_router_hedge_rescues_total``: a request the router's hedge
+    #: saved answers the client in time even though the slow primary
+    #: attempt eventually records an over-threshold observation — that
+    #: observation must not burn latency budget (ROADMAP item B).
+    good_credit_metric: Optional[str] = None
 
     def budget(self) -> float:
         return max(1e-9, 1.0 - self.objective)
@@ -130,6 +137,15 @@ class SLO:
                     good += running
                     break
             total += child.count
+        if self.good_credit_metric:
+            credit_family = metrics.REGISTRY.get(self.good_credit_metric)
+            if credit_family is not None:
+                credit = sum(child.value
+                             for _v, child in credit_family.children())
+                # cumulative counter + cumulative good: window deltas in
+                # burn_rate subtract cleanly, so each rescued request
+                # credits exactly one good observation
+                good = min(total, good + credit)
         return good, total
 
     def _measure_availability(self, family) -> Tuple[float, float]:
@@ -168,6 +184,10 @@ def slos_from_config(config: Dict[str, Any]) -> List[SLO]:
             threshold_ms=float(config.get(
                 "latency_ms",
                 metrics.env_float("PIO_SLO_LATENCY_MS", 100.0))),
+            # hedge-saved requests answered the client in time: their
+            # slow primary attempt's histogram observation must not
+            # read as a latency SLO miss (router wires the counter)
+            good_credit_metric="pio_router_hedge_rescues_total",
         ),
         SLO(
             name="http-availability",

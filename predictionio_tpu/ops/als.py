@@ -1129,6 +1129,113 @@ def als_grid_train(
     ]
 
 
+# ---------------------------------------------------------------------------
+# streaming fold-in (ROADMAP item C): solve a handful of touched groups
+# against the FIXED opposing factors — the classic implicit/explicit ALS
+# fold-in (one exact half-step for the touched rows), reusing the same
+# Gramian + CG machinery as the full train but at delta scale.
+# ---------------------------------------------------------------------------
+
+#: fold-in CG floor: the full train warm-starts from last iteration's
+#: factors so 6 steps suffice; a fold-in may solve COLD groups (new
+#: users), where ~16 jacobi-CG steps reach ~1e-3 relative at K=64 —
+#: far below the fold-in equivalence tolerance
+FOLD_IN_CG_ITERS = 16
+
+
+def _pow2_at_least(n: int, floor: int = 8) -> int:
+    v = floor
+    while v < n:
+        v *= 2
+    return v
+
+
+@functools.lru_cache(maxsize=64)
+def _build_fold_in(b_pad: int, l_pad: int, rank: int, implicit: bool,
+                   solver: str, cg_iters: int):
+    """One jitted fold-in solve per (padded batch, padded length, rank,
+    flags) bucket — pow2 padding bounds the distinct compiles."""
+    f32 = jnp.float32
+    eye = np.eye(rank, dtype=np.float32)
+
+    def solve(Y, idx, val, mask, counts, x0, reg, alpha):
+        maskf = mask.astype(f32)
+        Yg = Y[idx] * maskf[..., None]               # [B, L, K], pads zeroed
+        if implicit:
+            A = alpha * jnp.einsum("blk,bl,blj->bkj", Yg, val, Yg,
+                                   preferred_element_type=f32)
+            b = jnp.einsum("blk,bl->bk", Yg, (1.0 + alpha * val) * maskf,
+                           preferred_element_type=f32)
+            YtY = jnp.einsum("lk,lj->kj", Y, Y, preferred_element_type=f32)
+            A = A + YtY + reg * eye
+        else:
+            A = jnp.einsum("blk,blj->bkj", Yg, Yg,
+                           preferred_element_type=f32)
+            b = jnp.einsum("blk,bl->bk", Yg, val,
+                           preferred_element_type=f32)
+            n_u = jnp.maximum(counts.astype(f32), 1.0)
+            A = A + (reg * n_u)[:, None, None] * eye
+        if solver == "cg":
+            x = _batched_cg(A, b, cg_iters, x0=x0, matvec_dtype=f32,
+                            unroll=False, precond="jacobi")
+        else:
+            x = jnp.linalg.solve(A, b[..., None])[..., 0]
+        # empty (all-pad) groups keep their warm start untouched: a
+        # zero-rating solve would drag an existing factor toward zero
+        return jnp.where((counts > 0)[:, None], x, x0)
+
+    return jax.jit(solve)
+
+
+def fold_in_solve(
+    Y: np.ndarray,
+    rows: "List[Tuple[np.ndarray, np.ndarray]]",
+    cfg: ALSConfig,
+    x0: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Solve ``len(rows)`` groups' factors against fixed opposing
+    factors ``Y`` [n_opposing, K].
+
+    ``rows[i] = (opp_idx, values)``: group i's COMPLETE rating set
+    (opposing-side row indices + ratings) — for a new user this is
+    exactly its delta events, and the solve is the exact conditional
+    ALS optimum given Y; for an existing user the caller supplies the
+    full history so the fold-in matches what a half-step of the full
+    train would produce. ``x0`` [B, K] warm-starts the CG from the
+    groups' current factors (zeros for new groups).
+
+    Everything runs in float32 (deltas are small; fold-in precision is
+    what the equivalence gate measures). Inputs are padded to pow2
+    (batch, length) buckets so repeated folds hit a bounded set of
+    compiled programs. Returns the solved [B, K] float32 factors.
+    """
+    B = len(rows)
+    if B == 0:
+        return np.zeros((0, cfg.rank), np.float32)
+    L = max(1, max(len(idx) for idx, _ in rows))
+    b_pad = _pow2_at_least(B)
+    l_pad = _pow2_at_least(L)
+    idx = np.zeros((b_pad, l_pad), np.int32)
+    val = np.zeros((b_pad, l_pad), np.float32)
+    mask = np.zeros((b_pad, l_pad), np.bool_)
+    counts = np.zeros(b_pad, np.int32)
+    for i, (gi, gv) in enumerate(rows):
+        n = len(gi)
+        idx[i, :n] = gi
+        val[i, :n] = gv
+        mask[i, :n] = True
+        counts[i] = n
+    x0_arr = np.zeros((b_pad, cfg.rank), np.float32)
+    if x0 is not None:
+        x0_arr[:B] = np.asarray(x0, np.float32)
+    cg_iters = max(cfg.cg_iters, FOLD_IN_CG_ITERS)
+    fn = _build_fold_in(b_pad, l_pad, cfg.rank, cfg.implicit,
+                        cfg.solver, cg_iters)
+    out = fn(jnp.asarray(Y, dtype=jnp.float32), idx, val, mask,
+             counts, x0_arr, np.float32(cfg.reg), np.float32(cfg.alpha))
+    return np.asarray(out)[:B]
+
+
 def predict_rmse(factors: ALSFactors, coo) -> float:
     """Host-side RMSE over COO ratings (evaluation metric helper)."""
     u, i, r = coo

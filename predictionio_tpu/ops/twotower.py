@@ -802,3 +802,93 @@ def twotower_train(
     trainer = TwoTowerTrainer(positives, n_users, n_items, cfg, mesh=mesh)
     losses = trainer.run()
     return trainer.embeddings(losses)
+
+
+# ---------------------------------------------------------------------------
+# streaming online steps (ROADMAP item C): bounded mini-batch gradient
+# steps on a delta buffer, applied to the SERVING embeddings — the
+# two-tower counterpart of the ALS fold-in. The full trainer owns the
+# tables + tail MLP; at serving time a TwoTowerModel carries only the
+# final (L2-normalized) embedding vectors, so the online step treats the
+# touched rows as free embeddings and descends the same in-batch
+# softmax-CE the trainer optimizes, renormalizing after each step to
+# stay on the serving manifold. Quality gates for this delta path are a
+# ROADMAP follow-up (item C close-out); equivalence with a full retrain
+# is NOT claimed — this keeps fresh interactions from serving stale.
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=32)
+def _build_online_step(steps: int):
+    def run(Uu, Vi, pos_u, pos_i, weight, lr, temp):
+        def loss_fn(params):
+            Uu_, Vi_ = params
+            return _dense_softmax_ce(Uu_[pos_u], Vi_[pos_i], pos_u, pos_i,
+                                     weight, temp, jnp.float32)
+
+        def renorm(t):
+            return t / jnp.maximum(
+                jnp.linalg.norm(t, axis=-1, keepdims=True), 1e-8)
+
+        def body(params, _):
+            loss, (gU, gV) = jax.value_and_grad(loss_fn)(params)
+            Uu_, Vi_ = params
+            return (renorm(Uu_ - lr * gU), renorm(Vi_ - lr * gV)), loss
+
+        (Uu, Vi), losses = jax.lax.scan(body, (Uu, Vi), None, length=steps)
+        return Uu, Vi, losses
+
+    return jax.jit(run)
+
+
+def online_delta_step(
+    user_vecs: np.ndarray,
+    item_vecs: np.ndarray,
+    u_rows: np.ndarray,
+    i_rows: np.ndarray,
+    weight: Optional[np.ndarray] = None,
+    lr: float = 0.05,
+    steps: int = 4,
+    temp: float = 0.05,
+):
+    """``steps`` SGD steps of the in-batch softmax CE over the delta
+    pairs ``(u_rows[p], i_rows[p])``, updating ONLY the touched rows of
+    the serving embedding tables.
+
+    Returns ``(touched_u_rows, new_u_vecs, touched_i_rows, new_i_vecs,
+    losses)`` — the unique touched row indices and their updated
+    (renormalized) vectors; untouched rows are never read back, so the
+    result is directly a model patch. Inputs pad to pow2 buckets so
+    repeated folds hit a bounded set of compiled programs.
+    """
+    u_rows = np.asarray(u_rows, np.int32)
+    i_rows = np.asarray(i_rows, np.int32)
+    P = len(u_rows)
+    if P == 0:
+        d = user_vecs.shape[1]
+        return (np.zeros(0, np.int32), np.zeros((0, d), np.float32),
+                np.zeros(0, np.int32), np.zeros((0, d), np.float32), [])
+    from predictionio_tpu.ops.als import _pow2_at_least
+
+    uu, pos_u = np.unique(u_rows, return_inverse=True)
+    ii, pos_i = np.unique(i_rows, return_inverse=True)
+    p_pad = _pow2_at_least(P)
+    bu_pad = _pow2_at_least(len(uu))
+    bi_pad = _pow2_at_least(len(ii))
+    d = user_vecs.shape[1]
+    Uu = np.zeros((bu_pad, d), np.float32)
+    Uu[:len(uu)] = np.asarray(user_vecs, np.float32)[uu]
+    Vi = np.zeros((bi_pad, d), np.float32)
+    Vi[:len(ii)] = np.asarray(item_vecs, np.float32)[ii]
+    posu = np.zeros(p_pad, np.int32)
+    posu[:P] = pos_u
+    posi = np.zeros(p_pad, np.int32)
+    posi[:P] = pos_i
+    w = np.zeros(p_pad, np.float32)
+    w[:P] = (np.asarray(weight, np.float32)
+             if weight is not None else np.ones(P, np.float32))
+    fn = _build_online_step(int(steps))
+    Uu2, Vi2, losses = fn(Uu, Vi, posu, posi, w,
+                          np.float32(lr), np.float32(temp))
+    return (uu.astype(np.int32), np.asarray(Uu2)[:len(uu)],
+            ii.astype(np.int32), np.asarray(Vi2)[:len(ii)],
+            [float(x) for x in np.asarray(losses)])

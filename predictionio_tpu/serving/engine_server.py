@@ -69,6 +69,15 @@ _SERVING_SECONDS = metrics.histogram(
 #: PIO_STALL_FACTOR x that median (floor 1s x factor)
 _DISPATCH_WATCHDOG = health.Watchdog("serving_dispatch")
 
+#: streaming model patches (workflow/stream.py fold-in lane): applied /
+#: stale-instance-rejected / unsupported-or-malformed
+_MODEL_PATCHES = metrics.counter(
+    "pio_model_patches_total",
+    "Streaming model patches received by outcome (applied / stale / "
+    "rejected)",
+    ("result",),
+)
+
 
 def _http_inflight() -> float:
     """Requests currently inside this engine server (the shared HTTP
@@ -577,6 +586,58 @@ class EngineServer(HTTPServerBase):
             self.deployment = deployment
         return deployment.instance.id
 
+    # -- streaming model patches (workflow/stream.py) -----------------------
+    class StalePatch(RuntimeError):
+        """The patch targets an instance this server no longer serves."""
+
+    def apply_patch(self, payload: dict) -> dict:
+        """Apply a streaming fold-in patch to the live deployment —
+        the lightweight freshness lane between full reloads. Applied
+        under the deployment lock (between queries); each algorithm's
+        ``apply_patch`` swaps rows copy-on-write, so in-flight queries
+        see old-or-new tables, never torn rows.
+
+        Raises :class:`StalePatch` when ``instanceId`` names another
+        instance (the caller should resync), ValueError on malformed or
+        unsupported blocks. Returns {"applied": n_blocks}."""
+        instance_id = payload.get("instanceId")
+        blocks = payload.get("algorithms")
+        if not isinstance(blocks, list) or not blocks:
+            _MODEL_PATCHES.labels("rejected").inc()
+            raise ValueError("patch needs a non-empty 'algorithms' list")
+        with self._deployment_lock:
+            deployment = self.deployment
+            if instance_id and instance_id != deployment.instance.id:
+                _MODEL_PATCHES.labels("stale").inc()
+                raise self.StalePatch(
+                    f"patch targets instance {instance_id} but "
+                    f"{deployment.instance.id} is deployed")
+            applied = 0
+            for block in blocks:
+                if not isinstance(block, dict):
+                    _MODEL_PATCHES.labels("rejected").inc()
+                    raise ValueError("each algorithm block must be an object")
+                idx = block.get("index", 0)
+                if not isinstance(idx, int) or not (
+                        0 <= idx < len(deployment.algorithms)):
+                    _MODEL_PATCHES.labels("rejected").inc()
+                    raise ValueError(f"algorithm index {idx!r} out of range")
+                algo = deployment.algorithms[idx]
+                model = deployment.models[idx]
+                try:
+                    ok = algo.apply_patch(model, block)
+                except ValueError:
+                    _MODEL_PATCHES.labels("rejected").inc()
+                    raise
+                if not ok:
+                    _MODEL_PATCHES.labels("rejected").inc()
+                    raise ValueError(
+                        f"algorithm {type(algo).__name__} does not "
+                        "support model patches — use /reload")
+                applied += 1
+        _MODEL_PATCHES.labels("applied").inc()
+        return {"applied": applied}
+
     # -- degraded mode ------------------------------------------------------
     def degraded_reason(self) -> Optional[str]:
         """Non-None while serving degraded: the storage circuit is not
@@ -848,6 +909,35 @@ class _EngineRequestHandler(JSONRequestHandler):
             self._send(200, result,
                        extra_headers=({"X-PIO-Degraded": degraded}
                                       if degraded else None))
+        elif path == "/model/patch":
+            # same bearer gate as /admin/*: a patch MUTATES the served
+            # model — an open route would let anyone rewrite factors
+            from predictionio_tpu.serving.http import _admin_authorized
+
+            if not _admin_authorized(self):
+                self._send(401, {"message": "missing or invalid bearer "
+                                            "token (PIO_ADMIN_TOKEN)"},
+                           extra_headers={"WWW-Authenticate": "Bearer"})
+                return
+            try:
+                payload = self._read_json()
+            except json.JSONDecodeError as e:
+                self._send(400, {"message": f"invalid JSON: {e}"})
+                return
+            try:
+                result = self.server_ref.apply_patch(payload)
+            except EngineServer.StalePatch as e:
+                self._send(409, {"message": str(e)})
+                return
+            except (ValueError, TypeError, KeyError) as e:
+                self._send(400, {"message": f"bad patch: {e}"})
+                return
+            except Exception as e:  # noqa: BLE001 — a failing patch must
+                # answer 500, never crash the keep-alive connection
+                log.exception("model patch failed")
+                self._send(500, {"message": str(e)})
+                return
+            self._send(200, {"message": "patched", **result})
         elif path == "/stop":
             self._send(200, {"message": "stopping"})
             self.server_ref.stop()

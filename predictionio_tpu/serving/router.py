@@ -32,6 +32,12 @@ the supervisor's replicas (serving/fleet.py):
                shed traffic amplifies the overload it signals —
                counted in ``pio_router_passthrough_total{reason}``
 
+Forwarded attempts (and hedges) run on a small REUSABLE worker pool
+(``PIO_ROUTER_POOL_SIZE``, default 16) instead of a fresh thread per
+proxied query; when every worker is busy the attempt runs on a one-off
+overflow thread (a hedge timer must not queue behind a stalled fleet)
+and ``pio_router_pool_saturated_total`` counts it.
+
 Everything else of the operator surface (``/healthz``, ``/readyz``
 with a fleet-readiness probe, ``/metrics``, ``/admin/fleet``, ...)
 is inherited from serving/http.py. ``GET /reload`` starts the
@@ -86,6 +92,96 @@ _HEDGE_DEADLINE = metrics.gauge(
     "pio_router_hedge_deadline_seconds",
     "Current trailing-quantile hedge deadline (0 while unarmed)",
 )
+_HEDGE_RESCUES = metrics.counter(
+    "pio_router_hedge_rescues_total",
+    "Hedged requests whose hedge answer won while the primary attempt "
+    "was still in flight: the client got a timely answer, so the "
+    "serving-latency SLO credits these as good even though the slow "
+    "primary's eventual completion lands an over-threshold histogram "
+    "observation (obs/slo.py good_credit_metric)",
+)
+_POOL_SATURATED = metrics.counter(
+    "pio_router_pool_saturated_total",
+    "route_query submissions that found every pooled worker busy and "
+    "ran on a one-off overflow thread instead (raise "
+    "PIO_ROUTER_POOL_SIZE if this grows under steady load)",
+)
+
+
+class _WorkerPool:
+    """Reusable worker threads for the router's forwarded attempts
+    (ROADMAP item B follow-up): every proxied query used to spawn a
+    fresh thread — and a hedge a second one — putting thread-spawn
+    cost and churn on the hot path at real qps. ``size`` long-lived
+    workers (started lazily) drain a task queue instead. When every
+    worker is occupied, the task runs on a one-off overflow thread
+    rather than queueing — a hedge fired at the deadline must not wait
+    behind a stalled fleet's attempts — and the saturation is counted
+    in ``pio_router_pool_saturated_total``."""
+
+    def __init__(self, size: int):
+        self._size = max(1, size)
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._lock = threading.Lock()
+        self._outstanding = 0   # tasks queued or running on pool workers
+        self._started = 0
+        self._stopped = False
+
+    def _worker(self) -> None:
+        while True:
+            task = self._q.get()
+            if task is None:
+                return
+            fn, args = task
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001 — a task error must not
+                # kill the shared worker (attempts report their own
+                # failures through the results queue)
+                log.exception("router pool task failed")
+            finally:
+                with self._lock:
+                    self._outstanding -= 1
+
+    def submit(self, fn, *args) -> None:
+        overflow = False
+        with self._lock:
+            if self._stopped:
+                overflow = True
+            elif self._outstanding >= self._size:
+                overflow = True
+            else:
+                self._outstanding += 1
+                if self._started < min(self._outstanding, self._size):
+                    self._started += 1
+                    threading.Thread(
+                        target=self._worker, daemon=True,
+                        name=f"router-pool-{self._started}").start()
+        if overflow:
+            _POOL_SATURATED.inc()
+            threading.Thread(target=self._run_overflow, args=(fn, args),
+                             daemon=True,
+                             name="router-pool-overflow").start()
+        else:
+            self._q.put((fn, args))
+
+    @staticmethod
+    def _run_overflow(fn, args) -> None:
+        try:
+            fn(*args)
+        except Exception:  # noqa: BLE001 — same contract as _worker
+            log.exception("router overflow task failed")
+
+    def outstanding(self) -> int:
+        with self._lock:
+            return self._outstanding
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            started = self._started
+        for _ in range(started):
+            self._q.put(None)
 
 
 class HedgeClock:
@@ -293,6 +389,10 @@ class QueryRouter(HTTPServerBase):
         self._rng = rng or random.Random()
         self._pools: Dict[Tuple[str, int], _ReplicaClient] = {}
         self._pools_lock = threading.Lock()
+        # hot-path worker pool: forwarded attempts (and hedges) run on
+        # reusable threads instead of a fresh spawn per query
+        self._worker_pool = _WorkerPool(
+            metrics.env_int("PIO_ROUTER_POOL_SIZE", 16))
         super().__init__(host, port, _RouterRequestHandler,
                          bind_retries=bind_retries)
 
@@ -387,11 +487,9 @@ class QueryRouter(HTTPServerBase):
 
         def launch(replica: Replica) -> None:
             tried.add(replica.name)
-            threading.Thread(
-                target=self._attempt,
-                args=(replica, body, headers, deadline, results,
-                      idempotent),
-                daemon=True, name=f"route-{replica.name}").start()
+            self._worker_pool.submit(
+                self._attempt, replica, body, headers, deadline, results,
+                idempotent)
 
         first = self._select(tried)
         if first is None:
@@ -403,6 +501,7 @@ class QueryRouter(HTTPServerBase):
         hedge_at = (time.monotonic() + hedge_after
                     if hedge_after is not None else None)
         outstanding = 1
+        hedge_name: Optional[str] = None
         last_error: Optional[BaseException] = None
         # first non-2xx application answer, held while another attempt
         # is still in flight (see below)
@@ -423,6 +522,7 @@ class QueryRouter(HTTPServerBase):
                     second = self._select(tried)
                     if second is not None:
                         _HEDGES.inc()
+                        hedge_name = second.name
                         launch(second)
                         outstanding += 1
                     continue
@@ -449,6 +549,15 @@ class QueryRouter(HTTPServerBase):
             status, data, replica_headers = outcome
             outstanding -= 1
             if 200 <= status < 300 or not outstanding:
+                if (200 <= status < 300 and outstanding
+                        and replica.name == hedge_name):
+                    # the hedge SAVED this request: its answer returns
+                    # while the slow primary is still in flight. The
+                    # primary's eventual completion will land an
+                    # over-threshold serving-latency observation the
+                    # client never experienced — this counter credits
+                    # it back in the SLO burn accounting (obs/slo.py)
+                    _HEDGE_RESCUES.inc()
                 return self._passthrough(replica, status, data,
                                          replica_headers)
             # a non-2xx racer answer must not beat a primary attempt
@@ -520,6 +629,7 @@ class QueryRouter(HTTPServerBase):
 
     def stop(self) -> None:
         super().stop()
+        self._worker_pool.stop()
         with self._pools_lock:
             pools, self._pools = list(self._pools.values()), {}
         for pool in pools:

@@ -11,6 +11,9 @@ Console.scala:128-735 command surface; bin/pio:17-42 wrapper):
                          in-process — no spark-submit JVM hop)
   eval                  (ref: evaluation branch, CreateWorkflow.scala:263)
   deploy / undeploy     (ref: Console.scala:830 -> CreateServer)
+  stream                (streaming events->model daemon: delta tailer +
+                         ALS fold-in / two-tower online steps, model
+                         patches to live servers — ROADMAP item C)
   eventserver / adminserver / dashboard / storageserver
   import / export       (ref: imprt/FileToEvents, export/EventsToFile)
   template list|get     (egress-free: scaffolds the built-in templates
@@ -359,6 +362,38 @@ def _deploy_fleet(args, replicas: int) -> int:
                and _time.monotonic() < deadline):
             _time.sleep(0.05)
         fleet.stop()
+    return 0
+
+
+def cmd_stream(args) -> int:
+    """`pio stream`: the streaming events→model daemon (ROADMAP item C)
+    — tail the event log since the last fold, fold deltas into the
+    deployed model (ALS fold-in / two-tower online steps), and push
+    model patches to live engine servers; `--once` runs one cycle."""
+    from predictionio_tpu.workflow.stream import (StreamUnsupported,
+                                                  StreamUpdater)
+
+    variant = _load_variant(args.engine_json)
+    engine = variant.create_engine()
+    engine_id = (args.engine_id or variant.raw.get("engineId")
+                 or variant.engine_factory)
+    urls = [u.strip() for u in (args.url or "").split(",") if u.strip()]
+    try:
+        updater = StreamUpdater(
+            engine, engine_id, engine_version=args.engine_version,
+            engine_variant=variant.id, patch_urls=urls)
+    except StreamUnsupported as e:
+        raise CommandError(str(e)) from e
+    if args.once:
+        _p(json.dumps(updater.poll_once()))
+        return 0
+    _p(f"streaming fold-in for engine {engine_id} "
+       f"(instance {updater.instance_id}, cursor {updater.cursor}) -> "
+       f"{', '.join(urls) if urls else 'local model only'}; Ctrl-C stops")
+    try:
+        updater.run_forever(interval=args.interval)
+    except KeyboardInterrupt:
+        _p("stream stopped")
     return 0
 
 
@@ -1120,6 +1155,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "ports (production) or in-process threaded "
                         "servers (single-host / tests)")
     p.set_defaults(func=cmd_deploy)
+
+    p = sub.add_parser(
+        "stream",
+        help="streaming events->model daemon: tail the event log, fold "
+             "deltas into the deployed model (ALS fold-in / two-tower "
+             "online steps), push /model/patch to engine servers "
+             "(ROADMAP item C; interval: PIO_STREAM_INTERVAL_SEC)")
+    add_engine_args(p)
+    p.add_argument("--url", default=None,
+                   help="comma-separated engine-server base URLs to "
+                        "patch (e.g. http://127.0.0.1:8000); omit to "
+                        "fold the local model copy only. For fleets, "
+                        "patch each replica — the rolling GET /reload "
+                        "stays the full-retrain fallback")
+    p.add_argument("--interval", type=float, default=None,
+                   help="poll seconds (default PIO_STREAM_INTERVAL_SEC "
+                        "or 1.0)")
+    p.add_argument("--once", action="store_true",
+                   help="one tail->fold->publish cycle, print stats JSON")
+    p.set_defaults(func=cmd_stream)
 
     p = sub.add_parser("undeploy", help="stop a deployed engine server")
     p.add_argument("--ip", default="127.0.0.1")
