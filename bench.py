@@ -281,8 +281,23 @@ def _transfer_and_compile(detail, trainer, iterations, n_read):
 
 def _read_prepare_bin_train(detail, n_expected):
     """The shared events->model path (both stages): returns everything
-    the caller needs for quality gates / serving."""
-    from predictionio_tpu.ops.als import ALSTrainer
+    the caller needs for quality gates / serving — (trainer, pd, ho,
+    train_stats, cfg, train_sec) where train_stats = {"n_train",
+    "train_mean"} (the COO itself no longer materializes on the
+    zero-copy lane).
+
+    Cold lane (PIO_BENCH_BINNED=0 restores the legacy path): the
+    fused native scan+bin call (store.bin_columnar) replaces
+    read_training -> prepare -> ALSTrainer binning — one pass off the
+    mmap'd log straight into the device-ready compressed layout, with
+    the 5%% holdout split applied natively. read_sec is the native
+    scan share, bin_sec the resolve+plan+fill share plus the (async)
+    put dispatch."""
+    from predictionio_tpu.data.bimap import BiMap
+    from predictionio_tpu.ops import bincache
+    from predictionio_tpu.ops.als import (ALSTrainer, als_row_cost_slots,
+                                          layout_cache_key,
+                                          side_layout_from_binned)
     from predictionio_tpu.parallel.mesh import MeshContext
     from predictionio_tpu.templates.recommendation import (
         RecoDataSource,
@@ -292,7 +307,70 @@ def _read_prepare_bin_train(detail, n_expected):
 
     _, _, _, rank, iterations = knobs()
     ctx = MeshContext()
-    ds = RecoDataSource(RecoDataSourceParams(app_name="bench"))
+    # binned=False: the bench drives the two lanes EXPLICITLY (the
+    # engine-path plumbing is exercised by tier-1; here each stage is
+    # timed by hand), so the fallback read must stay columnar
+    ds = RecoDataSource(RecoDataSourceParams(app_name="bench",
+                                             binned=False))
+    cfg = _bench_cfg()
+    binned_lane = (os.environ.get("PIO_BENCH_BINNED", "1") != "0"
+                   and ds._binned_supported())
+    detail["zero_copy_lane"] = bool(binned_lane)
+    if binned_lane:
+        from predictionio_tpu.data import store as dstore
+        from predictionio_tpu.models.als import PreparedRatings
+
+        fp = ds.data_fingerprint()
+        t0 = time.perf_counter()
+        binned = dstore.bin_columnar(
+            "bench", value_property="rating", overrides={"buy": 4.0},
+            entity_type="user", event_names=["rate", "buy"],
+            target_entity_type="item",
+            skip_mod=20, skip_rem=0,            # the 5% holdout split
+            seg_len=cfg.seg_len, block_size=cfg.block_size,
+            row_cost_slots=als_row_cost_slots(cfg.rank))
+        t1 = time.perf_counter()
+        n_hold = 0 if binned.holdout is None else len(binned.holdout[0])
+        assert binned.n_rows + n_hold == n_expected, (
+            binned.n_rows, n_hold, n_expected)
+        detail["read_sec"] = round(binned.scan_sec, 2)
+        detail["prepare_sec"] = 0.0   # dict-encode fused into the scan
+        user_side = side_layout_from_binned(binned.user_side)
+        item_side = side_layout_from_binned(binned.item_side)
+        trainer = ALSTrainer.from_sides(
+            user_side, item_side, len(binned.entity_vocab),
+            len(binned.target_vocab), binned.n_rows, cfg)
+        # everything that is not the scan is the bin stage (native
+        # resolve+plan+fill + vocab decode + async put dispatch)
+        detail["bin_sec"] = round(
+            (t1 - t0 - binned.scan_sec)
+            + (time.perf_counter() - t1), 2)
+        detail["bin_cache_hit"] = False
+        if fp is not None:
+            # persist under the SAME key the warm stage loads
+            arrays = {**user_side.to_arrays("u_"),
+                      **item_side.to_arrays("i_")}
+            bincache.save(
+                layout_cache_key(fp + _HOLD_TAG, cfg, 1), arrays, {
+                    "n_users": len(binned.entity_vocab),
+                    "n_items": len(binned.target_vocab),
+                    "n_shards": 1, "total_entries": binned.n_rows,
+                    **user_side.meta("u_"), **item_side.meta("i_"),
+                })
+        pd = PreparedRatings(
+            user_ids=BiMap.from_vocab(binned.entity_vocab),
+            item_ids=BiMap.from_vocab(binned.target_vocab),
+            fingerprint=fp)
+        ho = binned.holdout
+        train_stats = {
+            "n_train": binned.n_rows,
+            "train_mean": (binned.user_side.kept_value_sum
+                           / max(1, binned.user_side.kept_entries)),
+        }
+        train_sec = _transfer_and_compile(detail, trainer, iterations,
+                                          n_expected)
+        return trainer, pd, ho, train_stats, cfg, train_sec
+
     t0 = time.perf_counter()
     td = ds.read_training(ctx)
     read_sec = time.perf_counter() - t0
@@ -308,15 +386,15 @@ def _read_prepare_bin_train(detail, n_expected):
     tr_u, tr_i, tr_r = pd.user_idx[~hold], pd.item_idx[~hold], pd.ratings[~hold]
     ho = (pd.user_idx[hold], pd.item_idx[hold], pd.ratings[hold])
 
-    cfg = _bench_cfg()
     cache_key = (pd.fingerprint + _HOLD_TAG) if pd.fingerprint else None
     t0 = time.perf_counter()
     trainer = ALSTrainer((tr_u, tr_i, tr_r), len(pd.user_ids),
                          len(pd.item_ids), cfg, cache_key=cache_key)
     detail["bin_sec"] = round(time.perf_counter() - t0, 2)
     detail["bin_cache_hit"] = bool(trainer.cache_hit)
+    train_stats = {"n_train": len(tr_r), "train_mean": float(tr_r.mean())}
     train_sec = _transfer_and_compile(detail, trainer, iterations, n_read)
-    return trainer, pd, ho, (tr_u, tr_i, tr_r), cfg, train_sec
+    return trainer, pd, ho, train_stats, cfg, train_sec
 
 
 def _parse_train_profile(profile_dir):
@@ -1090,14 +1168,15 @@ def stage_cold(base_dir, out_path):
     detail["insert_batch_events_per_sec"] = round(sample / (t2 - t1), 1)
     detail["python_row_lane_events_per_sec"] = round(sample / (t2 - t0), 1)
 
-    trainer, pd, ho, train_coo, cfg, train_sec = _read_prepare_bin_train(
+    trainer, pd, ho, train_stats, cfg, train_sec = _read_prepare_bin_train(
         detail, n_ratings
     )
     factors = trainer.factors()
 
     # quality gates (baseline: the global-mean predictor fit on train)
     rmse = predict_rmse(factors, ho)
-    base_rmse = float(np.sqrt(np.mean((ho[2] - train_coo[2].mean()) ** 2)))
+    base_rmse = float(
+        np.sqrt(np.mean((ho[2] - train_stats["train_mean"]) ** 2)))
     detail["rmse_heldout"] = round(rmse, 4)
     detail["rmse_global_mean_baseline"] = round(base_rmse, 4)
     detail["rmse_gate_passed"] = bool(rmse <= 0.85 * base_rmse)
@@ -1108,7 +1187,8 @@ def stage_cold(base_dir, out_path):
     )
 
     effective = (trainer.kept_user_entries + trainer.kept_item_entries) / 2
-    assert int(effective) == len(train_coo[2]), (effective, len(train_coo[2]))
+    assert int(effective) == train_stats["n_train"], (
+        effective, train_stats["n_train"])
     detail["updates_per_sec"] = round(effective * iterations / train_sec, 1)
     detail["roofline"] = _roofline(trainer, train_sec, iterations)
 
@@ -1330,6 +1410,54 @@ def stage_twotower(base_dir, out_path):
         json.dump(detail, f)
 
 
+def _chunk_sweep(full_key, cfg):
+    """The H2D chunk-size sweep (detail.datapath.chunk_sweep): re-put
+    the CACHED layout at several PIO_BIN_CHUNK_MB settings — mmap load
+    + chunked device_put, timed put-dispatch -> confirmed-resident.
+    After the first point the file is page-cache-warm, so the sweep
+    isolates the transfer pipeline itself (chunking/overlap), not disk;
+    chunk 0 = double-buffering off (the old single-shot put per array),
+    giving the in-round A/B for the pipeline."""
+    from predictionio_tpu.ops import bincache
+    from predictionio_tpu.ops.als import ALSTrainer, SideLayout
+
+    points = []
+    saved_chunk = os.environ.get("PIO_BIN_CHUNK_MB")
+    saved_db = os.environ.get("PIO_TRANSFER_DOUBLE_BUFFER")
+    try:
+        for mb in (16, 64, 256, 0):
+            cached = bincache.load(full_key)
+            if cached is None:
+                break
+            arrays, meta = cached
+            if mb > 0:
+                os.environ["PIO_BIN_CHUNK_MB"] = str(mb)
+                os.environ.pop("PIO_TRANSFER_DOUBLE_BUFFER", None)
+            else:
+                os.environ["PIO_TRANSFER_DOUBLE_BUFFER"] = "0"
+            user_side = SideLayout.from_arrays(arrays, "u_", meta)
+            item_side = SideLayout.from_arrays(arrays, "i_", meta)
+            trainer = ALSTrainer.from_sides(
+                user_side, item_side, int(meta["n_users"]),
+                int(meta["n_items"]), int(meta["total_entries"]), cfg)
+            dones = trainer.wait_device_timed()
+            sec = max(dones[-1] - trainer.put_start, 1e-9)
+            points.append({
+                "chunk_mb": mb,
+                "transfer_sec": round(sec, 3),
+                "mb_per_sec": round(trainer.transfer_bytes / sec / 1e6, 1),
+            })
+            del trainer
+    finally:
+        for k, v in (("PIO_BIN_CHUNK_MB", saved_chunk),
+                     ("PIO_TRANSFER_DOUBLE_BUFFER", saved_db)):
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return points
+
+
 def stage_warm(base_dir, out_path):
     """Fresh process, same store + same compilation + layout caches:
     the repeat events->model path every retrain / deploy / reload pays.
@@ -1373,6 +1501,18 @@ def stage_warm(base_dir, out_path):
     if trainer is not None:
         n_read = n_ratings  # what the skipped read would have returned
         _transfer_and_compile(detail, trainer, iterations, n_read)
+        if os.environ.get("PIO_BENCH_CHUNK_SWEEP", "1") != "0":
+            from predictionio_tpu.ops.als import layout_cache_key
+
+            detail["datapath"] = {
+                "chunk_sweep": _chunk_sweep(
+                    layout_cache_key(fp + _HOLD_TAG, _bench_cfg(), 1),
+                    _bench_cfg()),
+                "note": ("warm re-puts of the cached layout per "
+                         "PIO_BIN_CHUNK_MB (page-cache-warm after the "
+                         "first point); chunk_mb 0 = double-buffered "
+                         "pipeline OFF (single-shot put per array)"),
+            }
     else:
         detail["bin_cache_hit"] = False
         _read_prepare_bin_train(detail, n_ratings)
@@ -1425,6 +1565,10 @@ def emit_headline(detail, detail_path=None):
     key = {
         "train_sec": detail.get("train_sec"),
         "events_to_model_sec": detail.get("events_to_model_sec"),
+        # the zero-copy data path's own gates: cold host binning and
+        # the H2D wire window (benchcmp: _sec suffix = lower-better)
+        "bin_sec": detail.get("bin_sec"),
+        "transfer_sec": detail.get("transfer_sec"),
         "warm_events_to_model_sec": detail.get("warm", {})
         .get("events_to_model_sec"),
         "warm_transfer_mb_per_sec": detail.get("warm", {})

@@ -26,6 +26,7 @@ import struct
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from predictionio_tpu import native as native_mod
 from predictionio_tpu.data import storage as S
 from predictionio_tpu.data.backends.localfs import LocalFSStorageClient
 from predictionio_tpu.data.datamap import DataMap
@@ -37,6 +38,34 @@ _US = _dt.timedelta(microseconds=1)
 _I64_MIN = -(2**63)
 _I64_MAX = 2**63 - 1
 _ABSENT = 0xFFFF
+
+
+#: binlayout::CSide mirror (shared with ops/ragged via native.CSide)
+_CSide = native_mod.CSide
+
+
+class _BinColumnarOut(ctypes.Structure):
+    """Mirror of BinColumnarOut (eventlog.cpp el_bin_columnar)."""
+
+    _fields_ = [
+        ("user_side", _CSide),
+        ("item_side", _CSide),
+        ("ent_dict", ctypes.c_void_p),
+        ("ent_offsets", ctypes.c_void_p),
+        ("tgt_dict", ctypes.c_void_p),
+        ("tgt_offsets", ctypes.c_void_p),
+        ("hold_u", ctypes.c_void_p),
+        ("hold_i", ctypes.c_void_p),
+        ("hold_v", ctypes.c_void_p),
+        ("ent_dict_bytes", ctypes.c_uint64),
+        ("tgt_dict_bytes", ctypes.c_uint64),
+        ("n_ent", ctypes.c_int64),
+        ("n_tgt", ctypes.c_int64),
+        ("n_hold", ctypes.c_int64),
+        ("n_rows", ctypes.c_int64),
+        ("scan_sec", ctypes.c_double),
+        ("bin_sec", ctypes.c_double),
+    ]
 
 
 class _FindReq(ctypes.Structure):
@@ -145,6 +174,27 @@ def _load():
     lib.el_fingerprint.argtypes = [ctypes.c_void_p,
                                    ctypes.POINTER(ctypes.c_uint64)]
     lib.el_fingerprint.restype = None
+    lib.el_bin_columnar.restype = ctypes.c_int64
+    lib.el_bin_columnar.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(_FindReq), ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_double), ctypes.c_int32,
+        ctypes.c_int64, ctypes.c_int64,                   # skip mod/rem
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,   # seg_len, max u/i
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_double,  # shards, block, cost
+        ctypes.POINTER(_BinColumnarOut),
+    ]
+    lib.el_append_rows.restype = ctypes.c_int64
+    u64p_ = ctypes.POINTER(ctypes.c_uint64)
+    lib.el_append_rows.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_char_p,                                  # ids n*16
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_char_p,                                  # flags
+        ctypes.c_char_p, u64p_, ctypes.c_char_p, u64p_,   # ev, et
+        ctypes.c_char_p, u64p_, ctypes.c_char_p, u64p_,   # ei, tt
+        ctypes.c_char_p, u64p_, ctypes.c_char_p, u64p_,   # ti, extra
+        ctypes.c_int32,                                   # fresh_ids
+    ]
     lib.el_free.argtypes = [ctypes.c_void_p]
     return lib
 
@@ -176,17 +226,15 @@ def _us(t: _dt.datetime) -> int:
     return (t - _EPOCH) // _US
 
 
-def _pack(e: Event, id16: Optional[bytes] = None) -> bytes:
-    """One wire record. ``id16``: pre-derived raw id (the insert_batch
-    hot path generates ids itself); None derives it from e.event_id."""
-    # extra carries everything the filterable header doesn't: properties,
-    # tags, prId, exact ISO times when needed (tz offsets survive the
-    # round trip; a UTC time is exactly reconstructed from the micros
-    # header, so the common case skips both isoformats and shrinks the
-    # JSON — the row write lane is latency-sensitive), and the original
-    # id when it isn't canonical 16-byte hex
-    t_us = _us(e.event_time)
-    c_us = _us(e.creation_time)
+def _extra_bytes(e: Event, orig_id: Optional[str]) -> bytes:
+    """The record's JSON ``extra`` blob: everything the filterable
+    header doesn't carry — properties, tags, prId, exact ISO times when
+    needed (tz offsets survive the round trip; a UTC time is exactly
+    reconstructed from the micros header, so the common case skips both
+    isoformats and shrinks the JSON — the row write lane is
+    latency-sensitive), and the original id when it isn't canonical
+    16-byte hex. The ONE implementation behind both the legacy _pack
+    and the vectorized insert_batch fast lane."""
     extra: Dict[str, Any] = {}
     if e.event_time.utcoffset():
         extra["et"] = e.event_time.isoformat()
@@ -198,19 +246,49 @@ def _pack(e: Event, id16: Optional[bytes] = None) -> bytes:
         extra["t"] = list(e.tags)
     if e.pr_id is not None:
         extra["pr"] = e.pr_id
+    if orig_id is not None:
+        extra["id"] = orig_id
+    if not extra:
+        return b""
+    if len(extra) == 1 and "p" in extra:
+        # the dominant live-lane shape: properties only — and within
+        # it, the single-numeric-property case ({"rating": 4.5}) is hot
+        # enough that skipping json.dumps is worth a guarded formatter
+        p = extra["p"]
+        if len(p) == 1:
+            k, v = next(iter(p.items()))
+            tv = type(v)
+            if ((tv is float and v == v and v not in (_INF, _NINF))
+                    or tv is int) and _plain_key(k):
+                return f'{{"p":{{"{k}":{v!r}}}}}'.encode("utf-8")
+        return b'{"p":' + json.dumps(
+            p, separators=(",", ":")
+        ).encode("utf-8") + b"}"
+    return json.dumps(extra, separators=(",", ":")).encode("utf-8")
+
+
+_INF = float("inf")
+_NINF = float("-inf")
+
+
+def _plain_key(k: str) -> bool:
+    """Key needs no JSON escaping (ascii, printable, no quote/backslash)
+    — the guard on the formatter fast path above."""
+    return (type(k) is str and k.isascii() and k.isprintable()
+            and '"' not in k and "\\" not in k)
+
+
+def _pack(e: Event, id16: Optional[bytes] = None) -> bytes:
+    """One wire record. ``id16``: pre-derived raw id (callers that
+    generate ids pass it); None derives it from e.event_id."""
+    t_us = _us(e.event_time)
+    c_us = _us(e.creation_time)
+    orig_id = None
     if id16 is None:
         id16 = _id16(e.event_id)
         if id16.hex() != e.event_id:
-            extra["id"] = e.event_id
-    if not extra:
-        extra_b = b""
-    elif len(extra) == 1 and "p" in extra:
-        # the dominant live-lane shape: properties only
-        extra_b = b'{"p":' + json.dumps(
-            extra["p"], separators=(",", ":")
-        ).encode("utf-8") + b"}"
-    else:
-        extra_b = json.dumps(extra, separators=(",", ":")).encode("utf-8")
+            orig_id = e.event_id
+    extra_b = _extra_bytes(e, orig_id)
 
     ev = e.event.encode("utf-8")
     et = e.entity_type.encode("utf-8")
@@ -286,6 +364,19 @@ def _unpack_records(buf: bytes) -> List[Event]:
     return events
 
 
+def _decode_vocab(ptr, nbytes: int, offs_ptr, count: int) -> List[str]:
+    """Native dictionary -> vocabulary list: concatenated bytes + exact
+    prefix offsets (the separator-free layout of DictEncoder.dump; ids
+    may contain ANY byte). The ONE ctypes-side decoder, shared by the
+    columnar reads and the binned lane."""
+    if not count:
+        return []
+    raw = ctypes.string_at(ptr, nbytes)
+    offs = ctypes.cast(offs_ptr, ctypes.POINTER(ctypes.c_uint64))
+    return [raw[offs[i]:offs[i + 1]].decode("utf-8")
+            for i in range(count)]
+
+
 class JsonRowsUnsupported(Exception):
     """The JSON payload uses a construct the native fast lane does not
     handle (caller-stamped ids, exotic time formats, escaped property
@@ -352,21 +443,11 @@ class _ColumnarOut:
         free them (always frees, even when the copy raises)."""
         import numpy as np
 
-        u64p = ctypes.POINTER(ctypes.c_uint64)
-
         def arr(ptr, ctype, count, np_dtype):
             a = np.ctypeslib.as_array(
                 ctypes.cast(ptr, ctypes.POINTER(ctype)), shape=(count,)
             ).copy() if count else np.empty(0, np_dtype)
             return a.astype(np_dtype, copy=False)
-
-        def vocab(ptr, nbytes, offs_ptr, count):
-            if not count:
-                return []
-            raw = ctypes.string_at(ptr, nbytes)
-            offs = ctypes.cast(offs_ptr, u64p)
-            return [raw[offs[i]:offs[i + 1]].decode("utf-8")
-                    for i in range(count)]
 
         try:
             return S.EventColumns(
@@ -375,12 +456,12 @@ class _ColumnarOut:
                 name_codes=arr(self.nam, ctypes.c_int32, n, np.int32),
                 values=arr(self.val, ctypes.c_double, n, np.float64),
                 times_us=arr(self.tim, ctypes.c_int64, n, np.int64),
-                entity_vocab=vocab(self.ent_d, self.ent_db.value,
-                                   self.ent_o, self.n_ent.value),
-                target_vocab=vocab(self.tgt_d, self.tgt_db.value,
-                                   self.tgt_o, self.n_tgt.value),
-                names=vocab(self.nam_d, self.nam_db.value,
-                            self.nam_o, self.n_nam.value),
+                entity_vocab=_decode_vocab(self.ent_d, self.ent_db.value,
+                                           self.ent_o, self.n_ent.value),
+                target_vocab=_decode_vocab(self.tgt_d, self.tgt_db.value,
+                                           self.tgt_o, self.n_tgt.value),
+                names=_decode_vocab(self.nam_d, self.nam_db.value,
+                                    self.nam_o, self.n_nam.value),
             )
         finally:
             self.free()
@@ -446,28 +527,100 @@ class EventLogEventStore(S.EventStore):
         return self.insert_batch([event], app_id, channel_id)[0]
 
     def insert_batch(self, events, app_id, channel_id=None) -> List[str]:
+        """Row-lane bulk append, vectorized (the r03 30x gap fix): one
+        Python pass collects per-field byte streams, numpy assembles
+        the offset tables, and ONE native call (el_append_rows) packs
+        every wire record and appends under a single lock with the GIL
+        released — no per-row struct.pack, no per-row record join.
+        Freshness-clock and fingerprint semantics are identical to the
+        old per-row pack: ids minted here keep the lazy id index
+        (fresh), caller-stamped ids pay the dup check, and one
+        note_ingest covers the accepted batch."""
+        import numpy as np
+
         h = self._handle(app_id, channel_id)
+        events = list(events)
+        n = len(events)
+        if n == 0:
+            return []
+        rand = os.urandom(16 * n)
+        ids = bytearray(rand)
         out_ids: List[str] = []
-        parts: List[bytes] = []
         fresh = True  # every id generated right here -> lazy id index
-        for e in events:
+        times = np.empty(n, np.int64)
+        ctimes = np.empty(n, np.int64)
+        flags = bytearray(n)
+        ev_p: List[bytes] = []
+        et_p: List[bytes] = []
+        ei_p: List[bytes] = []
+        tt_p: List[bytes] = []
+        ti_p: List[bytes] = []
+        ex_p: List[bytes] = []
+        empty = b""
+        for i, e in enumerate(events):
+            orig_id = None
             if e.event_id:
                 fresh = False
+                id16 = _id16(e.event_id)
+                if id16.hex() != e.event_id:
+                    orig_id = e.event_id
+                ids[16 * i:16 * i + 16] = id16
                 out_ids.append(e.event_id)
-                parts.append(_pack(e))
             else:
-                id16 = os.urandom(16)
-                out_ids.append(id16.hex())
-                parts.append(_pack(e, id16))
-        buf = b"".join(parts)
-        n = self._lib.el_append_batch(h, buf, len(buf), 1 if fresh else 0)
-        if n != len(events):
-            raise S.StorageError(f"append failed ({n} of {len(events)} written)")
-        if out_ids:
-            # freshness clock: these rows now wait for a model publish
-            from predictionio_tpu.obs import perfacct
+                out_ids.append(rand[16 * i:16 * i + 16].hex())
+            times[i] = _us(e.event_time)
+            ctimes[i] = _us(e.creation_time)
+            ev_p.append(e.event.encode("utf-8"))
+            et_p.append(e.entity_type.encode("utf-8"))
+            ei_p.append(e.entity_id.encode("utf-8"))
+            f = 0
+            if e.target_entity_type is not None:
+                tt_p.append(e.target_entity_type.encode("utf-8"))
+                f |= 1
+            else:
+                tt_p.append(empty)
+            if e.target_entity_id is not None:
+                ti_p.append(e.target_entity_id.encode("utf-8"))
+                f |= 2
+            else:
+                ti_p.append(empty)
+            flags[i] = f
+            ex_p.append(_extra_bytes(e, orig_id))
 
-            perfacct.note_ingest()
+        def stream(parts):
+            offs = np.zeros(n + 1, np.uint64)
+            np.cumsum(np.fromiter(map(len, parts), np.uint64, count=n),
+                      out=offs[1:])
+            return b"".join(parts), offs
+
+        ev_b, ev_o = stream(ev_p)
+        et_b, et_o = stream(et_p)
+        ei_b, ei_o = stream(ei_p)
+        tt_b, tt_o = stream(tt_p)
+        ti_b, ti_o = stream(ti_p)
+        ex_b, ex_o = stream(ex_p)
+
+        def optr(a):
+            return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+        rc = self._lib.el_append_rows(
+            h, n, bytes(ids),
+            times.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ctimes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            bytes(flags),
+            ev_b, optr(ev_o), et_b, optr(et_o), ei_b, optr(ei_o),
+            tt_b, optr(tt_o), ti_b, optr(ti_o), ex_b, optr(ex_o),
+            1 if fresh else 0,
+        )
+        if rc == -2:
+            raise S.StorageError(
+                "a string field exceeds the 65534-byte wire-format limit")
+        if rc != n:
+            raise S.StorageError(f"append failed ({rc} of {n} written)")
+        # freshness clock: these rows now wait for a model publish
+        from predictionio_tpu.obs import perfacct
+
+        perfacct.note_ingest()
         return out_ids
 
     def insert_json_batch(
@@ -683,6 +836,136 @@ class EventLogEventStore(S.EventStore):
                 cols, shard_limit,
                 newest_first=bool(find_kwargs.get("reversed", False)))
         return cols
+
+    # -- fused zero-copy bin lane -------------------------------------------
+    _BIN_FILTERS = {
+        "start_time", "until_time", "entity_type", "entity_id",
+        "event_names", "target_entity_type", "target_entity_id",
+    }
+
+    def bin_columnar(
+        self,
+        app_id,
+        channel_id=None,
+        *,
+        value_property: Optional[str] = None,
+        overrides: Optional[Dict[str, float]] = None,
+        skip_mod: int = 0,
+        skip_rem: int = 0,
+        seg_len="auto",
+        max_len_user: Optional[int] = None,
+        max_len_item: Optional[int] = None,
+        n_shards: int = 1,
+        block_size: int = 4096,
+        row_cost_slots: float = 16.0,
+        **find_kwargs,
+    ) -> S.BinnedInteractions:
+        """The fused ingest->bin lane: ONE native call takes the mmap'd
+        log to both sides' device-ready compressed layouts (grouped by
+        entity and by target), with the GIL released for the whole
+        scan+bin. The returned arrays are ZERO-COPY views over aligned
+        native buffers — hand them to ``jax.device_put`` as-is; their
+        buffer objects anchor the allocation's lifetime.
+
+        ``overrides`` maps event names to constant ratings (the "buy
+        means 4.0" rule); other rows take ``value_property`` with
+        NaN -> 0.0. ``skip_mod``/``skip_rem`` hold out every row whose
+        kept-row ordinal % mod == rem as an evaluation COO (the bench's
+        5%% split). Rows without a target id are dropped
+        (read_interactions semantics). The layout is bit-identical to
+        ``compress_side(build_segmented_groups(...))`` over the same
+        COO — pinned by tests/test_bin_columnar.py."""
+        unknown = set(find_kwargs) - self._BIN_FILTERS
+        if unknown:
+            raise TypeError(
+                f"bin_columnar() got unexpected filters {sorted(unknown)}"
+            )
+        h = self._handle(app_id, channel_id)
+        req = self._build_req(
+            find_kwargs.get("start_time"), find_kwargs.get("until_time"),
+            find_kwargs.get("entity_type"), find_kwargs.get("entity_id"),
+            find_kwargs.get("event_names"),
+            find_kwargs.get("target_entity_type", S.UNSET),
+            find_kwargs.get("target_entity_id", S.UNSET),
+            None, False,
+        )
+        ov = dict(overrides or {})
+        ov_names = b"".join(k.encode("utf-8") + b"\0" for k in ov) or None
+        ov_vals = ((ctypes.c_double * len(ov))(*[float(v) for v in ov.values()])
+                   if ov else None)
+        if isinstance(seg_len, str):
+            if seg_len != "auto":
+                raise ValueError(
+                    f"seg_len must be an int or 'auto', got {seg_len!r}")
+            seg_len_i = -1
+        else:
+            seg_len_i = int(seg_len)
+        out = _BinColumnarOut()
+        n = self._lib.el_bin_columnar(
+            h, ctypes.byref(req),
+            value_property.encode() if value_property is not None else None,
+            ov_names, ov_vals, len(ov),
+            int(skip_mod), int(skip_rem),
+            seg_len_i,
+            -1 if max_len_user is None else int(max_len_user),
+            -1 if max_len_item is None else int(max_len_item),
+            int(n_shards), int(block_size), float(row_cost_slots),
+            ctypes.byref(out),
+        )
+        if n == -3:
+            raise ValueError(
+                "vocab exceeds the 24-bit index wire format (widen "
+                "idx_hi before raising this cap)")
+        if n < 0:
+            raise S.StorageError(
+                f"native columnar binning failed (rc {n})")
+
+        # one owner per independently-released allocation group: the
+        # SIDES are dropped by the trainer the moment the device owns
+        # the bytes (_note_transfer), while a HOLDOUT COO typically
+        # lives to the end of an evaluation — a shared owner would let
+        # the small holdout views pin the multi-hundred-MB side buffers
+        owner = native_mod.NativeOwner(self._lib.el_free, [])
+        hold_owner = native_mod.NativeOwner(self._lib.el_free, [])
+
+        def side(c: _CSide) -> S.BinnedSide:
+            return S.BinnedSide(**native_mod.unpack_cside(c, owner))
+
+        try:
+            user_side = side(out.user_side)
+            item_side = side(out.item_side)
+            ent_vocab = _decode_vocab(out.ent_dict, out.ent_dict_bytes,
+                                      out.ent_offsets, out.n_ent)
+            tgt_vocab = _decode_vocab(out.tgt_dict, out.tgt_dict_bytes,
+                                      out.tgt_offsets, out.n_tgt)
+            holdout = None
+            if out.n_hold:
+                import numpy as np
+
+                nh = out.n_hold
+                for p in (out.hold_u, out.hold_i, out.hold_v):
+                    hold_owner.add(p)
+                holdout = (
+                    native_mod.as_ndarray(out.hold_u, nh * 4, np.int32,
+                                          (nh,), hold_owner),
+                    native_mod.as_ndarray(out.hold_i, nh * 4, np.int32,
+                                          (nh,), hold_owner),
+                    native_mod.as_ndarray(out.hold_v, nh * 4, np.float32,
+                                          (nh,), hold_owner),
+                )
+        finally:
+            # vocab buffers are copied into Python strings above; free
+            # them now (the side/holdout buffers live via the owner)
+            for p in (out.ent_dict, out.ent_offsets,
+                      out.tgt_dict, out.tgt_offsets):
+                if p:
+                    self._lib.el_free(p)
+        return S.BinnedInteractions(
+            user_side=user_side, item_side=item_side,
+            entity_vocab=ent_vocab, target_vocab=tgt_vocab,
+            holdout=holdout, n_rows=int(n),
+            scan_sec=float(out.scan_sec), bin_sec=float(out.bin_sec),
+        )
 
     # -- streaming delta reads (ROADMAP item C) -----------------------------
     @staticmethod

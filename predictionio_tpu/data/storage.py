@@ -24,7 +24,7 @@ import logging
 import os
 import re
 import threading
-from typing import Any, Dict, Iterable, List, Optional, Sequence, TYPE_CHECKING
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, TYPE_CHECKING
 
 if TYPE_CHECKING:
     import numpy as np
@@ -94,6 +94,55 @@ class EventColumns:
 
     def __len__(self) -> int:
         return len(self.entity_codes)
+
+
+@dataclasses.dataclass
+class BinnedSide:
+    """One side of a device-ready binned layout (the zero-copy data
+    path): transfer-compressed segmented virtual rows as produced by
+    the native builder (eventlog.cpp el_bin_columnar / raggedbin.cpp
+    rb_bin_compressed) — identical in shape and bytes to what
+    ops/als.compress_side(ops/ragged.build_segmented_groups(...))
+    produces from the same COO. Arrays may be ZERO-COPY views over
+    native buffers (their buffer objects anchor the allocation's
+    lifetime — see native.as_ndarray)."""
+
+    idx_lo: "np.ndarray"            # [R, L] uint16
+    idx_hi: "Optional[np.ndarray]"  # [R, L] uint8, None when vocab < 2^16
+    val: "np.ndarray"               # [R, L] uint8 codes | float32
+    mask: "Optional[np.ndarray]"    # [R, L] uint8, None when val is coded
+    seg: "np.ndarray"               # [R] int32
+    counts: "np.ndarray"            # [G] int32
+    affine: Optional[Tuple[float, float]]
+    row_block: int
+    group_block: int
+    groups_per_shard: int
+    n_shards: int
+    n_groups: int                   # true group count (pre-padding)
+    kept_entries: int
+    kept_value_sum: float
+
+
+@dataclasses.dataclass
+class BinnedInteractions:
+    """Both sides of an interaction dataset, binned straight off the
+    event log by the native zero-copy lane — what `el_bin_columnar`
+    hands back: grouped-by-entity (user) and grouped-by-target (item)
+    compressed layouts, the id vocabularies, and (optionally) a
+    held-out COO split for evaluation. ``scan_sec``/``bin_sec`` are the
+    native call's own wall-time split (filter+encode+vocab vs
+    resolve+plan+fill), feeding the data-path ledger's read/bin
+    stages."""
+
+    user_side: BinnedSide
+    item_side: BinnedSide
+    entity_vocab: List[str]
+    target_vocab: List[str]
+    #: (user_idx int32, item_idx int32, values float32) or None
+    holdout: Optional[Tuple["np.ndarray", "np.ndarray", "np.ndarray"]]
+    n_rows: int
+    scan_sec: float
+    bin_sec: float
 
 
 def stable_hash(s: str) -> int:
@@ -278,6 +327,15 @@ def pack_vocab(vocab) -> tuple:
             out=offsets[1:],
         )
     return b"".join(bs), offsets
+
+
+def unpack_vocab(data, offsets) -> List[str]:
+    """Inverse of :func:`pack_vocab`: concatenated bytes (bytes or a
+    uint8 array) + prefix offsets -> the vocabulary list."""
+    raw = data.tobytes() if hasattr(data, "tobytes") else bytes(data)
+    offs = [int(o) for o in offsets]
+    return [raw[offs[i]:offs[i + 1]].decode("utf-8")
+            for i in range(len(offs) - 1)]
 
 
 def columns_to_npz(cols: EventColumns) -> bytes:

@@ -105,6 +105,44 @@ def find_columnar(
     )
 
 
+def supports_bin_columnar(
+    app_name: str,
+    channel_name: Optional[str] = None,
+    storage: Optional[Storage] = None,
+) -> bool:
+    """Whether the app's event store offers the fused native
+    ingest->bin lane (``bin_columnar`` — today only the eventlog
+    backend, and only when its C++ toolchain is available). Raises
+    StorageError for an unknown app/channel, exactly like every other
+    store entry point — callers probing capability fall back so the
+    read path raises the canonical error message."""
+    storage = storage or get_storage()
+    resolve_app(app_name, channel_name, storage)
+    if getattr(storage.events(), "bin_columnar", None) is None:
+        return False
+    from predictionio_tpu import native
+
+    return native.native_available("eventlog")
+
+
+def bin_columnar(
+    app_name: str,
+    channel_name: Optional[str] = None,
+    storage: Optional[Storage] = None,
+    **kwargs,
+):
+    """The zero-copy training read: ONE native call scans the mmap'd
+    log and bins BOTH sides into device-ready compressed layouts
+    (storage.BinnedInteractions) — no Event objects, no Python row
+    loop, no intermediate COO materialization. Callers must check
+    :func:`supports_bin_columnar` first (other backends fall back to
+    ``find_columnar`` + ops.ragged binning)."""
+    storage = storage or get_storage()
+    app_id, channel_id = resolve_app(app_name, channel_name, storage)
+    return storage.events().bin_columnar(app_id, channel_id=channel_id,
+                                         **kwargs)
+
+
 def data_fingerprint(
     app_name: str,
     channel_name: Optional[str] = None,

@@ -65,6 +65,11 @@ class TwoTowerAlgorithm(Algorithm):
 
     def train(self, ctx: MeshContext, pd: PreparedRatings) -> TwoTowerModel:
         p: TwoTowerParams = self.params
+        if pd.binned_request is not None:
+            # the zero-copy lane's deferred read is ALS-layout-shaped;
+            # this trainer consumes host COO — materialize it through
+            # the columnar fallback (same rows/codes/value resolution)
+            pd = pd.binned_request.read_prepared(pd.fingerprint)
         keep = pd.ratings >= p.min_rating
         u, i, r = pd.user_idx[keep], pd.item_idx[keep], pd.ratings[keep]
         if len(u) == 0:

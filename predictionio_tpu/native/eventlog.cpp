@@ -62,6 +62,8 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include "binlayout.h"
+
 namespace {
 
 constexpr uint32_t kHeaderLen = 46;  // bytes after record_len, before strings
@@ -652,6 +654,98 @@ int64_t finish_columns(
   *n_tgt = static_cast<int64_t>(tgts.order.size());
   *n_names = static_cast<int64_t>(names.order.size());
   return static_cast<int64_t>(ent_v.size());
+}
+
+// Fused filter + dict-encode scan in LOG order (no sort, each record
+// parsed exactly once), single- or multi-threaded — the shared body of
+// el_find_columnar's bulk fast path and el_bin_columnar. Caller must
+// hold a shared lock. ``want_times`` skips the per-row time vector
+// (the binning lane never reads it; at 20M rows that is 160 MB of
+// writes saved).
+void fused_scan(const Log* log, const FindReq* req, const char* value_prop,
+                bool want_times,
+                DictEncoder* ents, DictEncoder* tgts, DictEncoder* names,
+                std::vector<int32_t>* ent_v, std::vector<int32_t>* tgt_v,
+                std::vector<int32_t>* name_v, std::vector<double>* val_v,
+                std::vector<int64_t>* time_v) {
+  const double nan = std::numeric_limits<double>::quiet_NaN();
+  FilterCtx ctx = make_filter_ctx(req);
+  const uint64_t nrec = log->recs.size();
+  const unsigned nt = scan_thread_count(nrec);
+  if (nt <= 1) {
+    Header hd;
+    for (uint64_t i = 0; i < nrec; ++i) {
+      if (!match_rec(log, req, ctx, i, &hd)) continue;
+      ent_v->push_back(ents->encode(hd.eid, hd.len_eid));
+      tgt_v->push_back(hd.tid ? tgts->encode(hd.tid, hd.len_tid) : -1);
+      name_v->push_back(names->encode(hd.event, hd.len_event));
+      if (want_times) time_v->push_back(hd.time_us);
+      val_v->push_back(value_prop ? header_value(hd, value_prop) : nan);
+    }
+    return;
+  }
+  // parallel fused scan: workers filter+encode contiguous record
+  // ranges with LOCAL dictionaries (mmap/recs/by_id are read-only
+  // under the shared lock), then ranges merge in order. Every
+  // range-r global-first-seen id precedes every range-(r+1) one,
+  // and within a range local first-seen order IS record order, so
+  // the merged code assignment is byte-identical to the
+  // sequential scan's.
+  struct ColPart {
+    DictEncoder ents, tgts, names;
+    std::vector<int32_t> ent, tgt, name;
+    std::vector<double> val;
+    std::vector<int64_t> time;
+  };
+  std::vector<ColPart> parts(nt);
+  std::vector<std::thread> workers;
+  workers.reserve(nt);
+  for (unsigned t = 0; t < nt; ++t) {
+    const uint64_t lo = nrec * t / nt, hi = nrec * (t + 1) / nt;
+    workers.emplace_back([&, t, lo, hi]() {
+      ColPart& p = parts[t];
+      Header hd;
+      for (uint64_t i = lo; i < hi; ++i) {
+        if (!match_rec(log, req, ctx, i, &hd)) continue;
+        p.ent.push_back(p.ents.encode(hd.eid, hd.len_eid));
+        p.tgt.push_back(hd.tid ? p.tgts.encode(hd.tid, hd.len_tid) : -1);
+        p.name.push_back(p.names.encode(hd.event, hd.len_event));
+        if (want_times) p.time.push_back(hd.time_us);
+        p.val.push_back(value_prop ? header_value(hd, value_prop) : nan);
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+  uint64_t total = 0;
+  for (const auto& p : parts) total += p.ent.size();
+  ent_v->reserve(total);
+  tgt_v->reserve(total);
+  name_v->reserve(total);
+  val_v->reserve(total);
+  if (want_times) time_v->reserve(total);
+  auto remap = [](DictEncoder& global, const DictEncoder& local) {
+    std::vector<int32_t> table(local.order.size());
+    for (size_t i = 0; i < local.order.size(); ++i) {
+      const std::string_view& sv = local.order[i];
+      table[i] = global.encode(
+          reinterpret_cast<const uint8_t*>(sv.data()),
+          static_cast<uint32_t>(sv.size()));
+    }
+    return table;
+  };
+  for (const auto& p : parts) {
+    const std::vector<int32_t> ent_map = remap(*ents, p.ents);
+    const std::vector<int32_t> tgt_map = remap(*tgts, p.tgts);
+    const std::vector<int32_t> name_map = remap(*names, p.names);
+    for (size_t i = 0; i < p.ent.size(); ++i) {
+      ent_v->push_back(ent_map[p.ent[i]]);
+      tgt_v->push_back(p.tgt[i] >= 0 ? tgt_map[p.tgt[i]] : -1);
+      name_v->push_back(name_map[p.name[i]]);
+    }
+    val_v->insert(val_v->end(), p.val.begin(), p.val.end());
+    if (want_times)
+      time_v->insert(time_v->end(), p.time.begin(), p.time.end());
+  }
 }
 
 // ---------------------------------------------------------------------------
@@ -1863,6 +1957,83 @@ int64_t el_append_json(void* h, const uint8_t* body, uint64_t nbytes,
   return n_valid;
 }
 
+// Vectorized row-lane append — the native bulk call behind
+// EventLogEventStore.insert_batch's fast lane. The Python side hands
+// over COLUMN streams (per-field concatenated bytes + exact prefix
+// offsets, times as int64 arrays, presence flags, ids as n*16 raw
+// bytes) assembled with numpy/bytes-join at C speed; this call packs
+// every wire record and appends them under ONE lock + (optional) one
+// fsync with the GIL released — replacing the per-row struct.pack +
+// join Python loop that made insert_batch ~30x slower than the
+// columnar bulk lane (r03).
+//
+// ``flags`` bit0 = has targetEntityType, bit1 = has targetEntityId.
+// Returns rows appended, -1 on I/O error, -2 when a string field
+// exceeds the u16 wire limit (the caller maps it to the same error
+// the struct.pack('H') overflow used to raise).
+int64_t el_append_rows(
+    void* h, int64_t n, const uint8_t* ids,
+    const int64_t* times_us, const int64_t* ctimes_us,
+    const uint8_t* flags,
+    const uint8_t* ev_b, const uint64_t* ev_off,
+    const uint8_t* et_b, const uint64_t* et_off,
+    const uint8_t* ei_b, const uint64_t* ei_off,
+    const uint8_t* tt_b, const uint64_t* tt_off,
+    const uint8_t* ti_b, const uint64_t* ti_off,
+    const uint8_t* ex_b, const uint64_t* ex_off,
+    int32_t fresh_ids) {
+  Log* log = static_cast<Log*>(h);
+  uint64_t total = 0;
+  for (int64_t r = 0; r < n; ++r) {
+    uint64_t l_ev = ev_off[r + 1] - ev_off[r];
+    uint64_t l_et = et_off[r + 1] - et_off[r];
+    uint64_t l_ei = ei_off[r + 1] - ei_off[r];
+    bool has_tt = flags[r] & 1, has_ti = flags[r] & 2;
+    uint64_t l_tt = has_tt ? tt_off[r + 1] - tt_off[r] : 0;
+    uint64_t l_ti = has_ti ? ti_off[r + 1] - ti_off[r] : 0;
+    uint64_t l_ex = ex_off[r + 1] - ex_off[r];
+    if (l_ev >= kAbsent || l_et >= kAbsent || l_ei >= kAbsent ||
+        l_tt >= kAbsent || l_ti >= kAbsent || l_ex >= (1ULL << 32))
+      return -2;
+    total += 4 + kHeaderLen + l_ev + l_et + l_ei + l_tt + l_ti + l_ex;
+  }
+  std::vector<uint8_t> buf(total);
+  uint8_t* p = buf.data();
+  for (int64_t r = 0; r < n; ++r) {
+    uint32_t l_ev = static_cast<uint32_t>(ev_off[r + 1] - ev_off[r]);
+    uint32_t l_et = static_cast<uint32_t>(et_off[r + 1] - et_off[r]);
+    uint32_t l_ei = static_cast<uint32_t>(ei_off[r + 1] - ei_off[r]);
+    bool has_tt = flags[r] & 1, has_ti = flags[r] & 2;
+    uint32_t l_tt = has_tt ? static_cast<uint32_t>(tt_off[r + 1] - tt_off[r]) : 0;
+    uint32_t l_ti = has_ti ? static_cast<uint32_t>(ti_off[r + 1] - ti_off[r]) : 0;
+    uint32_t l_ex = static_cast<uint32_t>(ex_off[r + 1] - ex_off[r]);
+    uint32_t rec_len = kHeaderLen + l_ev + l_et + l_ei + l_tt + l_ti + l_ex;
+    memcpy(p, &rec_len, 4);
+    p += 4;
+    memcpy(p, ids + r * 16, 16);
+    memcpy(p + 16, &times_us[r], 8);
+    memcpy(p + 24, &ctimes_us[r], 8);
+    uint16_t u16;
+    u16 = static_cast<uint16_t>(l_ev); memcpy(p + 32, &u16, 2);
+    u16 = static_cast<uint16_t>(l_et); memcpy(p + 34, &u16, 2);
+    u16 = static_cast<uint16_t>(l_ei); memcpy(p + 36, &u16, 2);
+    u16 = has_tt ? static_cast<uint16_t>(l_tt) : kAbsent;
+    memcpy(p + 38, &u16, 2);
+    u16 = has_ti ? static_cast<uint16_t>(l_ti) : kAbsent;
+    memcpy(p + 40, &u16, 2);
+    memcpy(p + 42, &l_ex, 4);
+    uint8_t* s = p + kHeaderLen;
+    memcpy(s, ev_b + ev_off[r], l_ev); s += l_ev;
+    memcpy(s, et_b + et_off[r], l_et); s += l_et;
+    memcpy(s, ei_b + ei_off[r], l_ei); s += l_ei;
+    if (has_tt) { memcpy(s, tt_b + tt_off[r], l_tt); s += l_tt; }
+    if (has_ti) { memcpy(s, ti_b + ti_off[r], l_ti); s += l_ti; }
+    if (l_ex) memcpy(s, ex_b + ex_off[r], l_ex);
+    p += rec_len;
+  }
+  return append_packed(log, buf.data(), total, n, fresh_ids != 0);
+}
+
 // O(1) content fingerprint of the log: (generation, log bytes, record
 // count, tombstone count). An append-only log + monotonically renamed
 // compaction generations means this quadruple changes whenever the
@@ -2005,78 +2176,10 @@ int64_t el_find_columnar(
   } else {
     // fused fast path (bulk training reads): filter + encode in ONE
     // pass, records in log order, no sort — a 20M-row scan parses each
-    // record exactly once
-    FilterCtx ctx = make_filter_ctx(req);
-    const uint64_t nrec = log->recs.size();
-    const unsigned nt = scan_thread_count(nrec);
-    if (nt <= 1) {
-      Header hd;
-      for (uint64_t i = 0; i < nrec; ++i) {
-        if (match_rec(log, req, ctx, i, &hd)) emit(hd);
-      }
-    } else {
-      // parallel fused scan: workers filter+encode contiguous record
-      // ranges with LOCAL dictionaries (mmap/recs/by_id are read-only
-      // under the shared lock), then ranges merge in order. Every
-      // range-r global-first-seen id precedes every range-(r+1) one,
-      // and within a range local first-seen order IS record order, so
-      // the merged code assignment is byte-identical to the
-      // sequential scan's.
-      struct ColPart {
-        DictEncoder ents, tgts, names;
-        std::vector<int32_t> ent, tgt, name;
-        std::vector<double> val;
-        std::vector<int64_t> time;
-      };
-      std::vector<ColPart> parts(nt);
-      std::vector<std::thread> workers;
-      workers.reserve(nt);
-      for (unsigned t = 0; t < nt; ++t) {
-        const uint64_t lo = nrec * t / nt, hi = nrec * (t + 1) / nt;
-        workers.emplace_back([&, t, lo, hi]() {
-          ColPart& p = parts[t];
-          Header hd;
-          for (uint64_t i = lo; i < hi; ++i) {
-            if (!match_rec(log, req, ctx, i, &hd)) continue;
-            p.ent.push_back(p.ents.encode(hd.eid, hd.len_eid));
-            p.tgt.push_back(hd.tid ? p.tgts.encode(hd.tid, hd.len_tid) : -1);
-            p.name.push_back(p.names.encode(hd.event, hd.len_event));
-            p.time.push_back(hd.time_us);
-            p.val.push_back(value_prop ? header_value(hd, value_prop) : nan);
-          }
-        });
-      }
-      for (auto& w : workers) w.join();
-      uint64_t total = 0;
-      for (const auto& p : parts) total += p.ent.size();
-      ent_v.reserve(total);
-      tgt_v.reserve(total);
-      name_v.reserve(total);
-      val_v.reserve(total);
-      time_v.reserve(total);
-      auto remap = [](DictEncoder& global, const DictEncoder& local) {
-        std::vector<int32_t> table(local.order.size());
-        for (size_t i = 0; i < local.order.size(); ++i) {
-          const std::string_view& sv = local.order[i];
-          table[i] = global.encode(
-              reinterpret_cast<const uint8_t*>(sv.data()),
-              static_cast<uint32_t>(sv.size()));
-        }
-        return table;
-      };
-      for (const auto& p : parts) {
-        const std::vector<int32_t> ent_map = remap(ents, p.ents);
-        const std::vector<int32_t> tgt_map = remap(tgts, p.tgts);
-        const std::vector<int32_t> name_map = remap(names, p.names);
-        for (size_t i = 0; i < p.ent.size(); ++i) {
-          ent_v.push_back(ent_map[p.ent[i]]);
-          tgt_v.push_back(p.tgt[i] >= 0 ? tgt_map[p.tgt[i]] : -1);
-          name_v.push_back(name_map[p.name[i]]);
-        }
-        val_v.insert(val_v.end(), p.val.begin(), p.val.end());
-        time_v.insert(time_v.end(), p.time.begin(), p.time.end());
-      }
-    }
+    // record exactly once (single- or multi-threaded, see fused_scan)
+    fused_scan(log, req, value_prop, /*want_times=*/true,
+               &ents, &tgts, &names,
+               &ent_v, &tgt_v, &name_v, &val_v, &time_v);
   }
 
   return finish_columns(
@@ -2264,6 +2367,221 @@ int64_t el_append_columnar(
   }
   // records were built here (fresh ids) — no validation pass, lazy id index
   return append_packed(log, buf.data(), buf.size(), n, /*fresh_ids=*/true);
+}
+
+namespace {
+
+double mono_sec() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<double>(ts.tv_sec) + ts.tv_nsec * 1e-9;
+}
+
+}  // namespace
+
+// Out-params of el_bin_columnar (mirrored by a ctypes Structure in the
+// Python binding — every field is 8 bytes, so the layout is
+// padding-free). All pointers are malloc'd/aligned outputs the caller
+// frees via el_free; zeroed on entry and on error.
+struct BinColumnarOut {
+  binlayout::CSide user_side;   // grouped by entity id
+  binlayout::CSide item_side;   // grouped by target id
+  uint8_t* ent_dict;            // concatenated entity-id bytes
+  uint64_t* ent_offsets;        // n_ent + 1 exact prefix offsets
+  uint8_t* tgt_dict;
+  uint64_t* tgt_offsets;
+  int32_t* hold_u;              // held-out COO (skip_mod rows)
+  int32_t* hold_i;
+  float* hold_v;
+  uint64_t ent_dict_bytes;
+  uint64_t tgt_dict_bytes;
+  int64_t n_ent;
+  int64_t n_tgt;
+  int64_t n_hold;
+  int64_t n_rows;               // kept (binned) interaction rows
+  double scan_sec;              // filter+encode+vocab-dump wall time
+  double bin_sec;               // value-resolve + plan + fill wall time
+};
+
+static void free_bin_columnar(BinColumnarOut* out) {
+  binlayout::SideOut u{out->user_side.idx_lo, out->user_side.idx_hi,
+                       out->user_side.val_u8, out->user_side.val_f32,
+                       out->user_side.mask, out->user_side.seg,
+                       out->user_side.counts};
+  u.free_all();
+  binlayout::SideOut i{out->item_side.idx_lo, out->item_side.idx_hi,
+                       out->item_side.val_u8, out->item_side.val_f32,
+                       out->item_side.mask, out->item_side.seg,
+                       out->item_side.counts};
+  i.free_all();
+  free(out->ent_dict); free(out->ent_offsets);
+  free(out->tgt_dict); free(out->tgt_offsets);
+  free(out->hold_u); free(out->hold_i); free(out->hold_v);
+  memset(out, 0, sizeof(*out));
+}
+
+// The fused ingest->bin lane (zero-copy data path): ONE call takes the
+// mmap'd log to both sides' device-ready compressed layouts.
+//
+//   scan     fused filter + dict-encode in log order (the same code
+//            path el_find_columnar's bulk reads use), vocabularies
+//            dumped under the shared lock
+//   resolve  per-row float32 value: per-event-name overrides (the
+//            "buy means rating 4.0" rule, resolved against the name
+//            dictionary), NaN -> 0.0 otherwise — exactly the Python
+//            template's nan_to_num + np.where
+//   filter   rows without a target id are dropped (read_interactions
+//            semantics); ``skip_mod > 0`` holds OUT every row whose
+//            kept-ordinal % skip_mod == skip_rem (the bench's 5%
+//            held-out split) and returns those as COO for evaluation
+//   bin      binlayout plan + single-pass compressed fill per side
+//            (group axis = entity for user_side, target for
+//            item_side), outside the lock so a 20M-row bin never
+//            blocks writers
+//
+// No per-row Python objects, no intermediate f32 val/mask arrays, no
+// Event materialization anywhere. Returns kept row count, or -1
+// (error/bad index), -2 (allocation), -3 (>24-bit index). seg_len -1 =
+// auto; max_len_* -1 = uncapped.
+int64_t el_bin_columnar(
+    void* h, const FindReq* req, const char* value_prop,
+    const char* override_names, const double* override_values,
+    int32_t n_overrides, int64_t skip_mod, int64_t skip_rem,
+    int64_t seg_len, int64_t max_len_user, int64_t max_len_item,
+    int64_t n_shards, int64_t block_size, double row_cost_slots,
+    BinColumnarOut* out) {
+  Log* log = static_cast<Log*>(h);
+  memset(out, 0, sizeof(*out));
+  double t0 = mono_sec();
+  ensure_index_for_scan(log);
+
+  std::vector<int32_t> ent_v, tgt_v, name_v;
+  std::vector<double> val_v;
+  std::vector<int64_t> time_v;  // unused (want_times=false)
+  std::vector<double> override_by_code;
+  int64_t n_ent = 0, n_tgt = 0;
+  {
+    std::shared_lock lk(log->mu);
+    if (log->broken) return -1;
+    DictEncoder ents, tgts, names;
+    ents.codes.reserve(1 << 16);
+    tgts.codes.reserve(1 << 16);
+    fused_scan(log, req, value_prop, /*want_times=*/false,
+               &ents, &tgts, &names,
+               &ent_v, &tgt_v, &name_v, &val_v, &time_v);
+    // vocabularies + override resolution must happen under the lock:
+    // the encoders key string_views into the mmap'd log
+    out->ent_dict = ents.dump(&out->ent_dict_bytes, &out->ent_offsets);
+    out->tgt_dict = tgts.dump(&out->tgt_dict_bytes, &out->tgt_offsets);
+    if (!out->ent_dict || !out->tgt_dict) {
+      free_bin_columnar(out);
+      return -2;
+    }
+    n_ent = static_cast<int64_t>(ents.order.size());
+    n_tgt = static_cast<int64_t>(tgts.order.size());
+    const double nan = std::numeric_limits<double>::quiet_NaN();
+    override_by_code.assign(names.order.size(), nan);
+    const char* p = override_names;
+    for (int32_t i = 0; i < n_overrides; ++i) {
+      size_t l = strlen(p);
+      auto it = names.codes.find(std::string_view(p, l));
+      if (it != names.codes.end()) override_by_code[it->second] = override_values[i];
+      p += l + 1;
+    }
+  }
+  out->n_ent = n_ent;
+  out->n_tgt = n_tgt;
+  out->scan_sec = mono_sec() - t0;
+  t0 = mono_sec();
+
+  // resolve + filter into the kept COO (and the held-out COO)
+  const int64_t n_scanned = static_cast<int64_t>(ent_v.size());
+  std::vector<int32_t> u_codes, i_codes;
+  std::vector<float> vals;
+  u_codes.reserve(n_scanned);
+  i_codes.reserve(n_scanned);
+  vals.reserve(n_scanned);
+  std::vector<int32_t> hold_u, hold_i;
+  std::vector<float> hold_v;
+  int64_t ordinal = 0;
+  for (int64_t k = 0; k < n_scanned; ++k) {
+    int32_t tc = tgt_v[k];
+    if (tc < 0) continue;  // read_interactions drops target-less rows
+    double ov = override_by_code.empty()
+                    ? std::numeric_limits<double>::quiet_NaN()
+                    : override_by_code[name_v[k]];
+    float v;
+    if (ov == ov) {
+      v = static_cast<float>(ov);
+    } else {
+      double raw = val_v[k];
+      v = raw == raw ? static_cast<float>(raw) : 0.0f;  // nan_to_num
+    }
+    bool held = skip_mod > 0 && (ordinal % skip_mod) == skip_rem;
+    ++ordinal;
+    if (held) {
+      hold_u.push_back(ent_v[k]);
+      hold_i.push_back(tc);
+      hold_v.push_back(v);
+    } else {
+      u_codes.push_back(ent_v[k]);
+      i_codes.push_back(tc);
+      vals.push_back(v);
+    }
+  }
+  // release the scan vectors before the fill allocates its buffers
+  ent_v.clear(); ent_v.shrink_to_fit();
+  tgt_v.clear(); tgt_v.shrink_to_fit();
+  name_v.clear(); name_v.shrink_to_fit();
+  val_v.clear(); val_v.shrink_to_fit();
+
+  const int64_t nnz = static_cast<int64_t>(u_codes.size());
+  auto bin_side = [&](const std::vector<int32_t>& grp,
+                      const std::vector<int32_t>& itm, int64_t n_groups,
+                      int64_t max_len, binlayout::CSide* side) -> int {
+    std::vector<int64_t> counts(n_groups, 0);
+    for (int64_t k = 0; k < nnz; ++k) {
+      if (grp[k] < 0 || grp[k] >= n_groups) return -1;
+      ++counts[grp[k]];
+    }
+    binlayout::SidePlan plan;
+    binlayout::plan_segmented(std::move(counts), n_groups, seg_len,
+                              max_len, n_shards, block_size,
+                              row_cost_slots, &plan);
+    binlayout::SideOut so;
+    int rc = binlayout::fill_compressed(
+        grp.data(), itm.data(), vals.data(), nnz, plan, &so);
+    if (rc != 0) {
+      so.free_all();
+      return rc;
+    }
+    binlayout::export_side(plan, &so, side);
+    return 0;
+  };
+  int rc = bin_side(u_codes, i_codes, n_ent, max_len_user, &out->user_side);
+  if (rc == 0)
+    rc = bin_side(i_codes, u_codes, n_tgt, max_len_item, &out->item_side);
+  if (rc != 0) {
+    free_bin_columnar(out);
+    return rc == -1 ? -1 : rc;
+  }
+
+  if (!hold_u.empty()) {
+    out->hold_u = static_cast<int32_t*>(malloc(hold_u.size() * 4));
+    out->hold_i = static_cast<int32_t*>(malloc(hold_i.size() * 4));
+    out->hold_v = static_cast<float*>(malloc(hold_v.size() * 4));
+    if (!out->hold_u || !out->hold_i || !out->hold_v) {
+      free_bin_columnar(out);
+      return -2;
+    }
+    memcpy(out->hold_u, hold_u.data(), hold_u.size() * 4);
+    memcpy(out->hold_i, hold_i.data(), hold_i.size() * 4);
+    memcpy(out->hold_v, hold_v.data(), hold_v.size() * 4);
+  }
+  out->n_hold = static_cast<int64_t>(hold_u.size());
+  out->n_rows = nnz;
+  out->bin_sec = mono_sec() - t0;
+  return nnz;
 }
 
 // Compaction: rewrite the log keeping only LIVE records (drops

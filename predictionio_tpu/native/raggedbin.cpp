@@ -24,6 +24,8 @@
 #include <cstring>
 #include <vector>
 
+#include "binlayout.h"
+
 extern "C" {
 
 // Fill segmented virtual rows (SegmentedGroups layout, ragged.py):
@@ -87,6 +89,48 @@ int rb_fill_padded(
     val_out[at] = values[k];
     mask_out[at] = 1.0f;
   }
+  return 0;
+}
+
+void rb_free(void* p) { free(p); }
+
+// Single-pass COO -> transfer-compressed segmented layout: plans the
+// blocks/padding (binlayout.h — the one port of the Python layout
+// math), then fills the WIRE streams directly (uint16 idx_lo [+ uint8
+// idx_hi], uint8 affine value codes or f32+mask, int32 seg/counts)
+// into 64-byte-aligned buffers. Replaces the old two-stage
+// build_segmented_groups -> compress_side pipeline, which materialized
+// [R, L] float32 val + mask + int32 idx (12-16 B/slot) only to
+// re-scan them down to 3-4 B/slot (np.unique + searchsorted + bit
+// splits over 20M+ elements).
+//
+// ``seg_len`` -1 = auto (size from the group-size histogram);
+// ``max_len`` -1 = uncapped. Returns 0 ok, -1 index out of range,
+// -2 allocation failure, -3 item index exceeds the 24-bit wire
+// format. Buffers in *out are caller-owned (rb_free each).
+int rb_bin_compressed(
+    const int64_t* group_idx, const int64_t* item_idx, const float* values,
+    int64_t nnz, int64_t n_groups,
+    int64_t seg_len, int64_t max_len, int64_t n_shards, int64_t block_size,
+    double row_cost_slots, binlayout::CSide* out) {
+  memset(out, 0, sizeof(*out));
+  std::vector<int64_t> counts(n_groups, 0);
+  for (int64_t k = 0; k < nnz; ++k) {
+    int64_t g = group_idx[k];
+    if (g < 0 || g >= n_groups) return -1;
+    ++counts[g];
+  }
+  binlayout::SidePlan plan;
+  binlayout::plan_segmented(std::move(counts), n_groups, seg_len, max_len,
+                            n_shards, block_size, row_cost_slots, &plan);
+  binlayout::SideOut side;
+  int rc = binlayout::fill_compressed(group_idx, item_idx, values, nnz,
+                                      plan, &side);
+  if (rc != 0) {
+    side.free_all();
+    return rc;
+  }
+  binlayout::export_side(plan, &side, out);
   return 0;
 }
 

@@ -25,8 +25,10 @@ them continuous:
 
   Data-path ledger (:data:`LEDGER`)
     Wall-time per stage of the events->model pipeline (read / prepare /
-    fit / train / bin-cache / compile), recorded by core/engine.py,
-    workflow/train.py, ops/bincache.py and ops/als.py into a bounded
+    bin / transfer / fit / train / bin-cache / compile), recorded by
+    core/engine.py, workflow/train.py, ops/bincache.py and ops/als.py
+    (the zero-copy lane splits its one native call into read=scan and
+    bin=fill, and the transfer watcher times the H2D window) into a bounded
     per-run history plus ``pio_datapath_stage_seconds{stage=}``, and
     the freshness gauge ROADMAP item C will gate on:
 
@@ -188,8 +190,11 @@ _MODEL_STALENESS = metrics.gauge(
 _DATAPATH_STAGE_SECONDS = metrics.gauge(
     "pio_datapath_stage_seconds",
     "Wall seconds the current/last training run spent per "
-    "events->model pipeline stage (read / prepare / fit / train / "
-    "bin_cache_load / bin_cache_save / compile)",
+    "events->model pipeline stage (read / prepare / bin / transfer / "
+    "fit / train / bin_cache_load / bin_cache_save / compile). The "
+    "zero-copy lane reports read = the native scan share, bin = the "
+    "native resolve+plan+fill share, transfer = the host->device wire "
+    "window (put dispatch -> confirmed resident)",
     ("stage",),
 )
 

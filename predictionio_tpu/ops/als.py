@@ -30,6 +30,9 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
+import os
+import threading
 import time
 from typing import List, Optional, Tuple
 
@@ -39,6 +42,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from predictionio_tpu.ops.ragged import SegmentedGroups, build_segmented_groups
+
+log = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -98,6 +103,18 @@ class ALSConfig:
     # loads per slot than the hardware gather XLA emits. Removed.
 
 
+def als_row_cost_slots(rank: int) -> float:
+    """Per-row overhead in equivalent slots for the auto seg-len sweep:
+    the [rows, K, K] partial-Gramian HBM round trip relative to the
+    per-slot gather cost. The ONE copy — this number shapes the
+    PHYSICAL layout (it drives auto seg_len), and the binned-layout
+    cache key covers it only through ``rank``, so every lane (trainer,
+    binned fit lane, bench) must derive it from rank the same way or
+    a shared cache entry would carry a different geometry than the
+    requesting lane would build."""
+    return max(8.0, rank * rank / 300.0)
+
+
 def _build_side(
     group_idx: np.ndarray,
     item_idx: np.ndarray,
@@ -112,9 +129,7 @@ def _build_side(
     return build_segmented_groups(
         group_idx, item_idx, vals, n_groups, seg_len=cfg.seg_len,
         max_len=max_len, n_shards=n_shards, block_size=cfg.block_size,
-        # per-row overhead in equivalent slots: the [rows, K, K] partial
-        # HBM round trip relative to the per-slot gather cost
-        row_cost_slots=max(8.0, cfg.rank * cfg.rank / 300.0),
+        row_cost_slots=als_row_cost_slots(cfg.rank),
     )
 
 
@@ -613,6 +628,105 @@ def compress_side(sg: SegmentedGroups, n_opposing: int) -> SideLayout:
         groups_per_shard=sg.groups_per_shard, n_shards=sg.n_shards)
 
 
+def side_layout_from_binned(bs) -> "SideLayout":
+    """``data.storage.BinnedSide`` (the native zero-copy builders'
+    product) -> the trainer's SideLayout — same arrays, no copies."""
+    return SideLayout(
+        idx_lo=bs.idx_lo, idx_hi=bs.idx_hi, val=bs.val, mask=bs.mask,
+        seg=bs.seg, counts=bs.counts,
+        affine=tuple(bs.affine) if bs.affine is not None else None,
+        row_block=bs.row_block, group_block=bs.group_block,
+        groups_per_shard=bs.groups_per_shard, n_shards=bs.n_shards)
+
+
+def build_compressed_side(
+    group_idx: np.ndarray,
+    item_idx: np.ndarray,
+    vals: np.ndarray,
+    n_groups: int,
+    cfg: ALSConfig,
+    n_shards: int,
+    max_len: Optional[int],
+) -> "SideLayout":
+    """One side's compressed device layout from COO, in ONE native pass
+    when available (ragged.build_compressed_segmented: plan + wire-
+    stream fill with no [R, L] f32 val/mask intermediates), else the
+    two-stage Python reference (build_segmented_groups +
+    compress_side). Both produce bit-identical layouts — pinned by
+    tests/test_bin_columnar.py."""
+    from predictionio_tpu.ops import ragged as ragged_mod
+
+    try:
+        bs = ragged_mod.build_compressed_segmented(
+            group_idx, item_idx, vals, n_groups, seg_len=cfg.seg_len,
+            max_len=max_len, n_shards=n_shards, block_size=cfg.block_size,
+            row_cost_slots=als_row_cost_slots(cfg.rank))
+    except MemoryError as e:
+        log.warning("native compressed binning failed (%s) — falling "
+                    "back to the two-stage path", e)
+        bs = None
+    if bs is not None:
+        return side_layout_from_binned(bs)
+    sg = _build_side(group_idx, item_idx, vals, n_groups, cfg, n_shards,
+                     max_len)
+    return compress_side(sg, 0)
+
+
+#: default H2D chunk for the double-buffered transfer pipeline (MB);
+#: PIO_BIN_CHUNK_MB overrides, PIO_TRANSFER_DOUBLE_BUFFER=0 restores
+#: the single-shot put per array
+_DEFAULT_CHUNK_MB = 64.0
+
+
+@functools.lru_cache(maxsize=32)
+def _chunk_concat_fn(n_chunks: int):
+    """Device-side concat of n row-chunks, compiled once per chunk
+    count (then per shape set via the jit cache; the persistent compile
+    cache absorbs it across processes). The chunk buffers are transfer
+    temporaries nothing else reads, but concatenate cannot alias its
+    inputs into the (larger) output, so donating them only produces
+    XLA's donated-buffer-unusable warning — they are instead freed
+    naturally right after the concat consumes them."""
+    del n_chunks  # keying arg: one cached jit wrapper per chunk count
+    return jax.jit(lambda *xs: jnp.concatenate(xs, axis=0))
+
+
+def _chunked_device_put(a: np.ndarray, chunk_bytes: int):
+    """Chunked, double-buffered host->device put: row-slices of the
+    (C-contiguous) host array are dispatched as independent async
+    device_puts and concatenated ON DEVICE. While chunk N's bytes cross
+    the wire, chunk N+1 is being serialized/paged-in on the host — on
+    the warm lane the source is an mmap'd cache file, so the OS read of
+    chunk N+1 overlaps chunk N's transfer instead of serializing in
+    front of it. Small arrays keep the one-shot put."""
+    if a.ndim == 0 or a.shape[0] < 2 or a.nbytes <= chunk_bytes:
+        return jnp.asarray(a)
+    per_row = max(1, a.nbytes // a.shape[0])
+    rows = max(1, chunk_bytes // per_row)
+    chunks = [jax.device_put(a[s:s + rows])
+              for s in range(0, a.shape[0], rows)]
+    if len(chunks) == 1:
+        return chunks[0]
+    return _chunk_concat_fn(len(chunks))(*chunks)
+
+
+def layout_cache_key(cache_key: str, cfg: ALSConfig, n_shards: int,
+                     max_ratings_per_user: Optional[int] = None,
+                     max_ratings_per_item: Optional[int] = None) -> str:
+    """The ONE bincache key derivation for ALS segmented layouts —
+    shared by ALSTrainer's internal COO-path cache, the zero-copy
+    binned lane (models/als._train_binned) and the bench's warm stage,
+    so an entry written by any lane serves the others (the layouts are
+    bit-identical by construction)."""
+    from predictionio_tpu.ops import bincache
+
+    return bincache.layout_key(
+        cache_key, "als-segmented",
+        {"seg_len": cfg.seg_len, "block_size": cfg.block_size,
+         "rank": cfg.rank, "n_shards": n_shards,
+         "max_u": max_ratings_per_user, "max_i": max_ratings_per_item})
+
+
 class LayoutCacheMiss(LookupError):
     """No cached layout for the key (caller falls back to the read path)."""
 
@@ -666,11 +780,9 @@ class ALSTrainer:
         if cache_key is not None:
             from predictionio_tpu.ops import bincache
 
-            full_key = bincache.layout_key(
-                cache_key, "als-segmented",
-                {"seg_len": cfg.seg_len, "block_size": cfg.block_size,
-                 "rank": cfg.rank, "n_shards": n_shards,
-                 "max_u": max_ratings_per_user, "max_i": max_ratings_per_item})
+            full_key = layout_cache_key(
+                cache_key, cfg, n_shards, max_ratings_per_user,
+                max_ratings_per_item)
             cached = bincache.load(full_key)
             if cached is not None:
                 arrays, meta = cached
@@ -696,17 +808,21 @@ class ALSTrainer:
             # the dominant one-time cost, and this hides the second
             # side's host binning underneath the first side's bytes in
             # flight
-            by_user = _build_side(
+            t_bin = time.perf_counter()
+            user_side = build_compressed_side(
                 u_idx, i_idx, vals, n_users, cfg, n_shards,
                 max_ratings_per_user)
-            user_side = compress_side(by_user, n_items)
             self._ud = self._put_side(user_side)
-            by_item = _build_side(
+            item_side = build_compressed_side(
                 i_idx, u_idx, vals, n_items, cfg, n_shards,
                 max_ratings_per_item)
-            item_side = compress_side(by_item, n_users)
             self._it = self._put_side(item_side)
             self.total_entries = len(vals)
+            # data-path ledger: the host binning sub-stage, beside the
+            # read/prepare/compile/train stages (obs/perfacct.py)
+            from predictionio_tpu.obs import perfacct
+
+            perfacct.LEDGER.note_stage("bin", time.perf_counter() - t_bin)
             if full_key is not None:
                 from predictionio_tpu.ops import bincache
 
@@ -717,11 +833,47 @@ class ALSTrainer:
                     "n_shards": n_shards, "total_entries": len(vals),
                     **user_side.meta("u_"), **item_side.meta("i_"),
                 })
+        self._finish_init(user_side, item_side)
 
+    @classmethod
+    def from_sides(
+        cls,
+        user_side: "SideLayout",
+        item_side: "SideLayout",
+        n_users: int,
+        n_items: int,
+        total_entries: int,
+        cfg: ALSConfig,
+        mesh: Optional[Mesh] = None,
+    ) -> "ALSTrainer":
+        """Prepared trainer from ALREADY-BUILT compressed layouts — the
+        zero-copy lanes' entry point (native el_bin_columnar output, or
+        a bincache mmap load): the sides go straight to the chunked
+        device puts, no COO, no re-binning. The arrays may be zero-copy
+        views over native buffers or mmap'd cache files; the trainer
+        keeps them referenced until the transfer completes
+        (``_note_transfer``)."""
+        self = cls.__new__(cls)
+        self.cfg = cfg
+        self.mesh = mesh
+        self.cache_hit = False
+        self.n_users, self.n_items = n_users, n_items
+        self.total_entries = total_entries
+        self._ud = self._put_side(user_side)
+        self._it = self._put_side(item_side)
+        self._finish_init(user_side, item_side)
+        return self
+
+    def _finish_init(self, user_side: "SideLayout",
+                     item_side: "SideLayout") -> None:
+        cfg = self.cfg
+        n_shards = user_side.n_shards
         # light layout descriptors only — the SideLayout objects pin
         # hundreds of MB of host arrays and must not outlive the puts
         # (experiment harnesses rebuild step fns against the same
-        # device arrays without re-binning)
+        # device arrays without re-binning); _host_refs keeps them —
+        # and through them any native/mmap buffers — alive EXACTLY
+        # until the async transfers complete (_note_transfer)
         self._sides = tuple(
             SideSpec(s.row_block, s.group_block, s.groups_per_shard, s.affine)
             for s in (user_side, item_side))
@@ -736,6 +888,9 @@ class ALSTrainer:
         self._slot_bytes = (user_side.slot_bytes, item_side.slot_bytes)
         self._user_row_block = user_side.row_block
         self._user_affine = user_side.affine  # measure_gather_roof
+        self._host_refs = (user_side, item_side)
+        self._transfer_lock = threading.Lock()
+        self._transfer_noted = False
 
         key = jax.random.PRNGKey(cfg.seed)
         ku, ki = jax.random.split(key)
@@ -743,16 +898,32 @@ class ALSTrainer:
         self._Y = _init_factors(ki, self._g_items, self.n_items, cfg.rank)
 
         self._user_step = make_half_step(
-            mesh, cfg, user_side.row_block, user_side.group_block,
+            self.mesh, cfg, user_side.row_block, user_side.group_block,
             user_side.groups_per_shard, val_affine=user_side.affine,
         )
         self._item_step = make_half_step(
-            mesh, cfg, item_side.row_block, item_side.group_block,
+            self.mesh, cfg, item_side.row_block, item_side.group_block,
             item_side.groups_per_shard, val_affine=item_side.affine,
         )
         self._run_cache = {}
         # MFU/roofline accounting (obs/perfacct.py), built on first step
         self._acct = None
+        # transfer watcher: notes the wire window into the data-path
+        # ledger (pio_datapath_stage_seconds{stage="transfer"}) and
+        # releases the host buffers as soon as the puts complete — the
+        # engine lane never calls wait_device itself. Multi-host runs
+        # skip it: indexing a non-fully-addressable sharded array
+        # raises, and the host arrays then stay referenced for the
+        # trainer's lifetime exactly as they always did on that path
+        if jax.process_count() == 1:
+            threading.Thread(target=self._transfer_watch, daemon=True,
+                             name="als-transfer-watch").start()
+
+    def _transfer_watch(self) -> None:
+        try:  # graftlint: disable=JT09 — logged below; accounting must not break training
+            self.wait_device_timed()
+        except Exception as e:  # noqa: BLE001
+            log.debug("transfer watcher failed: %s", e)
 
     def _put_side(self, side: SideLayout):
         if not hasattr(self, "put_start"):
@@ -776,7 +947,21 @@ class ALSTrainer:
                 for a in wire
             ]
         else:
-            arrs = [jnp.asarray(a) for a in wire]
+            # chunked double-buffered H2D (PIO_BIN_CHUNK_MB /
+            # PIO_TRANSFER_DOUBLE_BUFFER): row-chunks dispatch as
+            # independent async puts + one device-side concat, so host
+            # serialization/page-in of chunk N+1 overlaps chunk N's
+            # bytes on the wire (the warm mmap lane's win; the mesh
+            # path keeps whole-array puts — NamedSharding already
+            # splits them)
+            chunk_bytes = int(float(os.environ.get(
+                "PIO_BIN_CHUNK_MB", str(_DEFAULT_CHUNK_MB))) * 1e6)
+            if (chunk_bytes > 0
+                    and os.environ.get("PIO_TRANSFER_DOUBLE_BUFFER",
+                                       "1") != "0"):
+                arrs = [_chunked_device_put(a, chunk_bytes) for a in wire]
+            else:
+                arrs = [jnp.asarray(a) for a in wire]
         # recombine the index wire streams to int32 ONCE on device (the
         # per-step gather must read int32 — an int16 gather paid ~12%
         # step time when measured in r3); the puts above are async and
@@ -840,7 +1025,23 @@ class ALSTrainer:
             for a in arrs:
                 jax.device_get(a[(0,) * a.ndim])
             out.append(time.perf_counter())
+        self._note_transfer(out[-1])
         return out
+
+    def _note_transfer(self, done_ts: float) -> None:
+        """Once, at first confirmed transfer completion: record the
+        wire window in the data-path ledger (``transfer`` stage beside
+        bin/read/compile/train) and drop the host-side layout refs —
+        zero-copy native buffers and mmap'd cache pages are released
+        the moment the device owns the bytes."""
+        with self._transfer_lock:
+            if self._transfer_noted:
+                return
+            self._transfer_noted = True
+            self._host_refs = None
+        from predictionio_tpu.obs import perfacct
+
+        perfacct.LEDGER.note_stage("transfer", done_ts - self.put_start)
 
     def compile(self) -> "ALSTrainer":
         """Warm the default-iteration-count program (bench warm-up).

@@ -66,6 +66,15 @@ def _native_lib():
             i64p, ctypes.c_int64,
             i32p, f32p, f32p,
         ]
+        lib.rb_bin_compressed.restype = ctypes.c_int
+        lib.rb_bin_compressed.argtypes = [
+            i64p, i64p, f32p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_double,
+            ctypes.POINTER(native.CSide),
+        ]
+        lib.rb_free.restype = None
+        lib.rb_free.argtypes = [ctypes.c_void_p]
         _LIB = lib
     except Exception as exc:  # missing toolchain -> numpy path
         log.debug("native ragged binning unavailable: %s", exc)
@@ -275,6 +284,67 @@ def build_segmented_groups(
         groups_per_shard=g_per_shard, row_block=row_block,
         group_block=group_block,
     )
+
+
+def build_compressed_segmented(
+    group_idx: np.ndarray,
+    item_idx: np.ndarray,
+    values: np.ndarray,
+    n_groups: int,
+    seg_len="auto",
+    max_len: Optional[int] = None,
+    n_shards: int = 1,
+    block_size: int = 4096,
+    row_cost_slots: float = 16.0,
+):
+    """Native single-pass COO -> transfer-compressed segmented layout
+    (raggedbin.cpp rb_bin_compressed): plans the blocks and fills the
+    WIRE streams (uint16 idx_lo [+ uint8 idx_hi], uint8 affine value
+    codes or f32+mask) directly into aligned buffers — bit-identical to
+    ``compress_side(build_segmented_groups(...))`` without ever
+    materializing the [R, L] float32 val/mask/int32 idx intermediates
+    or re-scanning them (np.unique / searchsorted / bit splits over the
+    full nnz).
+
+    Returns a ``data.storage.BinnedSide`` whose arrays are zero-copy
+    views over the native buffers, or None when the native library is
+    unavailable or the input is below the native cutover (callers fall
+    back to the two-stage Python path)."""
+    group_idx = np.ascontiguousarray(group_idx, dtype=np.int64)
+    item_idx = np.ascontiguousarray(item_idx, dtype=np.int64)
+    values = np.ascontiguousarray(values, dtype=np.float32)
+    if not (len(group_idx) == len(item_idx) == len(values)):
+        raise ValueError("COO arrays must have equal length")
+    nnz = len(group_idx)
+    lib = _native_lib() if nnz >= _NATIVE_MIN_NNZ else None
+    if lib is None:
+        return None
+    if isinstance(seg_len, str):
+        if seg_len != "auto":
+            raise ValueError(f"seg_len must be an int or 'auto', got {seg_len!r}")
+        seg_len_i = -1
+    else:
+        seg_len_i = int(seg_len)
+    from predictionio_tpu import native
+    from predictionio_tpu.data.storage import BinnedSide
+
+    out = native.CSide()
+    rc = lib.rb_bin_compressed(
+        group_idx, item_idx, values, nnz, n_groups,
+        seg_len_i, -1 if max_len is None else int(max_len),
+        int(n_shards), int(block_size), float(row_cost_slots),
+        ctypes.byref(out),
+    )
+    if rc == -1:
+        raise ValueError("group index out of range in native binning")
+    if rc == -3:
+        raise ValueError(
+            "vocab exceeds the 24-bit index wire format (widen idx_hi "
+            "before raising this cap)")
+    if rc != 0:
+        raise MemoryError("native compressed binning allocation failed")
+    owner = native.NativeOwner(lib.rb_free, [])
+    return BinnedSide(**native.unpack_cside(out, owner))
 
 
 def build_padded_groups(

@@ -28,6 +28,7 @@ from predictionio_tpu.core import (
 from predictionio_tpu.core.params import Params
 from predictionio_tpu.data import store
 from predictionio_tpu.data.bimap import BiMap
+from predictionio_tpu.data.storage import StorageError
 from predictionio_tpu.models.als import ALSAlgorithm, ALSParams, PreparedRatings
 from predictionio_tpu.parallel.mesh import MeshContext
 
@@ -51,19 +52,104 @@ class RatingColumns:
     ratings: np.ndarray     # float32 [n]
 
 
+def _resolve_ratings(values: np.ndarray, name_codes: np.ndarray,
+                     names: List[str],
+                     overrides: Dict[str, float]) -> np.ndarray:
+    """The ONE value-resolution rule of this template's read paths
+    (and the Python reference for the native lane's in-scan resolve):
+    NaN -> 0.0, then per-event-name constant overrides ("buy" means
+    rating 4.0)."""
+    ratings = np.nan_to_num(values, nan=0.0).astype(np.float32)
+    for name, val in overrides.items():
+        if name in names:
+            code = names.index(name)
+            ratings = np.where(name_codes == code, np.float32(val), ratings)
+    return ratings
+
+
+@dataclass
+class BinnedReadRequest:
+    """Deferred zero-copy training read: the DataSource cannot bin at
+    read time because the binned layout depends on ALGORITHM knobs
+    (rank, seg_len, block_size, per-group caps), so it hands the fit
+    stage this request and the algorithm performs the ONE fused native
+    scan+bin call (store.bin_columnar) with its own config — events go
+    mmap'd log -> device-ready compressed layout with no Event objects
+    and no intermediate COO anywhere in Python."""
+
+    app_name: str
+    channel_name: Optional[str]
+    entity_type: str
+    event_names: List[str]
+    target_entity_type: str
+    value_property: Optional[str]
+    #: event name -> constant rating (the "buy means 4.0" rule)
+    overrides: Dict[str, float]
+
+    def bin(self, **layout_knobs):
+        from predictionio_tpu.data import store
+
+        return store.bin_columnar(
+            self.app_name, self.channel_name,
+            value_property=self.value_property,
+            overrides=self.overrides,
+            entity_type=self.entity_type,
+            event_names=list(self.event_names),
+            target_entity_type=self.target_entity_type,
+            **layout_knobs,
+        )
+
+    def read_prepared(self, fingerprint: Optional[str] = None):
+        """COO materialization fallback: algorithms that do NOT consume
+        the binned layout (two-tower, the vmapped grid) call this to
+        turn the deferred request into a classic indexed-COO
+        PreparedRatings via the columnar read path — same rows, same
+        first-seen code assignment, same value resolution as both the
+        legacy lane and the native builder. MEMOIZED per request: a
+        multi-algorithm engine (the ALS + two-tower hybrid) shares one
+        materialization instead of re-scanning the log per consumer."""
+        cached = getattr(self, "_prepared", None)
+        if cached is not None:
+            return cached
+        from predictionio_tpu.models.als import PreparedRatings
+        from predictionio_tpu.templates._columnar import read_interactions
+
+        cols = read_interactions(
+            self.app_name, self.channel_name, self.entity_type,
+            self.event_names, self.target_entity_type,
+            value_property=self.value_property,
+        )
+        pd = PreparedRatings(
+            user_ids=BiMap.from_vocab(cols.entity_vocab),
+            item_ids=BiMap.from_vocab(cols.target_vocab),
+            user_idx=cols.entity_idx.astype(np.int64, copy=False),
+            item_idx=cols.target_idx.astype(np.int64, copy=False),
+            ratings=_resolve_ratings(cols.values, cols.name_codes,
+                                     cols.names, self.overrides),
+            fingerprint=fingerprint,
+        )
+        self._prepared = pd
+        return pd
+
+
 @dataclass
 class RatingsTD(SanityCheck):
     """TD: (user, item, rating) triples from the event store — as a
-    row list (small data, eval folds) or columnar arrays (bulk path).
+    row list (small data, eval folds), columnar arrays (bulk path), or
+    a deferred ``binned_request`` (zero-copy lane: nothing read yet;
+    the fit stage scans+bins natively in one pass).
     ``fingerprint`` (when the backend offers a cheap one) identifies
     the exact data + derivation, keying the binned-layout cache so a
     retrain on unchanged events skips re-binning."""
 
     ratings: List[RatingEvent] = field(default_factory=list)
     columns: Optional[RatingColumns] = None
+    binned_request: Optional[BinnedReadRequest] = None
     fingerprint: Optional[str] = None
 
     def sanity_check(self) -> None:
+        if self.binned_request is not None:
+            return  # emptiness surfaces at the fit-stage native read
         if not self.ratings and (self.columns is None or not len(self.columns.ratings)):
             raise ValueError("RatingsTD is empty — no rate/buy events found")
 
@@ -79,6 +165,10 @@ class RecoDataSourceParams(Params):
     eval_query_num: int = 10
     columnar: bool = True     # bulk dict-encoded read (ML-20M path);
                               # False forces the per-event row path
+    binned: bool = True       # zero-copy lane: defer the read and let
+                              # the fit stage scan+bin natively in one
+                              # pass (falls back to the columnar read
+                              # when the backend/toolchain lacks it)
 
 
 class RecoDataSource(DataSource):
@@ -116,12 +206,8 @@ class RecoDataSource(DataSource):
             p.app_name, p.channel_name, "user",
             [p.rate_event, p.buy_event], "item", value_property="rating",
         )
-        ratings = np.nan_to_num(cols.values, nan=0.0).astype(np.float32)
-        if p.buy_event in cols.names:
-            buy_code = cols.names.index(p.buy_event)
-            ratings = np.where(
-                cols.name_codes == buy_code, np.float32(p.buy_rating), ratings
-            )
+        ratings = _resolve_ratings(cols.values, cols.name_codes,
+                                   cols.names, {p.buy_event: p.buy_rating})
         return RatingColumns(
             user_vocab=cols.entity_vocab,
             item_vocab=cols.target_vocab,
@@ -143,9 +229,37 @@ class RecoDataSource(DataSource):
         return (f"{fp}|reco|{p.rate_event}|{p.buy_event}|{p.buy_rating}"
                 f"|{p.columnar}")
 
+    def _binned_supported(self) -> bool:
+        """The zero-copy lane needs the native store AND a single-host
+        run (host-sharded multi-host reads reassemble COO over the
+        interconnect — they keep the columnar path)."""
+        from predictionio_tpu.data import store
+        from predictionio_tpu.parallel import multihost as mh
+
+        p: RecoDataSourceParams = self.params
+        if mh.process_count() > 1:
+            return False
+        try:
+            return store.supports_bin_columnar(p.app_name, p.channel_name)
+        except StorageError:
+            # app/channel resolution failed — fall back so the columnar
+            # read path raises the canonical error message
+            return False
+
     def read_training(self, ctx: MeshContext) -> RatingsTD:
         p: RecoDataSourceParams = self.params
         fp = self.data_fingerprint()
+        if p.columnar and p.binned and self._binned_supported():
+            return RatingsTD(
+                binned_request=BinnedReadRequest(
+                    app_name=p.app_name, channel_name=p.channel_name,
+                    entity_type="user",
+                    event_names=[p.rate_event, p.buy_event],
+                    target_entity_type="item", value_property="rating",
+                    overrides={p.buy_event: p.buy_rating},
+                ),
+                fingerprint=fp,
+            )
         if p.columnar:
             return RatingsTD(columns=self._read_columnar(), fingerprint=fp)
         return RatingsTD(ratings=self._read(), fingerprint=fp)
@@ -177,6 +291,11 @@ class RecoPreparator(Preparator):
     dict-encoded, so indexing is just wrapping the vocabularies."""
 
     def prepare(self, ctx: MeshContext, td: RatingsTD) -> PreparedRatings:
+        if td.binned_request is not None:
+            # zero-copy lane: nothing to index here — the fit stage's
+            # native call dict-encodes ids as part of its one pass
+            return PreparedRatings(binned_request=td.binned_request,
+                                   fingerprint=td.fingerprint)
         if td.columns is not None:
             c = td.columns
             return PreparedRatings(

@@ -1,4 +1,4 @@
-"""graftlint rules JT01-JT12: the TPU hazards this codebase has hit.
+"""graftlint rules JT01-JT13: the TPU hazards this codebase has hit.
 
 Each rule encodes a failure class with a concrete precedent in this
 tree's history (the bf16-Gramian divergence behind JT03 is recorded in
@@ -1202,3 +1202,85 @@ class JoinWaitWithoutTimeout(Rule):
                 "must never hang on a dead replica); pass timeout= "
                 "and handle the expiry",
             )
+
+
+# -- JT13 ----------------------------------------------------------------------
+
+@register
+class CopyInducingDeviceTransfer(Rule):
+    id = "JT13"
+    name = "copy-inducing-device-transfer"
+    rationale = (
+        "jax.device_put / jnp.array / jnp.asarray on a Python list, a "
+        ".tolist() product, or a non-contiguous (stepped) slice forces "
+        "a host-side serialize/copy before a single byte can cross to "
+        "the device: the list round-trips element-by-element through "
+        "the Python object layer, and the strided view is densified "
+        "into a fresh host buffer first. On the data-path hot lanes "
+        "(this repo's whole zero-copy design: native buffers -> numpy "
+        "views -> device_put with no copies) that silently re-adds the "
+        "copy the pipeline exists to remove. Build a contiguous "
+        "ndarray first (np.asarray / np.ascontiguousarray) — or keep "
+        "the native view and put IT."
+    )
+
+    #: the hazard lives where bulk arrays move; tiny constant lists in
+    #: tests/CLI glue are not worth the noise
+    def applies_to(self, abspath: str) -> bool:
+        return ("/ops/" in abspath or "/data/" in abspath
+                or "/models/" in abspath or "/templates/" in abspath
+                or "/parallel/" in abspath)
+
+    _TRANSFER_TAILS = {"device_put", "array", "asarray"}
+
+    def _is_transfer(self, func: ast.AST) -> bool:
+        d = dotted(func)
+        if not d:
+            return False
+        head, _, tail = d.rpartition(".")
+        if tail == "device_put":
+            return head in ("jax", "") or head.endswith("jax")
+        if tail in ("array", "asarray"):
+            return head in _JNP_MODULES
+        return False
+
+    def _offender(self, arg: ast.AST) -> Optional[str]:
+        if isinstance(arg, ast.List):
+            return "a Python list literal"
+        if isinstance(arg, (ast.ListComp, ast.GeneratorExp)):
+            return "a Python list comprehension"
+        if isinstance(arg, ast.Call):
+            if (isinstance(arg.func, ast.Attribute)
+                    and arg.func.attr == "tolist"):
+                return "a .tolist() result"
+            if dotted(arg.func) == "list":
+                return "a list(...) result"
+            return None
+        if isinstance(arg, ast.Subscript):
+            sl = arg.slice
+            parts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+            for part in parts:
+                if not isinstance(part, ast.Slice) or part.step is None:
+                    continue
+                step = part.step
+                if (isinstance(step, ast.Constant)
+                        and step.value in (1, None)):
+                    continue
+                return "a stepped (non-contiguous) slice"
+        return None
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if not self._is_transfer(node.func):
+                continue
+            why = self._offender(node.args[0])
+            if why:
+                yield Finding(
+                    self.id, ctx.path, node.lineno, node.col_offset,
+                    f"device transfer of {why} forces a host "
+                    "serialize/copy on the data path; build a "
+                    "contiguous ndarray (np.asarray/ascontiguousarray) "
+                    "once and transfer that",
+                )

@@ -258,6 +258,19 @@ def run_train(
         # pio_model_staleness_seconds drops to the age of whatever
         # arrived during the train (0 when nothing did)
         perfacct.LEDGER.note_publish()
+        # one structured line with the events->model stage split (the
+        # zero-copy lane's read/bin/transfer sub-stages land here, so
+        # a `pio train` log answers "where did the minutes go" without
+        # a bench run; pio_datapath_stage_seconds carries it live)
+        runs = perfacct.LEDGER.snapshot().get("runs") or []
+        if runs:
+            stages = runs[-1].get("stages") or {}
+            log.info(
+                "events->model stages (sec): %s",
+                " ".join(f"{k}={v:.2f}" for k, v in sorted(stages.items())),
+                extra={"pio": {"instance": instance.id,
+                               "datapath_stages": stages}},
+            )
         # every host sees the COMPLETED row before anyone deploys from it
         mh.barrier("pio_train_" + instance.id)
         log.info("training completed: instance %s", instance.id)

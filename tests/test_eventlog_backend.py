@@ -1015,3 +1015,77 @@ def test_fingerprint_distinguishes_apps_with_identical_content(tmp_path):
     # and the fingerprint is stable for the same unchanged log
     assert st.events().data_fingerprint(1) == fp1
     st.events().close()
+
+
+# -- vectorized row-lane append (el_append_rows) --------------------------------
+
+def test_insert_batch_fast_lane_full_round_trip(tmp_path):
+    """The vectorized pack (numpy struct assembly + one native bulk
+    call) must preserve EVERY record field the per-row _pack lane
+    carried: tz-offset times, properties, tags, prId, caller-stamped
+    canonical and non-canonical ids, NUL bytes inside ids."""
+    st = _mk(tmp_path)
+    app = st.apps().insert("rows")
+    st.events().init(app.id)
+    tz = dt.timezone(dt.timedelta(hours=-7))
+    evs = [
+        Event(event="rate", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1",
+              properties={"rating": 4.5},
+              event_time=dt.datetime(2026, 1, 1, tzinfo=UTC)),
+        Event(event="$set", entity_type="user", entity_id="u2",
+              properties={"a": [1, 2], "b": {"c": "x"}},
+              tags=("t1", "t2"), pr_id="p9",
+              event_time=dt.datetime(2026, 1, 2, 3, 4, 5, 123456, tzinfo=tz)),
+        Event(event="view", entity_type="user", entity_id="u\x00weird",
+              event_time=dt.datetime(2026, 2, 1, tzinfo=UTC),
+              event_id="deadbeef" * 4),
+        Event(event="view", entity_type="user", entity_id="u4",
+              event_time=dt.datetime(2026, 2, 2, tzinfo=UTC),
+              event_id="my-custom-id"),
+    ]
+    ids = st.events().insert_batch(evs, app.id)
+    assert ids[2] == "deadbeef" * 4 and ids[3] == "my-custom-id"
+    for eid, e in zip(ids, evs):
+        got = st.events().get(eid, app.id)
+        assert got is not None, eid
+        assert got.event == e.event
+        assert got.entity_id == e.entity_id
+        assert got.target_entity_id == e.target_entity_id
+        assert got.properties.to_dict() == dict(e.properties)
+        assert got.event_time == e.event_time
+        assert got.tags == e.tags and got.pr_id == e.pr_id
+    # survives reopen (the packed wire records are well-formed)
+    st.events().close()
+    st2 = _mk(tmp_path)
+    got = st2.events().get(ids[0], app.id)
+    assert got is not None and got.properties.to_dict() == {"rating": 4.5}
+    st2.events().close()
+
+
+def test_insert_batch_fast_lane_wire_limit_error(tmp_path):
+    from predictionio_tpu.data.storage import StorageError
+
+    st = _mk(tmp_path)
+    app = st.apps().insert("rows2")
+    st.events().init(app.id)
+    big = Event(event="rate", entity_type="user", entity_id="x" * 70_000,
+                event_time=dt.datetime(2026, 1, 1, tzinfo=UTC))
+    with pytest.raises(StorageError, match="65534"):
+        st.events().insert_batch([big], app.id)
+    # nothing appended: the batch is validated before any write
+    assert st.events().find(app.id) == []
+    st.events().close()
+
+
+def test_insert_batch_fast_lane_moves_freshness_clock(tmp_path):
+    from predictionio_tpu.obs import perfacct
+
+    st = _mk(tmp_path)
+    app = st.apps().insert("rows3")
+    st.events().init(app.id)
+    perfacct.LEDGER.clear()
+    st.events().insert_batch([ev("u1")], app.id)
+    assert perfacct.LEDGER.staleness_seconds() > 0.0
+    perfacct.LEDGER.clear()
+    st.events().close()

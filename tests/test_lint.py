@@ -37,7 +37,8 @@ def rule_ids(findings):
 
 def test_all_rules_registered():
     assert {"JT01", "JT02", "JT03", "JT04", "JT05", "JT06",
-            "JT07", "JT08", "JT09", "JT10", "JT11", "JT12"} <= set(RULES)
+            "JT07", "JT08", "JT09", "JT10", "JT11", "JT12",
+            "JT13"} <= set(RULES)
 
 
 def test_syntax_error_is_reported_not_raised(tmp_path):
@@ -981,3 +982,61 @@ def test_jt12_positive_queue_adjacent_names_still_flagged(tmp_path):
             pool_ready.wait()
     """)
     assert rule_ids(findings) == ["JT12", "JT12"]
+
+
+# -- JT13 copy-inducing-device-transfer ----------------------------------------
+
+def test_jt13_positive_list_tolist_and_stepped_slice(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+
+        def put(xs, arr):
+            a = jax.device_put([1, 2, 3])
+            b = jnp.asarray(xs.tolist())
+            c = jnp.array([x * 2 for x in xs])
+            d = jax.device_put(arr[::2])
+            e = jnp.asarray(arr[:, ::4])
+            return a, b, c, d, e
+    """, relpath="ops/mod.py")
+    assert rule_ids(findings) == ["JT13"] * 5
+    assert "serialize/copy" in findings[0].message
+
+
+def test_jt13_negative_contiguous_and_ndarray(tmp_path):
+    # ndarray vars, contiguous row slices and step-1 slices stay silent
+    findings = lint_src(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def put(arr):
+            ok1 = jnp.asarray(arr)
+            ok2 = jax.device_put(arr[1:5])
+            ok3 = jnp.array(arr[::1])
+            ok4 = jax.device_put(np.ascontiguousarray(arr.T))
+            return ok1, ok2, ok3, ok4
+    """, relpath="ops/mod.py")
+    assert findings == []
+
+
+def test_jt13_scoped_to_data_path_modules(tmp_path):
+    # the hazard is bulk data movement; CLI/test glue is out of scope
+    src = """\
+        import jax
+
+        def put():
+            return jax.device_put([1, 2, 3])
+    """
+    assert rule_ids(lint_src(tmp_path, src, relpath="ops/m.py")) == ["JT13"]
+    assert lint_src(tmp_path, src, relpath="tools/m.py") == []
+
+
+def test_jt13_suppressible_with_justification(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import jax
+
+        def put():
+            return jax.device_put([0.0])  # graftlint: disable=JT13 — fixture: one-element warmup constant
+    """, relpath="ops/m.py")
+    assert findings == []
