@@ -1,0 +1,212 @@
+"""Exact on-device retrieval: fused Pallas dot+top-k, XLA fallback.
+
+The hot path is ``ops/pallas/topk_dot.py`` — the item table streamed
+through VMEM in tiles, MXU partial dots, a running [B, k] top-k merged
+per tile; the full [B, I] logits matrix never exists in HBM. The XLA
+brute-force scorer (``ops.topk.TopKScorer``) remains the numerical
+reference and the fallback everywhere the kernel is ineligible or its
+Mosaic probe fails — the ``ops/pallas`` design contract, applied to
+serving instead of training.
+
+Kernel selection mirrors ``flash_ce_kernel`` exactly: a per-index
+``kernel`` flag ("auto"/"on"/"off", wired from the model params'
+``index_kernel``), the ``PIO_INDEX_KERNEL`` env override, ``auto``
+engaging only on a real TPU backend, probe-guarded with per-shape
+smoke compiles, and interpret mode for CPU tier-1 equivalence tests.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from predictionio_tpu.index import AnnIndex, MEASURED_RECALL
+from predictionio_tpu.ops import pallas as plk
+
+log = logging.getLogger(__name__)
+
+
+class ExactIndex(AnnIndex):
+    """Exact top-k by dot product over the full table.
+
+    Results are pinned to ``ops.topk.TopKScorer.score`` (identical
+    scores; identical indices modulo exact score ties when the Pallas
+    kernel is engaged — tests/test_index.py).
+    """
+
+    backend = "exact"
+
+    def __init__(self, kernel: str = "auto", max_exclude: int = 64,
+                 block_items: Optional[int] = None,
+                 placement: Optional[str] = None):
+        from predictionio_tpu.ops.pallas import topk_dot as tkd
+
+        self.kernel_flag = kernel
+        self.max_exclude = int(max_exclude)
+        self.block_items = int(block_items or tkd.BLOCK_ITEMS)
+        self._placement = placement
+        self._scorer = None          # lazy TopKScorer fallback
+        self._vectors = np.zeros((0, 1), np.float32)
+        self._device_padded = None   # device copy padded to the tile
+        self._fns: Dict[Tuple[int, int, int], object] = {}
+        self._lock = threading.Lock()
+        self.kernel_plan: Dict[str, object] = {"engaged": False,
+                                               "reason": "no build yet"}
+        self.build_seconds = 0.0
+        self.searches = 0
+
+    # -- build / upsert -------------------------------------------------------
+    def build(self, item_vectors: np.ndarray) -> None:
+        t0 = time.perf_counter()
+        with self._lock:
+            self._vectors = np.ascontiguousarray(item_vectors,
+                                                 dtype=np.float32)
+            self._scorer = None
+            self._device_padded = None
+            self._fns.clear()
+            self._plan_kernel()
+        self.build_seconds = time.perf_counter() - t0
+        self._note_build(self.build_seconds)
+        MEASURED_RECALL.labels(self.backend).set(1.0)  # exact by design
+
+    def upsert(self, rows: np.ndarray, vectors: np.ndarray) -> None:
+        """Overwrite/append rows copy-on-write: readers of
+        ``self._vectors`` see old-or-new tables, never torn rows — the
+        same publication discipline as ``ALSModel.upsert_rows``, which
+        is this method's only production caller."""
+        rows = np.asarray(rows, np.int64).ravel()
+        vectors = np.atleast_2d(np.asarray(vectors, np.float32))
+        if len(rows) == 0:
+            return
+        with self._lock:
+            table = self._vectors
+            n, d = table.shape if table.size else (0, vectors.shape[1])
+            grow = int(rows.max()) + 1 - n if rows.size else 0
+            if grow > 0:
+                table = np.vstack(
+                    [table.reshape(n, d),
+                     np.zeros((grow, d), np.float32)])
+            else:
+                table = table.copy()
+            table[rows] = vectors
+            self._vectors = table
+            # the kernel/fallback paths hold device copies of the OLD
+            # table; drop them — a same-shape re-put hits the compile
+            # cache, only appends change shapes
+            self._scorer = None
+            self._device_padded = None
+            if grow > 0:
+                self._fns.clear()   # n_items is a static kernel arg
+            self._note_build(self.build_seconds)
+
+    def __len__(self) -> int:
+        return int(self._vectors.shape[0])
+
+    @property
+    def vectors(self) -> np.ndarray:
+        return self._vectors
+
+    # -- kernel selection -----------------------------------------------------
+    def _plan_kernel(self) -> None:
+        import jax
+
+        interpret = plk.interpret_mode()
+        n = self._vectors.shape[0]
+        eligible = n > 0
+        reason = "empty table" if not eligible else ""
+        engaged, why = plk.decide(
+            self.kernel_flag, "PIO_INDEX_KERNEL",
+            eligible=eligible, ineligible_reason=reason,
+            auto_default=jax.default_backend() == "tpu",
+        )
+        self.kernel_plan = {"engaged": engaged, "reason": why,
+                            "interpret": interpret}
+
+    def _kernel_eligible(self, B: int, E: int, k: int) -> bool:
+        from predictionio_tpu.ops.pallas import topk_dot as tkd
+
+        return (bool(self.kernel_plan.get("engaged"))
+                and B <= tkd.MAX_BATCH and E <= tkd.MAX_EXCLUDE
+                and k <= tkd.MAX_K and k <= len(self))
+
+    def _fn(self, B: int, E: int, k: int):
+        from predictionio_tpu.ops.pallas import topk_dot as tkd
+
+        key = (B, E, k)
+        fn = self._fns.get(key)
+        if fn is None:
+            n, d = self._vectors.shape
+            interpret = bool(self.kernel_plan.get("interpret"))
+            if not interpret and not plk.probe(
+                    f"topk_dot:{n}x{d}:B{B}E{E}k{k}",
+                    lambda: tkd.smoke_at(n, d, B, k, E,
+                                         block_items=self.block_items)):
+                self._fns[key] = False   # this shape degraded to XLA
+                return False
+            fn = tkd.make_topk_dot(n, d, B, k, E,
+                                   block_items=self.block_items,
+                                   interpret=interpret)
+            self._fns[key] = fn
+        return fn
+
+    def _device_items(self):
+        from predictionio_tpu.ops.pallas import topk_dot as tkd
+        import jax.numpy as jnp
+
+        # read-once: a concurrent upsert nulls the cache mid-call (the
+        # patch lane runs while queries are in flight); the local ref
+        # keeps this search on a consistent (old-or-new) table
+        padded = self._device_padded
+        if padded is None:
+            padded = tkd.pad_items(jnp.asarray(self._vectors),
+                                   self.block_items)
+            self._device_padded = padded
+        return padded
+
+    def _fallback(self):
+        from predictionio_tpu.ops.topk import TopKScorer
+
+        scorer = self._scorer
+        if scorer is None:
+            scorer = TopKScorer(self._vectors,
+                                max_exclude=self.max_exclude,
+                                placement=self._placement)
+            self._scorer = scorer
+        return scorer
+
+    # -- search ---------------------------------------------------------------
+    def search(self, query_vecs: np.ndarray, k: int,
+               exclude: Optional[np.ndarray] = None,
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        self._note_query()
+        self.searches += 1
+        if len(self) == 0:
+            B = np.atleast_2d(np.asarray(query_vecs)).shape[0]
+            return (np.zeros((B, 0), np.float32),
+                    np.zeros((B, 0), np.int32))
+        from predictionio_tpu.ops.topk import _prepare_score_inputs
+
+        q2, excl, k_eff, k_bucket, B = _prepare_score_inputs(
+            query_vecs, k, exclude, len(self), self.max_exclude)
+        if not self._kernel_eligible(q2.shape[0], excl.shape[1], k_bucket):
+            return self._fallback().score(query_vecs, k, exclude)
+        fn = self._fn(q2.shape[0], excl.shape[1], k_bucket)
+        if fn is False:   # probe failed for this shape — XLA fallback
+            return self._fallback().score(query_vecs, k, exclude)
+        scores, idx = fn(q2, self._device_items(), excl)
+        return (np.asarray(scores)[:B, :k_eff],
+                np.asarray(idx)[:B, :k_eff])
+
+    def stats(self) -> Dict[str, object]:
+        out = super().stats()
+        out.update({
+            "kernel": dict(self.kernel_plan),
+            "build_seconds": round(self.build_seconds, 4),
+            "searches": self.searches,
+            "max_exclude": self.max_exclude,
+        })
+        return out

@@ -91,17 +91,30 @@ class ALSParams(Params):
     # makes caps unnecessary except as an outlier guard)
     max_ratings_per_user: Optional[int] = None
     max_ratings_per_item: Optional[int] = None
+    # retrieval-index knobs (predictionio_tpu/index): backend
+    # "auto"/"exact"/"ivf" (PIO_INDEX_BACKEND overrides), and the exact
+    # backend's Pallas dot+top-k kernel flag "auto"/"on"/"off"
+    # (PIO_INDEX_KERNEL overrides — selection exactly like
+    # flash_ce_kernel)
+    index_backend: str = "auto"
+    index_kernel: str = "auto"
 
 
 class ALSModel:
     """Factor matrices + id maps; scorer compiled lazily and kept on device."""
 
-    def __init__(self, factors: ALSFactors, user_ids: BiMap, item_ids: BiMap):
+    def __init__(self, factors: ALSFactors, user_ids: BiMap, item_ids: BiMap,
+                 index_backend: str = "auto", index_kernel: str = "auto"):
         self.user_factors = factors.user_factors
         self.item_factors = factors.item_factors
         self.user_ids = user_ids
         self.item_ids = item_ids
         self._scorer: Optional[TopKScorer] = None
+        # retrieval index (predictionio_tpu/index): built lazily /
+        # by deploy warm-up, patched in place by the streaming lane
+        self._index = None
+        self.index_backend = index_backend
+        self.index_kernel = index_kernel
         # picklable record that sharded serving was enabled (the mesh
         # itself never pickles); load_persistent_model re-enables it
         self.sharded_axis: Optional[str] = None
@@ -109,16 +122,39 @@ class ALSModel:
     def __getstate__(self):
         d = dict(self.__dict__)
         d["_scorer"] = None  # device buffers never pickle
+        d["_index"] = None   # rebuilt at deploy warm-up
         return d
 
     def __setstate__(self, d):
         d.setdefault("sharded_axis", None)  # models pickled pre-field
+        d.setdefault("_index", None)
+        d.setdefault("index_backend", "auto")
+        d.setdefault("index_kernel", "auto")
         self.__dict__.update(d)
 
     def scorer(self) -> TopKScorer:
         if self._scorer is None:
             self._scorer = TopKScorer(self.item_factors)
         return self._scorer
+
+    def retrieval_index(self):
+        """The model's ANN candidate-generation index over the item
+        factor table (predictionio_tpu/index): built lazily (the engine
+        server's warm-up builds it at model load), kept fresh by
+        ``upsert_rows`` — the streaming ``/model/patch`` lane reaches
+        retrieval, not just scoring."""
+        if self._index is None:
+            from predictionio_tpu.index import make_index
+
+            self._index = make_index(
+                self.item_factors, backend=self.index_backend,
+                kernel=self.index_kernel)
+        return self._index
+
+    def retrieval_stats(self) -> Optional[dict]:
+        """Stats of the BUILT index, or None (status pages must never
+        trigger a build)."""
+        return self._index.stats() if self._index is not None else None
 
     def enable_sharded_serving(self, mesh, axis: str = "data") -> None:
         """Swap in a ShardedTopKScorer: item factors row-sharded over
@@ -198,6 +234,14 @@ class ALSModel:
             self.item_ids = ids
             # the scorer holds a DEVICE copy of the old item table
             self._scorer = None
+            # the retrieval index takes the SAME rows as an in-place
+            # upsert (no rebuild): streamed items become retrievable
+            # without a /reload
+            if self._index is not None:
+                touched = np.fromiter(
+                    (ids[iid] for iid, _ in item_rows), np.int64,
+                    count=len(item_rows))
+                self._index.upsert(touched, factors[touched])
         return new_users, new_items
 
     def recommend(
@@ -222,16 +266,64 @@ class ALSModel:
             if len(cand) == 0:
                 return []
             scores = self.item_factors[cand] @ self.user_factors[row]
-            order = np.argsort(-scores)[:num]
+            # partial sort: the whitelist can be the whole catalog
+            # (JT14 — argsort(...)[:k] full-sorts it per query)
+            top_s, top_j = TopKScorer._host_topk(scores[None, :], num)
             inv = self.item_ids.inverse()
-            return [(inv[int(cand[j])], float(scores[j])) for j in order]
+            return [(inv[int(cand[j])], float(s))
+                    for s, j in zip(top_s[0], top_j[0])]
         excl = np.fromiter(exclude, dtype=np.int32) if exclude else None
-        scores, idx = self.scorer().score(self.user_factors[row], num, excl)
+        if self.sharded_axis is not None:
+            # sharded serving keeps the mesh scorer (a model-axis
+            # sharded index is the ROADMAP item A follow-up)
+            scores, idx = self.scorer().score(
+                self.user_factors[row], num, excl)
+        else:
+            scores, idx = self.retrieval_index().search(
+                self.user_factors[row], num, excl)
         inv = self.item_ids.inverse()
         return [
             (inv[int(i)], float(s))
             for s, i in zip(scores[0], idx[0])
-            if s > -1e29
+            if s > -1e29 and int(i) >= 0
+        ]
+
+    def similar_items(
+        self,
+        item_id: str,
+        num: int,
+        exclude_items: Sequence[str] = (),
+    ) -> List[Tuple[str, float]]:
+        """item -> top-``num`` similar items through the retrieval
+        index: top-k by dot product of the item's factor against the
+        item table, the query item excluded. Cosine similarity when the
+        table is row-normalized (two-tower towers are; raw ALS factors
+        score dot-similarity, popularity-weighted)."""
+        row = self.item_ids.get(item_id)
+        if row is None:
+            return []
+        exclude = {self.item_ids[i] for i in exclude_items
+                   if i in self.item_ids} - {row}
+        # self-exclusion goes LAST: the exact backend caps exclusion
+        # lists at max_exclude keeping the NEWEST (rightmost) entries,
+        # so an oversize blacklist may drop itself but never the query
+        # item — and the result filter below backstops even that
+        excl = np.fromiter(
+            list(exclude) + [row], dtype=np.int32,
+            count=len(exclude) + 1)
+        if self.sharded_axis is not None:
+            # sharded serving keeps the mesh scorer (same stance as
+            # recommend: no single-device index over a sharded catalog)
+            scores, idx = self.scorer().score(
+                self.item_factors[row], num, excl)
+        else:
+            scores, idx = self.retrieval_index().search(
+                self.item_factors[row], num, excl)
+        inv = self.item_ids.inverse()
+        return [
+            (inv[int(i)], float(s))
+            for s, i in zip(scores[0], idx[0])
+            if s > -1e29 and int(i) >= 0 and int(i) != row
         ]
 
 
@@ -301,7 +393,9 @@ class ALSAlgorithm(Algorithm):
             # retrain-on-unchanged-events skips re-binning (ops.bincache)
             cache_key=pd.fingerprint,
         )
-        return ALSModel(factors, pd.user_ids, pd.item_ids)
+        return ALSModel(factors, pd.user_ids, pd.item_ids,
+                        index_backend=p.index_backend,
+                        index_kernel=p.index_kernel)
 
     def _train_binned(self, ctx: MeshContext, pd: PreparedRatings,
                       cfg: ALSConfig) -> ALSModel:
@@ -347,7 +441,9 @@ class ALSAlgorithm(Algorithm):
                 trainer.cache_hit = True
                 return ALSModel(trainer.run(),
                                 BiMap.from_vocab(user_vocab),
-                                BiMap.from_vocab(item_vocab))
+                                BiMap.from_vocab(item_vocab),
+                                index_backend=p.index_backend,
+                                index_kernel=p.index_kernel)
             # entry saved by the COO lane (no vocab): rebuild below and
             # overwrite it with a vocab-carrying entry
 
@@ -391,7 +487,9 @@ class ALSAlgorithm(Algorithm):
             })
         return ALSModel(trainer.run(),
                         BiMap.from_vocab(binned.entity_vocab),
-                        BiMap.from_vocab(binned.target_vocab))
+                        BiMap.from_vocab(binned.target_vocab),
+                        index_backend=p.index_backend,
+                        index_kernel=p.index_kernel)
 
     @classmethod
     def grid_train(
@@ -461,7 +559,10 @@ class ALSAlgorithm(Algorithm):
             iterations=[p.num_iterations for p in params_list],
             cg_iters=[p.cg_iters for p in params_list],
         )
-        return [ALSModel(f, pd.user_ids, pd.item_ids) for f in factors_list]
+        return [ALSModel(f, pd.user_ids, pd.item_ids,
+                         index_backend=base.index_backend,
+                         index_kernel=base.index_kernel)
+                for f in factors_list]
 
     def load_persistent_model(self, persisted: ALSModel, ctx: MeshContext) -> ALSModel:
         """Re-enable sharded serving after unpickle when the model was
@@ -499,9 +600,35 @@ class ALSAlgorithm(Algorithm):
             rows = model.user_factors[np.arange(b) % len(model.user_ids)]
             for k in (5, 10):
                 model.scorer().score(rows, k)
+        if model.sharded_axis is not None:
+            # sharded serving never consults the single-device index —
+            # building one would device-put the FULL item table onto
+            # one chip, the exact thing the sharded catalog can't hold
+            return
+        # retrieval index: BUILD at model load (pio_index_build_seconds
+        # prices it here, never on a live query) and warm the search
+        # buckets both retrieval query shapes dispatch — user -> top-k
+        # (no exclusions) and item -> similar (one self-exclusion)
+        index = model.retrieval_index()
+        for b in (1, 8):
+            rows = model.user_factors[np.arange(b) % len(model.user_ids)]
+            for k in (5, 10):
+                index.search(rows, k)
+        index.search(model.item_factors[:1],
+                     min(10, len(model.item_ids)),
+                     exclude=np.array([[0]], np.int32))
 
     def predict(self, model: ALSModel, query: Dict[str, Any]) -> Dict[str, Any]:
         num = int(query.get("num", 10))
+        if "user" not in query and "item" in query:
+            # item -> top-num similar items: candidate generation
+            # through the retrieval index (the similarproduct-style
+            # query surface on the factor templates)
+            sims = model.similar_items(
+                str(query["item"]), num,
+                exclude_items=query.get("blacklist") or ())
+            return {"itemScores": [{"item": i, "score": s}
+                                   for i, s in sims]}
         recs = model.recommend(
             str(query["user"]),
             num,

@@ -80,21 +80,39 @@ class SimilarProductModel:
         self.item_categories = item_categories
         self._normalized = cosine_normalize(self.item_factors)
         self._scorer: Optional[TopKScorer] = None
+        self._index = None
         self._category_index: Optional[Dict[str, np.ndarray]] = None
 
     def __getstate__(self):
         d = dict(self.__dict__)
         d["_scorer"] = None
         d["_category_index"] = None
+        d["_index"] = None
         return d
 
     def __setstate__(self, d):
+        d.setdefault("_index", None)
         self.__dict__.update(d)
 
     def scorer(self) -> TopKScorer:
         if self._scorer is None:
             self._scorer = TopKScorer(self._normalized)
         return self._scorer
+
+    def retrieval_index(self):
+        """ANN candidate generation over the ROW-NORMALIZED item table
+        (predictionio_tpu/index — dot == cosine here): the
+        exclusion-only query shape goes through it; whitelist/category
+        predicates keep the masked scorer (masks are not an AnnIndex
+        surface)."""
+        if self._index is None:
+            from predictionio_tpu.index import make_index
+
+            self._index = make_index(self._normalized)
+        return self._index
+
+    def retrieval_stats(self) -> Optional[dict]:
+        return self._index.stats() if self._index is not None else None
 
     def _category_mask(self, categories: Set[str]) -> np.ndarray:
         """[I] bool — items sharing >=1 category with the query.
@@ -138,6 +156,28 @@ class SimilarProductModel:
         qvec = self._normalized[query_rows].sum(axis=0)
 
         n = len(self.item_ids)
+        # exclusion-only queries (no whitelist/category predicate) are
+        # CANDIDATE GENERATION — route them through the retrieval
+        # index; predicate queries keep the masked scorer (a bool mask
+        # is not an AnnIndex surface)
+        if white_list is None and not categories:
+            excl_rows = set(query_rows)
+            if black_list:
+                excl_rows |= {self.item_ids[i] for i in black_list
+                              if i in self.item_ids}
+            index = self.retrieval_index()
+            max_excl = getattr(index, "max_exclude", 64)
+            if len(excl_rows) <= max_excl:
+                scores, idx = index.search(
+                    qvec, num,
+                    np.fromiter(excl_rows, np.int32, count=len(excl_rows)))
+                inv = self.item_ids.inverse()
+                return [
+                    (inv[int(i)], float(s))
+                    for s, i in zip(scores[0], idx[0])
+                    if s > 0.0 and int(i) >= 0  # ref keeps score > 0 (:174)
+                ]
+
         mask = np.ones(n, dtype=bool)
         mask[query_rows] = False                     # discard query items
         if white_list is not None:
@@ -216,13 +256,18 @@ class SimilarProductAlgorithm(Algorithm):
         return SimilarProductModel(item_factors, item_ids, pd.item_categories)
 
     def warmup(self, model: SimilarProductModel, ctx: MeshContext) -> None:
-        """Pre-compile the masked-cosine serve buckets (B=1, k buckets
-        8 and 16) through the real query path."""
+        """Pre-compile the serve buckets (B=1, k buckets 8 and 16)
+        through the real query path — the exclusion-only call builds
+        the retrieval index at model load, the category call warms the
+        masked-scorer route."""
         first = next(iter(model.item_ids.keys()), None)
         if first is None:
             return
         for num in (5, 10):
             model.similar([first], num)
+        cats = next(iter(model.item_categories.values()), None)
+        if cats:
+            model.similar([first], 10, categories=set(cats[:1]))
 
     def predict(self, model: SimilarProductModel, query: Dict[str, Any]) -> Dict[str, Any]:
         recs = model.similar(

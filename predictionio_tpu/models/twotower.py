@@ -49,12 +49,17 @@ class TwoTowerParams(Params):
                                            # TwoTowerConfig; env overrides
                                            # PIO_TT_FLASH_CE /
                                            # PIO_TT_EMBED_UPDATE)
+    index_backend: str = "auto"            # retrieval index backend
+                                           # (PIO_INDEX_BACKEND overrides)
+    index_kernel: str = "auto"             # Pallas dot+top-k flag
+                                           # (PIO_INDEX_KERNEL overrides)
 
 
 class TwoTowerModel(ALSModel):
     """Same container as ALSModel: (user_vecs, item_vecs, id maps) +
-    TopKScorer serve path; vectors here are L2-normalized so scores are
-    cosine similarities."""
+    TopKScorer serve path + the shared retrieval index; vectors here
+    are L2-normalized so scores — including the index's item -> similar
+    answers — are cosine similarities."""
 
 
 class TwoTowerAlgorithm(Algorithm):
@@ -102,7 +107,9 @@ class TwoTowerAlgorithm(Algorithm):
         losses = trainer.run()
         emb = trainer.embeddings(losses)
         factors = ALSFactors(user_factors=emb.user_vecs, item_factors=emb.item_vecs)
-        model = TwoTowerModel(factors, pd.user_ids, pd.item_ids)
+        model = TwoTowerModel(factors, pd.user_ids, pd.item_ids,
+                              index_backend=p.index_backend,
+                              index_kernel=p.index_kernel)
         model.train_losses = emb.losses
         return model
 

@@ -24,9 +24,14 @@ Design contract shared by every kernel here:
   - equivalence tests pin each kernel to its XLA reference at <=1e-5
     in f32 (tests/test_pallas_kernels.py).
 
+The same contract covers serving: ``topk_dot`` (fused dot + streaming
+top-k over a tiled item table — the exact retrieval index's hot path,
+selected per-index via ``index_kernel`` / ``PIO_INDEX_KERNEL``).
+
 Env overrides (each beats the config flag, for bench A/B without code
-changes): ``PIO_TT_FLASH_CE``, ``PIO_TT_EMBED_UPDATE`` = ``on`` /
-``off`` / ``auto``; ``PIO_PALLAS_INTERPRET=1`` forces interpret mode.
+changes): ``PIO_TT_FLASH_CE``, ``PIO_TT_EMBED_UPDATE``,
+``PIO_INDEX_KERNEL`` = ``on`` / ``off`` / ``auto``;
+``PIO_PALLAS_INTERPRET=1`` forces interpret mode.
 """
 
 from __future__ import annotations

@@ -192,15 +192,25 @@ class TopKScorer:
 
     @staticmethod
     def _host_topk(scores: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
-        """Partial-sort top-k over host scores [B, I] -> ([B,k], [B,k])."""
+        """Partial-sort top-k over host scores [B, I] -> ([B,k], [B,k]).
+
+        Edge contracts pinned by tests/test_topk_edges.py (this scorer
+        is the equivalence reference for predictionio_tpu/index):
+        ``k >= n_items`` clamps, ``k == 0`` and empty tables return
+        [B, 0], and the final k-element sort is STABLE so exact ties
+        rank deterministically across calls (argpartition's arbitrary
+        partition order must not leak into the answer)."""
         n_items = scores.shape[1]
         k = min(k, n_items)
         if k < n_items:
             part = np.argpartition(-scores, k - 1, axis=1)[:, :k]
+            # canonicalize the partition's arbitrary order before the
+            # stable rank so tied scores resolve by position, not luck
+            part.sort(axis=1)
         else:
             part = np.broadcast_to(np.arange(n_items), scores.shape).copy()
         part_scores = np.take_along_axis(scores, part, axis=1)
-        order = np.argsort(-part_scores, axis=1)
+        order = np.argsort(-part_scores, axis=1, kind="stable")
         idx = np.take_along_axis(part, order, axis=1)
         return np.take_along_axis(part_scores, order, axis=1), idx
 

@@ -770,6 +770,14 @@ class EngineServer(HTTPServerBase):
         """ref: status landing page content (CreateServer.scala:433-459)."""
         with self._deployment_lock:
             instance = self.deployment.instance
+            models = list(self.deployment.models)
+        # retrieval surface: stats of each model's BUILT ANN index
+        # (built at warm-up; None for non-retrieval algorithms — a
+        # status read must never trigger a build)
+        retrieval = [
+            m.retrieval_stats() if hasattr(m, "retrieval_stats") else None
+            for m in models
+        ]
         return {
             "status": "alive",
             "engineId": self.engine_id,
@@ -788,6 +796,7 @@ class EngineServer(HTTPServerBase):
             "admission": self.admission.snapshot(),
             "degraded": self.degraded_reason(),
             "storageCircuit": self._storage_breaker.snapshot(),
+            "retrieval": retrieval,
         }
 
 

@@ -11,6 +11,13 @@ Behavior contract from the reference template
   - Serving (Serving.scala:12-54): z-score standardize each algorithm's
     scores (skip when num == 1; stddev 0 -> score 0), sum scores of the
     same item across algorithms, return top-num.
+
+Candidate generation: exclusion-only queries (no whiteList/categories
+predicate) run through the model's ANN retrieval index
+(predictionio_tpu/index — exact Pallas dot+top-k, IVF CPU fallback via
+``PIO_INDEX_BACKEND``), built at deploy warm-up; predicate queries keep
+the masked on-device scorer. Same answers either way — the index's
+exact backend is pinned to the ``ops.topk`` scorer.
 """
 
 from __future__ import annotations

@@ -7,6 +7,23 @@ ALS. `twotower_hybrid_engine` runs BOTH algorithms and averages their
 scores at serve time — exercising the reference's multi-algorithm
 Serving contract (CreateServer.scala:472–475) with a deep + linear
 ensemble no Spark template could express on one engine's hardware.
+
+Retrieval queries (predictionio_tpu/index — candidate generation, not
+just scoring):
+
+  ``{"user": U, "num": k}``   user -> top-k items through the model's
+                              ANN index (exact Pallas dot+top-k on
+                              device, ``index_backend`` /
+                              ``PIO_INDEX_BACKEND`` select the IVF CPU
+                              fallback);
+  ``{"item": I, "num": k}``   item -> top-k similar items over the
+                              same index (cosine — tower outputs are
+                              L2-normalized); the hybrid engine's
+                              score-averaging Serving combines both
+                              algorithms' similar-item answers.
+
+Streamed ``POST /model/patch`` rows land in the index via ``upsert``,
+so fold-in freshness reaches retrieval without a ``/reload``.
 """
 
 from __future__ import annotations

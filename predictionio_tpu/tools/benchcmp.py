@@ -28,9 +28,10 @@ import re
 from typing import Dict, List, Optional, Tuple
 
 #: rate-shaped fragments where HIGHER is better — checked first so
-#: ``*_per_sec_per_chip`` is not misread as a duration
+#: ``*_per_sec_per_chip`` is not misread as a duration and
+#: ``retrieval_qps_recall95`` is not misread via nothing at all
 _HIGHER_BETTER = re.compile(r"(per_sec|_qps|qps$|throughput|mfu|"
-                            r"_per_chip|hit)")
+                            r"_per_chip|hit|recall)")
 #: metric-name fragments where a LOWER value is better
 _LOWER_BETTER = re.compile(r"(_ms$|_ms_|_sec$|_sec_|_seconds|latency|"
                            r"_bytes$|p50|p99|debt|rmse)")

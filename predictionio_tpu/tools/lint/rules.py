@@ -1,4 +1,4 @@
-"""graftlint rules JT01-JT13: the TPU hazards this codebase has hit.
+"""graftlint rules JT01-JT14: the TPU hazards this codebase has hit.
 
 Each rule encodes a failure class with a concrete precedent in this
 tree's history (the bf16-Gramian divergence behind JT03 is recorded in
@@ -1284,3 +1284,70 @@ class CopyInducingDeviceTransfer(Rule):
                     "contiguous ndarray (np.asarray/ascontiguousarray) "
                     "once and transfer that",
                 )
+
+
+# -- JT14 ----------------------------------------------------------------------
+
+@register
+class FullSortForTopK(Rule):
+    id = "JT14"
+    name = "full-sort-for-topk"
+    rationale = (
+        "argsort(...)[...:k] / sort(...)[...:k] pays a FULL O(n log n) "
+        "sort (and materializes the whole order) to keep k elements. "
+        "On serving and ops paths n is the catalog — np.argpartition "
+        "selects in O(n), and on device jax.lax.top_k is the fused "
+        "MXU-friendly form (the whole index subsystem's exact path is "
+        "built on it). The truncating slice is the tell: a full sort "
+        "whose result is immediately cut down never needed the total "
+        "order."
+    )
+
+    #: the hazard lives where per-query ranking happens; CLI/tooling
+    #: glue ranking a dozen rows is not worth the noise
+    def applies_to(self, abspath: str) -> bool:
+        return ("/ops/" in abspath or "/models/" in abspath
+                or "/serving/" in abspath or "/templates/" in abspath
+                or "/index/" in abspath)
+
+    _SORT_TAILS = {"argsort", "sort"}
+
+    def _is_full_sort(self, func: ast.AST) -> bool:
+        d = dotted(func)
+        if not d:
+            return False
+        head, _, tail = d.rpartition(".")
+        return (tail in self._SORT_TAILS
+                and head in _NP_MODULES + _JNP_MODULES)
+
+    @staticmethod
+    def _truncating_slice(sub: ast.Subscript) -> bool:
+        """A slice that keeps only part of the sorted axis: any Slice
+        element with a start or stop ([:k], [-k:], [1:], [:, :k]).
+        Pure step slices ([::-1], [::2]) reorder/stride the FULL
+        result — not the top-k pattern."""
+        sl = sub.slice
+        parts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+        for part in parts:
+            if isinstance(part, ast.Slice) and (
+                    part.lower is not None or part.upper is not None):
+                return True
+        return False
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Subscript):
+                continue
+            if not (isinstance(node.value, ast.Call)
+                    and self._is_full_sort(node.value.func)):
+                continue
+            if not self._truncating_slice(node):
+                continue
+            d = dotted(node.value.func)
+            yield Finding(
+                self.id, ctx.path, node.lineno, node.col_offset,
+                f"{d}(...) immediately truncated by a slice — a full "
+                "O(n log n) sort for a top-k answer; use "
+                "np.argpartition (host) or jax.lax.top_k (device) and "
+                "sort only the k survivors",
+            )

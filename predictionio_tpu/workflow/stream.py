@@ -47,6 +47,15 @@ Correctness stance (what fold-in is and is not):
     skipped, the cursor resets to the tail, and the operator should run
     a full retrain (the rolling-reload lane).
 
+Retrieval drift probe: every ``PIO_STREAM_RECALL_EVERY`` applied folds
+the updater measures recall@k of the PATCHED retrieval index (the same
+``upsert`` lane the serving patches ride) against brute force over the
+current factor tables, exporting ``pio_stream_index_recall``; a value
+below ``PIO_STREAM_RECALL_FLOOR`` logs and increments
+``pio_stream_recall_breaches_total`` — index drift is visible long
+before a full shadow-retrain harness (ROADMAP item D) exists to
+arbitrate it.
+
 Config (env):
   PIO_STREAM_INTERVAL_SEC   daemon poll cadence (default 1.0)
   PIO_STREAM_MAX_GROUP      max history rows re-solved per group (8192)
@@ -55,6 +64,10 @@ Config (env):
   PIO_STREAM_TT_LR          two-tower online step size (0.05)
   PIO_STREAM_TT_STEPS       two-tower SGD steps per fold (4)
   PIO_STREAM_PATCH_TIMEOUT  per-target HTTP patch timeout sec (10)
+  PIO_STREAM_RECALL_EVERY   applied folds between recall probes (20)
+  PIO_STREAM_RECALL_FLOOR   breach threshold for the probe (0.95)
+  PIO_STREAM_RECALL_SAMPLE  probe query sample size (16)
+  PIO_STREAM_RECALL_K       probe k (10)
 """
 
 from __future__ import annotations
@@ -99,6 +112,16 @@ _GROUPS_SKIPPED = metrics.counter(
     "beyond PIO_STREAM_MAX_GROUP; truncated = user history capped to "
     "the newest rows)",
     ("reason",),
+)
+_INDEX_RECALL = metrics.gauge(
+    "pio_stream_index_recall",
+    "Last measured recall@k of the patched retrieval index vs brute "
+    "force over the current factors (worst across fold-capable "
+    "algorithms)",
+)
+_RECALL_BREACHES = metrics.counter(
+    "pio_stream_recall_breaches_total",
+    "Recall probes that landed below PIO_STREAM_RECALL_FLOOR",
 )
 
 
@@ -550,6 +573,7 @@ class StreamUpdater:
         # instance binds — its own run_train publish reconciled the log
         if prev_instance_id is None or instance.id != prev_instance_id:
             self._staleness_debt = False
+        self._folds_since_probe = 0
 
     def resync(self) -> None:
         """Rebind to the newest COMPLETED instance (after a retrain or
@@ -661,7 +685,7 @@ class StreamUpdater:
             _FOLD_EVENTS.inc(len(users))
         else:
             _FOLDS.labels("patch_failed").inc()
-        return {
+        out = {
             "events": len(users),
             "rebased": False,
             "truncated": truncated,
@@ -670,6 +694,57 @@ class StreamUpdater:
             "published": published,
             "seconds": seconds,
         }
+        self._folds_since_probe += 1
+        if (self._folds_since_probe
+                >= metrics.env_int("PIO_STREAM_RECALL_EVERY", 20)):
+            self._folds_since_probe = 0
+            recall = self.probe_recall()
+            if recall is not None:
+                out["index_recall"] = recall
+        return out
+
+    # -- retrieval drift probe -----------------------------------------------
+    def probe_recall(self) -> Optional[float]:
+        """Recall@k of the PATCHED retrieval index against brute force
+        over the current factor tables — the minimal fold-in quality
+        gate (the carried-over ROADMAP item; item D's shadow retrain is
+        the full version). The local models' indexes ride the SAME
+        ``upsert_rows`` lane the serving patches do, so a fold that
+        corrupts index freshness shows here before users see it.
+        Returns the worst recall across fold-capable algorithms, or
+        None when nothing is probeable."""
+        from predictionio_tpu.index.recall import recall_at_k
+
+        sample_n = metrics.env_int("PIO_STREAM_RECALL_SAMPLE", 16)
+        k_cfg = metrics.env_int("PIO_STREAM_RECALL_K", 10)
+        rng = np.random.default_rng(0x5CA1E)
+        worst: Optional[float] = None
+        for folder in self._folders:
+            model = getattr(folder, "model", None)
+            if model is None or not hasattr(model, "retrieval_index"):
+                continue
+            n_users = len(model.user_ids)
+            n_items = len(model.item_ids)
+            if n_users == 0 or n_items == 0:
+                continue
+            rows = rng.choice(n_users, min(sample_n, n_users),
+                              replace=False)
+            recall = recall_at_k(
+                model.retrieval_index(), model.user_factors[rows],
+                min(k_cfg, n_items), vectors=model.item_factors)
+            worst = recall if worst is None else min(worst, recall)
+        if worst is None:
+            return None
+        _INDEX_RECALL.set(worst)
+        floor = metrics.env_float("PIO_STREAM_RECALL_FLOOR", 0.95)
+        if worst < floor:
+            _RECALL_BREACHES.inc()
+            log.warning(
+                "patched retrieval index recall@k %.3f fell below the "
+                "floor %.2f — the fold-in lane is drifting from the "
+                "factor tables; run a full retrain (rolling /reload)",
+                worst, floor)
+        return worst
 
     # -- patch delivery ------------------------------------------------------
     def _publish(self, blocks: List[dict]) -> bool:

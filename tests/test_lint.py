@@ -38,7 +38,7 @@ def rule_ids(findings):
 def test_all_rules_registered():
     assert {"JT01", "JT02", "JT03", "JT04", "JT05", "JT06",
             "JT07", "JT08", "JT09", "JT10", "JT11", "JT12",
-            "JT13"} <= set(RULES)
+            "JT13", "JT14"} <= set(RULES)
 
 
 def test_syntax_error_is_reported_not_raised(tmp_path):
@@ -1039,4 +1039,59 @@ def test_jt13_suppressible_with_justification(tmp_path):
         def put():
             return jax.device_put([0.0])  # graftlint: disable=JT13 — fixture: one-element warmup constant
     """, relpath="ops/m.py")
+    assert findings == []
+
+
+# -- JT14 full-sort-for-topk ---------------------------------------------------
+
+def test_jt14_positive_truncated_sorts(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import numpy as np
+        import jax.numpy as jnp
+
+        def rank(scores, mat, k):
+            a = np.argsort(-scores)[:k]
+            b = np.argsort(scores)[-k:]
+            c = jnp.sort(mat)[:, :k]
+            d = np.sort(scores)[1:]
+            return a, b, c, d
+    """, relpath="serving/mod.py")
+    assert rule_ids(findings) == ["JT14"] * 4
+    assert "argpartition" in findings[0].message
+
+
+def test_jt14_negative_full_order_and_partition(tmp_path):
+    # a FULL order (no truncation), pure step slices, argpartition and
+    # sorting only k survivors stay silent
+    findings = lint_src(tmp_path, """\
+        import numpy as np
+
+        def rank(scores, part, k):
+            full = np.argsort(scores)
+            rev = np.argsort(scores)[::-1]
+            sel = np.argpartition(-scores, k - 1)[:k]
+            order = np.argsort(-scores[sel])
+            return full, rev, sel, order
+    """, relpath="ops/mod.py")
+    assert findings == []
+
+
+def test_jt14_scoped_to_ranking_paths(tmp_path):
+    src = """\
+        import numpy as np
+
+        def rank(scores, k):
+            return np.argsort(-scores)[:k]
+    """
+    assert rule_ids(lint_src(tmp_path, src, relpath="index/m.py")) == ["JT14"]
+    assert lint_src(tmp_path, src, relpath="tools/m.py") == []
+
+
+def test_jt14_suppressible_with_justification(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import numpy as np
+
+        def rank(scores, k):
+            return np.argsort(-scores)[:k]  # graftlint: disable=JT14 — fixture: scores is a dozen rows
+    """, relpath="models/m.py")
     assert findings == []

@@ -1,0 +1,124 @@
+"""ops/topk edge-case pins (index-subsystem satellite).
+
+The brute-force scorer is the equivalence REFERENCE for the whole
+``predictionio_tpu/index`` subsystem (the exact Pallas backend and the
+IVF recall gate are both judged against it), so its edges — ``k >=
+n_items``, exclusion lists longer than ``max_exclude``, empty tables,
+empty batches — are pinned here on BOTH placement routes. The two
+routes must behave identically: the index falls back between them
+freely.
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.topk import NEG_INF, TopKScorer
+
+RNG = np.random.default_rng(7)
+FACTORS = RNG.normal(size=(7, 4)).astype(np.float32)
+USER = RNG.normal(size=(4,)).astype(np.float32)
+
+PLACEMENTS = ("host", "device")
+
+
+@pytest.mark.parametrize("placement", PLACEMENTS)
+def test_k_beyond_n_items_clamps(placement):
+    sc = TopKScorer(FACTORS, placement=placement)
+    scores, idx = sc.score(USER, 50)
+    assert scores.shape == (1, 7) and idx.shape == (1, 7)
+    # all 7 items present, ranked descending
+    assert sorted(idx[0].tolist()) == list(range(7))
+    assert np.all(np.diff(scores[0]) <= 1e-6)
+
+
+@pytest.mark.parametrize("placement", PLACEMENTS)
+def test_k_zero_and_empty_batch(placement):
+    sc = TopKScorer(FACTORS, placement=placement)
+    scores, idx = sc.score(USER, 0)
+    assert scores.shape == (1, 0) and idx.shape == (1, 0)
+    scores, idx = sc.score(np.zeros((0, 4), np.float32), 5)
+    assert scores.shape == (0, 5) and idx.shape == (0, 5)
+
+
+@pytest.mark.parametrize("placement", PLACEMENTS)
+def test_exclude_longer_than_max_drops_oldest_first(placement):
+    """The documented cap semantics: entries beyond ``max_exclude``
+    drop OLDEST first — the newest (rightmost) ids stay excluded."""
+    sc = TopKScorer(FACTORS, max_exclude=2, placement=placement)
+    excl = np.array([0, 1, 2, 3], np.int32)   # only 2, 3 survive the cap
+    scores, idx = sc.score(USER, 7, excl)
+    # with k == n_items every slot fills: excluded ids may appear, but
+    # only at NEG_INF — live candidates are the score-filtered set
+    kept = {int(i) for s, i in zip(scores[0], idx[0]) if s > float(NEG_INF)}
+    assert 2 not in kept and 3 not in kept
+    assert {0, 1} <= kept   # dropped-oldest ids are back in play
+
+
+@pytest.mark.parametrize("placement", PLACEMENTS)
+def test_out_of_range_and_negative_excludes_dropped(placement):
+    """Stale blacklists (catalog shrank) and -1 padding must be
+    silently dropped — identically on both routes."""
+    sc = TopKScorer(FACTORS, placement=placement)
+    base_s, base_i = sc.score(USER, 3)
+    s, i = sc.score(USER, 3, np.array([99, -5, -1], np.int32))
+    np.testing.assert_allclose(s, base_s, rtol=1e-6)
+    assert np.array_equal(i, base_i)
+
+
+@pytest.mark.parametrize("placement", PLACEMENTS)
+def test_empty_item_table(placement):
+    sc = TopKScorer(np.zeros((0, 4), np.float32), placement=placement)
+    scores, idx = sc.score(USER, 5)
+    assert scores.shape == (1, 0) and idx.shape == (1, 0)
+    # exclusions against an empty table must not crash either
+    scores, idx = sc.score(USER, 5, np.array([0, 3], np.int32))
+    assert scores.shape == (1, 0)
+
+
+@pytest.mark.parametrize("placement", PLACEMENTS)
+def test_single_item_table(placement):
+    sc = TopKScorer(FACTORS[:1], placement=placement)
+    scores, idx = sc.score(USER, 5)
+    assert scores.shape == (1, 1) and int(idx[0, 0]) == 0
+
+
+@pytest.mark.parametrize("placement", PLACEMENTS)
+def test_masked_fewer_candidates_than_k(placement):
+    """Unfillable slots come back at NEG_INF — the contract callers
+    (and the index subsystem) filter by."""
+    sc = TopKScorer(FACTORS, placement=placement)
+    mask = np.zeros(7, bool)
+    mask[2] = True
+    scores, idx = sc.score_masked(USER, 3, mask)
+    assert int(idx[0, 0]) == 2 and scores[0, 0] > float(NEG_INF)
+    assert np.all(scores[0, 1:] <= float(NEG_INF))
+
+
+def test_host_tie_order_is_deterministic_and_matches_device():
+    """Exact ties rank by LOWEST item index on both routes (lax.top_k's
+    documented preference; the host route canonicalizes the partition
+    before its stable sort) — ties away from the k-th boundary, where
+    membership itself is determined."""
+    dominant = (USER / np.linalg.norm(USER)).astype(np.float32)
+    table = 0.01 * FACTORS
+    table = np.vstack([table[:2], 5.0 * dominant[None, :], table[2:],
+                       5.0 * dominant[None, :]])   # rows 2 and 8 tie on top
+    host_s, host_i = TopKScorer(table, placement="host").score(USER, 4)
+    dev_s, dev_i = TopKScorer(table, placement="device").score(USER, 4)
+    assert host_i[0, 0] == dev_i[0, 0] == 2   # lowest tied index first
+    assert host_i[0, 1] == dev_i[0, 1] == 8
+    np.testing.assert_allclose(host_s, dev_s, rtol=1e-5, atol=1e-6)
+
+
+def test_host_and_device_routes_agree():
+    """No-ties random data: both routes return identical rankings (the
+    index backend falls back between them freely, so they must be
+    interchangeable)."""
+    users = RNG.normal(size=(5, 4)).astype(np.float32)
+    excl = np.array([[1, 4], [-1, -1], [0, 2], [6, -1], [3, 3]], np.int32)
+    host = TopKScorer(FACTORS, placement="host")
+    dev = TopKScorer(FACTORS, placement="device")
+    hs, hi = host.score(users, 4, excl)
+    ds, di = dev.score(users, 4, excl)
+    np.testing.assert_allclose(hs, ds, rtol=1e-5, atol=1e-6)
+    assert np.array_equal(hi, di)
