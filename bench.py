@@ -818,6 +818,119 @@ def _stream_stage(storage, engine, server, item_ids, detail):
         "warm_events_to_model_sec instead")
 
 
+def stage_quality(base_dir, out_path):
+    """Model-quality observability stage (ROADMAP item D): prices the
+    continuous-evaluation plane on the bench's trained instance —
+
+      quality_recall_vs_retrain  the shadow-drift probe's recall after
+                                 a real fold cycle (the gate value the
+                                 stream daemon exports continuously;
+                                 benchcmp: "recall" = higher-better)
+      quality_probe_ms           wall cost of one drift probe (the
+                                 per-cycle tax of continuous eval)
+      replay_mean_overlap        the replay harness end-to-end on
+                                 captured live payloads (self-replay:
+                                 must stay 1.0)
+      replay_ms_per_query        replay throughput tax per query
+      canary_verdict_ms          wall cost of rendering one canary
+                                 promote/rollback verdict from paired
+                                 stats + lane histograms (benchcmp:
+                                 "_ms" = lower-better)
+    """
+    import urllib.request
+
+    from predictionio_tpu.data.storage import set_storage
+    from predictionio_tpu.obs import quality
+    from predictionio_tpu.serving.engine_server import EngineServer
+    from predictionio_tpu.templates.recommendation import recommendation_engine
+    from predictionio_tpu.workflow import replay as replay_mod
+    from predictionio_tpu.workflow.stream import StreamUpdater
+
+    os.environ["PIO_FLIGHT_PAYLOADS"] = "128"
+    storage = _storage(base_dir)
+    detail = {}
+    engine = recommendation_engine()
+    server = EngineServer(
+        engine, "bench_reco", host="127.0.0.1", port=0, storage=storage,
+    ).start()
+    try:
+        import datetime as dt
+
+        from predictionio_tpu.data.event import Event
+
+        app = storage.apps().get_by_name("bench")
+        item_ids = server.deployment.models[0].item_ids
+        inv_items = item_ids.inverse()
+        updater = StreamUpdater(engine, "bench_reco", storage=storage,
+                                patch_servers=[server])
+        # one real fold so the drift probe prices the live lane, not a
+        # trivially-identical snapshot
+        events = [Event(event="rate", entity_type="user",
+                        entity_id=f"q_u{k % 16}",
+                        target_entity_type="item",
+                        target_entity_id=inv_items[k % 8],
+                        properties={"rating": 4.0},
+                        event_time=dt.datetime.now(tz=dt.timezone.utc))
+                  for k in range(64)]
+        storage.events().insert_batch(events, app.id)
+        stats = updater.poll_once()
+        assert stats["published"], stats
+        t0 = time.perf_counter()
+        report = updater.probe_quality()
+        detail["quality_probe_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 2)
+        detail["quality_recall_vs_retrain"] = report["recall_vs_retrain"]
+        detail["quality_rmse_drift"] = report["rmse_drift"]
+
+        # replay: capture real payloads through the live HTTP lane,
+        # then replay them (self-replay — overlap gates at 1.0)
+        rng = np.random.default_rng(17)
+        users = [f"q_u{int(u)}" for u in rng.integers(0, 16, size=32)]
+        for user in users:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/queries.json",
+                data=json.dumps({"user": user, "num": 5}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                resp.read()
+        from predictionio_tpu.obs import flight
+
+        payloads = flight.RECORDER.payloads()
+        assert payloads, "payload capture recorded nothing"
+        target = replay_mod.server_target(server)
+        t0 = time.perf_counter()
+        rep = replay_mod.replay(payloads, target, target)
+        replay_sec = time.perf_counter() - t0
+        detail["replay_mean_overlap"] = rep["mean_overlap"]
+        detail["replay_ms_per_query"] = round(
+            replay_sec / max(1, rep["n"]) * 1e3, 3)
+
+        # canary verdict: realistic paired stats + lane histograms,
+        # then the verdict math end to end
+        quality.STATE.canary_begin("bench_r1", "base_inst", "cand_inst")
+        lat = rng.lognormal(-5.0, 0.4, size=512)
+        for v in lat:
+            quality.CANARY_SECONDS.labels("baseline").observe(float(v))
+            quality.CANARY_SECONDS.labels("canary").observe(float(v * 1.1))
+        for _ in range(256):
+            quality.STATE.add_paired({"overlap": 0.9, "score_delta": 0.01})
+        t0 = time.perf_counter()
+        for _ in range(10):
+            verdict = quality.STATE.canary_verdict()
+        detail["canary_verdict_ms"] = round(
+            (time.perf_counter() - t0) / 10 * 1e3, 3)
+        detail["canary_verdict_note"] = (
+            "verdict render over 256 paired samples + 2x512-observation "
+            "lane histograms; verdict=" + verdict["verdict"])
+        quality.STATE.canary_end("bench_done", None)
+    finally:
+        server.stop()
+    storage.events().close()
+    set_storage(None)
+    with open(out_path, "w") as f:
+        json.dump(detail, f)
+
+
 def stage_retrieval(base_dir, out_path):
     """Candidate-generation stage (index subsystem): build the ANN
     indexes over the trained bench model's item factors, then sweep
@@ -1714,6 +1827,12 @@ def emit_headline(detail, detail_path=None):
         # benchcmp) + its build cost (_sec = lower-better)
         "retrieval_qps_recall95": detail.get("retrieval_qps_recall95"),
         "index_build_sec": detail.get("index_build_sec"),
+        # model-quality plane (ROADMAP item D): the drift probe's
+        # recall-vs-retrain (benchcmp: "recall" = higher-better) and
+        # the canary verdict's render cost ("_ms" = lower-better)
+        "quality_recall_vs_retrain": detail.get(
+            "quality_recall_vs_retrain"),
+        "canary_verdict_ms": detail.get("canary_verdict_ms"),
     }
     if "twotower" in detail:
         tt = detail["twotower"]
@@ -1761,8 +1880,11 @@ def orchestrate():
     try:
         stages = {}
         # stream stays LAST (it appends events — see stage_stream);
-        # retrieval only READS the cold stage's trained instance
-        for stage in ("cold", "warm", "twotower", "retrieval", "stream"):
+        # retrieval only READS the cold stage's trained instance;
+        # quality appends a small fold batch, so it runs after warm
+        # (whose unchanged-data fast path the appends would evict)
+        for stage in ("cold", "warm", "twotower", "retrieval", "quality",
+                      "stream"):
             out = os.path.join(base_dir, f"{stage}.json")
             # child stdout -> our stderr: the stdout contract is ONE line
             proc = subprocess.run(
@@ -1779,10 +1901,13 @@ def orchestrate():
         detail = stages["cold"]
         detail["warm"] = stages["warm"]
         detail["twotower"] = stages["twotower"]
-        # stream/retrieval keys land at top level: emit_headline reads
-        # detail["event_to_servable_ms"] / ["retrieval_qps_recall95"] /
-        # ["index_build_sec"] / ["foldin_events_per_sec"]
+        # stream/retrieval/quality keys land at top level: emit_headline
+        # reads detail["event_to_servable_ms"] /
+        # ["retrieval_qps_recall95"] / ["index_build_sec"] /
+        # ["foldin_events_per_sec"] / ["quality_recall_vs_retrain"] /
+        # ["canary_verdict_ms"]
         detail.update(stages["retrieval"])
+        detail.update(stages["quality"])
         detail.update(stages["stream"])
         print(json.dumps(emit_headline(detail)))
     finally:
@@ -1793,7 +1918,8 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--stage",
                         choices=["cold", "warm", "twotower", "retrieval",
-                                 "stream", "parse_profile", "loadgen"])
+                                 "quality", "stream", "parse_profile",
+                                 "loadgen"])
     parser.add_argument("--base")
     parser.add_argument("--out")
     args = parser.parse_args()
@@ -1805,6 +1931,8 @@ def main() -> None:
         stage_twotower(args.base, args.out)
     elif args.stage == "retrieval":
         stage_retrieval(args.base, args.out)
+    elif args.stage == "quality":
+        stage_quality(args.base, args.out)
     elif args.stage == "stream":
         stage_stream(args.base, args.out)
     elif args.stage == "parse_profile":

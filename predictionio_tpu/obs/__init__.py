@@ -35,9 +35,16 @@ PR), one registry:
   obs.timeline — bounded in-process metric time-series rings behind
                  ``GET /admin/timeline``, the dashboard sparklines and
                  ``pio top``
+  obs.quality  — the model-quality plane: drift-vs-shadow-retrain
+                 gauges, the replay/canary answer differ, and the
+                 canary promote/rollback verdict behind
+                 ``GET /admin/quality``, ``pio canary`` and the
+                 dashboard ``/quality`` panel (imported lazily: it
+                 pulls numpy)
 
 Import cost is stdlib-only; jax is touched lazily inside jaxmon,
-profiler, perfacct's cost-analysis helpers and the health device probe.
+profiler, perfacct's cost-analysis helpers and the health device probe
+(and obs.quality — the numpy-using drift math — loads on first use).
 """
 
 from predictionio_tpu.obs import (flight, health, jaxmon, metrics, perfacct,
@@ -67,8 +74,17 @@ __all__ = [
     "perfacct",
     "profiler",
     "push",
+    "quality",
     "slo",
     "span",
     "timeline",
     "trace",
 ]
+
+
+def __getattr__(name):
+    if name == "quality":
+        import importlib
+
+        return importlib.import_module("predictionio_tpu.obs.quality")
+    raise AttributeError(name)

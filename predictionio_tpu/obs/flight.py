@@ -26,6 +26,15 @@ The whole dump is served as JSON by ``GET /admin/flight`` on every PIO
 server (serving/http.py routes it, like ``/metrics``) and by
 ``pio flight --url ...``.
 
+Beyond the per-request records, the recorder optionally captures the
+QUERY PAYLOADS themselves (``PIO_FLIGHT_PAYLOADS`` > 0): a bounded ring
+of the last N ``/queries.json`` bodies (each capped at
+``PIO_FLIGHT_PAYLOAD_BYTES``), the raw material the replay harness
+(workflow/replay.py) re-plays against a candidate instance. Payloads
+are user data — ``GET /admin/flight`` serves them ONLY when an admin
+token is configured and presented; with no token set the dump carries
+the capture counts but never the bodies.
+
 Config (all env):
   PIO_FLIGHT_CAPACITY        ring size (default 256 records)
   PIO_SLOW_MS                slow-request threshold in ms (default 1000;
@@ -36,6 +45,10 @@ Config (all env):
                              64; oldest evicted first)
   PIO_FLIGHT_MAX_DUMP_BYTES  total bytes of dump files kept (default
                              64 MiB; oldest evicted first)
+  PIO_FLIGHT_PAYLOADS        query payloads captured for replay
+                             (default 0 = capture off)
+  PIO_FLIGHT_PAYLOAD_BYTES   per-payload size cap (default 4096;
+                             oversized payloads are skipped, counted)
 """
 
 from __future__ import annotations
@@ -93,6 +106,20 @@ _NEGATIVE_REMAINDER_TOLERANCE_MS = 0.01
 
 DEFAULT_MAX_DUMPS = 64
 DEFAULT_MAX_DUMP_BYTES = 64 * 1024 * 1024
+
+DEFAULT_PAYLOAD_BYTES = 4096
+
+_PAYLOADS_SKIPPED = metrics.counter(
+    "pio_flight_payloads_skipped_total",
+    "Query payloads not captured because they exceeded "
+    "PIO_FLIGHT_PAYLOAD_BYTES",
+)
+
+
+def payload_capacity() -> int:
+    """The PIO_FLIGHT_PAYLOADS capture size (0 = off; read per call so
+    env changes and test monkeypatching take effect immediately)."""
+    return max(0, metrics.env_int("PIO_FLIGHT_PAYLOADS", 0))
 
 
 def _enforce_dump_caps(out_dir: str) -> None:
@@ -212,7 +239,12 @@ class FlightRecorder:
         self._snapshots: "collections.deque[Dict[str, Any]]" = (
             collections.deque(maxlen=SNAPSHOT_CAPACITY))
         self._snapshot_interval = snapshot_interval
-        self._last_snapshot = 0.0
+        self._last_snapshot = 0.0   # monotonic: a cadence, not a timestamp
+        #: captured query payloads for the replay harness (opt-in via
+        #: PIO_FLIGHT_PAYLOADS; the deque is re-bounded on capacity
+        #: changes at capture time)
+        self._payloads: "collections.deque[Dict[str, Any]]" = (
+            collections.deque(maxlen=1))
         self._keys = itertools.count(1)
         # open records, insertion-ordered (dict preserves order): the
         # oldest open record for a trace id is the edge request
@@ -314,12 +346,16 @@ class FlightRecorder:
         outcome = "error" if error is not None else (
             "slow" if slow else "ok")
         _RECORDS_TOTAL.labels(outcome).inc()
-        now = time.time()
+        # the cadence is a DURATION between snapshots: measured on the
+        # monotonic clock (JT15) — an NTP step must not stall or storm
+        # the snapshot (and every listener riding it); the snapshot's
+        # own ts stays wall time, it is a record, not a measurement
+        now_mono = time.monotonic()
         snap = None
         with self._lock:
-            if now - self._last_snapshot >= self._snapshot_interval:
-                self._last_snapshot = now
-                snap = {"ts": round(now, 3)}
+            if now_mono - self._last_snapshot >= self._snapshot_interval:
+                self._last_snapshot = now_mono
+                snap = {"ts": round(time.time(), 3)}
             self._ring.append(record)
         if snap is not None:
             # registry walk outside the ring lock (it takes family locks)
@@ -345,6 +381,46 @@ class FlightRecorder:
             self._dump_on_error(record)
         return record
 
+    # -- query-payload capture (replay's raw material) ----------------------
+    def record_payload(self, route: str, payload: Any,
+                       nbytes: Optional[int] = None) -> bool:
+        """Capture one query payload for later replay (no-op while
+        PIO_FLIGHT_PAYLOADS is 0). ``nbytes`` is the serialized size
+        the caller already knows (the request body length) — payloads
+        over PIO_FLIGHT_PAYLOAD_BYTES are skipped and counted, so one
+        megabyte query cannot crowd out the ring or bloat the dump."""
+        cap = payload_capacity()
+        if cap <= 0:
+            return False
+        limit = max(1, metrics.env_int("PIO_FLIGHT_PAYLOAD_BYTES",
+                                       DEFAULT_PAYLOAD_BYTES))
+        if nbytes is None:
+            try:
+                nbytes = len(json.dumps(payload))
+            except (TypeError, ValueError):
+                return False
+        if nbytes > limit:
+            _PAYLOADS_SKIPPED.inc()
+            return False
+        entry = {"ts": round(time.time(), 3), "route": route,
+                 "payload": payload}
+        with self._lock:
+            ring = self._payloads
+            if ring.maxlen != cap:
+                ring = collections.deque(ring, maxlen=cap)
+                self._payloads = ring
+            ring.append(entry)
+        return True
+
+    def payloads(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        """The captured query payloads, oldest first (``n`` newest when
+        given)."""
+        with self._lock:
+            out = list(self._payloads)
+        if n is None:
+            return out
+        return out[-n:] if n > 0 else []
+
     # -- reading ------------------------------------------------------------
     def records(self, n: Optional[int] = None,
                 slow_only: bool = False) -> List[Dict[str, Any]]:
@@ -363,21 +439,36 @@ class FlightRecorder:
         with self._lock:
             return list(self._snapshots)
 
-    def dump(self, n: Optional[int] = None,
-             slow_only: bool = False) -> Dict[str, Any]:
-        """The full flight dump (what ``GET /admin/flight`` serves)."""
-        return {
+    def dump(self, n: Optional[int] = None, slow_only: bool = False,
+             include_payloads: bool = False) -> Dict[str, Any]:
+        """The full flight dump (what ``GET /admin/flight`` serves).
+
+        Captured query payloads are USER DATA: they ride along only
+        when the caller says so (the admin route includes them exactly
+        when a bearer token is configured AND was presented); otherwise
+        the dump carries the capture counts, never the bodies."""
+        captured = self.payloads()
+        out = {
             "capacity": self.capacity,
             "slow_threshold_ms": slow_threshold_ms(),
             "records": self.records(n, slow_only=slow_only),
             "metric_snapshots": self.snapshots(),
+            "payload_capture": {
+                "capacity": payload_capacity(),
+                "captured": len(captured),
+                "included": bool(include_payloads),
+            },
         }
+        if include_payloads:
+            out["payloads"] = captured
+        return out
 
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
             self._snapshots.clear()
             self._open.clear()
+            self._payloads.clear()
 
     # -- error dumps --------------------------------------------------------
     def _dump_on_error(self, record: Dict[str, Any]) -> None:
@@ -430,3 +521,8 @@ def note_stage(stage: str, seconds: float,
 def note_field(name: str, value: Any,
                trace_id: Optional[str] = None) -> None:
     RECORDER.note_field(name, value, trace_id)
+
+
+def record_payload(route: str, payload: Any,
+                   nbytes: Optional[int] = None) -> bool:
+    return RECORDER.record_payload(route, payload, nbytes)

@@ -321,7 +321,7 @@ class SLOMonitor:
         Rate-limited so the cadence hook and on-read ticks coexist."""
         now = time.time() if now is None else now
         with self._lock:
-            if now - self._last_tick < MIN_SAMPLE_SPACING_SEC:
+            if now - self._last_tick < MIN_SAMPLE_SPACING_SEC:  # graftlint: disable=JT15 — the spacing check must read the SAME injectable clock the burn-window samples are stamped with (tests drive synthetic now); a second monotonic clock would let cadence and series disagree
                 return
             self._last_tick = now
             slos = list(self._slos.values())

@@ -19,7 +19,10 @@ watching.
 Default series: per-model MFU (``mfu.<model>``), model staleness
 (``staleness_sec``), serving p50/p99 per engine
 (``serve_p50_ms.<engine>`` / ``serve_p99_ms.<engine>``), the HTTP
-request rate (``http_rps``) and in-flight count (``inflight``).
+request rate (``http_rps``), in-flight count (``inflight``), and the
+model-quality drift gauges (``quality.recall`` /
+``quality.rmse_drift`` — obs/quality.py's recall-vs-retrain and
+normalized rmse drift, the dashboard ``/quality`` sparklines).
 
 Config (all env, read per sample so tests can monkeypatch):
   PIO_TIMELINE_INTERVAL_SEC   minimum spacing between samples
@@ -153,6 +156,12 @@ def default_collectors() -> List[Collector]:
                            "serve_p99_ms", scale=1e3),
         rate_collector("pio_http_requests_total", "http_rps"),
         gauge_collector("pio_http_requests_in_flight", "inflight"),
+        # model-quality drift vs the shadow retrain (obs/quality.py):
+        # the dashboard /quality panel's sparklines ride these
+        gauge_collector("pio_model_quality_recall_vs_retrain",
+                        "quality.recall"),
+        gauge_collector("pio_model_quality_rmse_drift",
+                        "quality.rmse_drift"),
     ]
 
 
@@ -207,7 +216,7 @@ class Timeline:
         must not stop the others' history."""
         now = time.time() if now is None else now
         with self._lock:
-            if not force and now - self._last_sample < self.interval_sec():
+            if not force and now - self._last_sample < self.interval_sec():  # graftlint: disable=JT15 — cadence and ring timestamps must share the injectable clock (tests drive synthetic now); splitting them onto monotonic would desynchronize spacing from the recorded ts
                 return False
             self._last_sample = now
             collectors = list(self._collectors)

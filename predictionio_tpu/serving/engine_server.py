@@ -541,10 +541,22 @@ class EngineServer(HTTPServerBase):
         super().__init__(host, port, _EngineRequestHandler, bind_retries=bind_retries)
 
     # -- deployment management ----------------------------------------------
-    def _load_latest(self) -> Deployment:
-        instance = self.storage.engine_instances().get_latest_completed(
-            self.engine_id, self.engine_version, self.engine_variant
-        )
+    def _load_latest(self, instance_id: Optional[str] = None) -> Deployment:
+        """Build a warm deployment of the latest COMPLETED instance —
+        or of a SPECIFIC completed instance when ``instance_id`` names
+        one (the canary rollback lane: the fleet swaps its canary
+        replica back onto the baseline instance, not onto "latest",
+        which IS the candidate being rolled back)."""
+        if instance_id:
+            instance = self.storage.engine_instances().get(instance_id)
+            if instance is None or instance.status != "COMPLETED":
+                raise RuntimeError(
+                    f"engine instance {instance_id} not found or not "
+                    "COMPLETED")
+        else:
+            instance = self.storage.engine_instances().get_latest_completed(
+                self.engine_id, self.engine_version, self.engine_variant
+            )
         if instance is None:
             raise RuntimeError(
                 f"No valid engine instance found for engine {self.engine_id} "
@@ -568,8 +580,10 @@ class EngineServer(HTTPServerBase):
                 log.exception("warmup failed for %s", type(algo).__name__)
         log.info("serve warm-up done in %.2fs", time.perf_counter() - t0)
 
-    def reload(self) -> str:
-        """Hot-swap to the latest completed instance (ref: /reload :592).
+    def reload(self, instance_id: Optional[str] = None) -> str:
+        """Hot-swap to the latest completed instance (ref: /reload :592)
+        — or to the specific completed instance ``instance_id`` names
+        (``GET /reload?instance=<id>``, the canary rollback lane).
         The swap happens only after the new deployment is warm — live
         traffic never waits on the new model's compiles. A reload that
         fails on storage feeds the degraded-mode circuit; one that
@@ -577,7 +591,7 @@ class EngineServer(HTTPServerBase):
         from predictionio_tpu.data.storage import StorageError
 
         try:
-            deployment = self._load_latest()
+            deployment = self._load_latest(instance_id)
         except (StorageError, ConnectionError):
             self._storage_breaker.record_failure()
             raise
@@ -858,8 +872,12 @@ class _EngineRequestHandler(JSONRequestHandler):
             else:
                 self._send(200, status)
         elif path == "/reload":
+            from urllib.parse import parse_qs
+
+            target = (parse_qs(urlparse(self.path).query)
+                      .get("instance") or [None])[0]
             try:
-                instance_id = self.server_ref.reload()
+                instance_id = self.server_ref.reload(target)
                 self._send(200, {"message": "reloaded", "engineInstanceId": instance_id})
             except RuntimeError as e:
                 self.server_ref.remote_log(f"reload failed: {e}")
@@ -897,6 +915,11 @@ class _EngineRequestHandler(JSONRequestHandler):
             except json.JSONDecodeError as e:
                 self._send(400, {"message": f"invalid JSON: {e}"})
                 return
+            # opt-in replay capture (PIO_FLIGHT_PAYLOADS): the byte cap
+            # reuses the Content-Length the read already knew
+            flight.record_payload(
+                "/queries.json", payload,
+                nbytes=int(self.headers.get("Content-Length") or 0))
             try:
                 result = self.server_ref.query(payload)
             except (KeyError, TypeError, ValueError) as e:

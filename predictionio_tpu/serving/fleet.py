@@ -26,6 +26,21 @@ story (serving/router.py is the routing half):
              the in-replica swap, serving/engine_server.py), rejoin —
              live traffic never waits on a compile and the fleet never
              drops below N-1 ready replicas
+  canary     :meth:`FleetSupervisor.start_canary` puts the newest
+             COMPLETED instance on EXACTLY ONE replica through the
+             same drain→reload→rejoin machinery; the router then tags
+             per-lane latency histograms and samples paired answers
+             (serving/router.py), obs/quality.py renders the
+             promote/rollback verdict, and the supervisor acts on it
+             automatically (``PIO_CANARY_AUTO``, default on): promote
+             = rolling-swap the rest of the fleet onto the candidate,
+             rollback = swap the canary replica BACK onto the baseline
+             instance (``GET /reload?instance=<baseline>``). With
+             ``canary_mode`` (``pio deploy --canary`` /
+             ``PIO_FLEET_CANARY=1``) the auto-swap watch starts a
+             canary instead of a full rolling swap when a new
+             COMPLETED instance lands — train-to-serving with a
+             quality gate and no operator in the loop.
 
 Observability: ``pio_fleet_replica_up{replica}``,
 ``pio_fleet_replica_version{replica,version}``,
@@ -53,6 +68,7 @@ import sys
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional
 
@@ -342,6 +358,7 @@ class FleetSupervisor:
         restart_policy: Optional[Policy] = None,
         version_source: Optional[Callable[[], Optional[str]]] = None,
         backoff: Optional[Callable[[int], float]] = None,
+        canary_mode: Optional[bool] = None,
     ):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
@@ -366,6 +383,14 @@ class FleetSupervisor:
         self._state_lock = threading.Lock()
         self._swap: Dict[str, Any] = {"active": False, "last": None}
         self._last_watch = 0.0
+        #: None = read PIO_FLEET_CANARY at watch time; explicit bool =
+        #: `pio deploy --canary` / tests
+        self._canary_mode = canary_mode
+        self._canary: Dict[str, Any] = {"active": False, "last": None}
+        self._canary_thread: Optional[threading.Thread] = None
+        #: hot-path copy of the active canary replica's name (plain
+        #: attribute read — the router checks it on every answer)
+        self._canary_name: Optional[str] = None
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "FleetSupervisor":
@@ -381,6 +406,7 @@ class FleetSupervisor:
 
     def stop(self) -> None:
         self._stop_evt.set()
+        self._canary_name = None  # routers must stop shadow-sampling now
         if self._monitor is not None:
             self._monitor.join(timeout=10)
         # signal everyone first (subprocess drains run in PARALLEL —
@@ -433,6 +459,7 @@ class FleetSupervisor:
                         return
                     self._tick(replica)
                 self._maybe_auto_swap()
+                self._maybe_canary_decision()
                 _READY_GAUGE.set(float(self.ready_count()))
             except Exception:  # noqa: BLE001 — the supervisor dying
                 # silently IS the outage this module exists to prevent
@@ -636,7 +663,6 @@ class FleetSupervisor:
     def _rolling_reload_locked(self) -> Dict[str, Any]:
         swapped: List[str] = []
         errors: List[str] = []
-        window = drain_timeout()
         for replica in list(self.replicas):
             if self._stop_evt.is_set():
                 errors.append("fleet stopping")
@@ -651,44 +677,11 @@ class FleetSupervisor:
                 errors.append(f"{replica.name}: operator-drained; "
                               "skipped")
                 continue
-            # hold the N-1 floor: every OTHER live replica must be
-            # back in rotation before this one leaves it
-            if not self._await_others_ready(replica, timeout=60.0):
-                errors.append(f"{replica.name}: fleet never converged "
-                              "to ready before drain")
+            outcome = self._swap_one(replica, errors)
+            if outcome == "abort":
                 break
-            # _await_others_ready converges VACUOUSLY when every peer
-            # is DEAD/STOPPED — draining the last ready replica would
-            # take the fleet to zero for a whole reload+warm window.
-            # Skip it; dead peers boot onto the new version anyway.
-            if not any(p.state == READY for p in self.replicas
-                       if p is not replica):
-                errors.append(f"{replica.name}: only ready replica — "
-                              "refusing to drain the fleet to zero")
-                continue
-            self._set_state(replica, DRAINING)
-            if not self._await(lambda: replica.outstanding() == 0,
-                               timeout=window):
-                errors.append(f"{replica.name}: drain window expired "
-                              f"with {replica.outstanding()} in flight")
-                # proceed anyway: the replica keeps answering its
-                # stragglers from the OLD model while it reloads
-            status, body = self._reload(replica)
-            if status != 200:
-                errors.append(f"{replica.name}: reload answered "
-                              f"{status}: {body}")
-                # re-enter rotation on the old model: a failed swap
-                # must degrade to "stale replica", never "lost replica"
-                self._set_state(replica, EVICTED, deliberate=True)
-                self.probe_and_update(replica)
-                continue
-            self._refresh_version(replica)
-            self._set_state(replica, EVICTED, deliberate=True)
-            if not self._await(lambda: replica.state == READY,
-                               timeout=60.0, probe=replica):
-                errors.append(f"{replica.name}: not ready after reload")
-                continue
-            swapped.append(replica.name)
+            if outcome == "swapped":
+                swapped.append(replica.name)
         return {
             "outcome": "ok" if not errors else "partial",
             "swapped": swapped,
@@ -697,11 +690,65 @@ class FleetSupervisor:
             "finished_unix": round(time.time(), 3),
         }
 
-    def _reload(self, replica: Replica):
+    def _swap_one(self, replica: Replica, errors: List[str],
+                  instance_id: Optional[str] = None) -> str:
+        """Drain→reload→rejoin ONE replica under the fleet's N-1 floor
+        guards — the shared core of the rolling swap and the canary
+        lane (``instance_id`` targets a specific completed instance,
+        the canary rollback). Appends operator-facing error strings;
+        returns "swapped", "skip" (this replica failed/was skipped but
+        siblings may proceed) or "abort" (the fleet never converged —
+        nothing later can safely drain either)."""
+        # hold the N-1 floor: every OTHER live replica must be
+        # back in rotation before this one leaves it
+        if not self._await_others_ready(replica, timeout=60.0):
+            errors.append(f"{replica.name}: fleet never converged "
+                          "to ready before drain")
+            return "abort"
+        # _await_others_ready converges VACUOUSLY when every peer
+        # is DEAD/STOPPED — draining the last ready replica would
+        # take the fleet to zero for a whole reload+warm window.
+        # Skip it; dead peers boot onto the new version anyway.
+        if not any(p.state == READY for p in self.replicas
+                   if p is not replica):
+            errors.append(f"{replica.name}: only ready replica — "
+                          "refusing to drain the fleet to zero")
+            return "skip"
+        self._set_state(replica, DRAINING)
+        if not self._await(lambda: replica.outstanding() == 0,
+                           timeout=drain_timeout()):
+            errors.append(f"{replica.name}: drain window expired "
+                          f"with {replica.outstanding()} in flight")
+            # proceed anyway: the replica keeps answering its
+            # stragglers from the OLD model while it reloads
+        status, body = self._reload(replica, instance_id)
+        if status != 200:
+            errors.append(f"{replica.name}: reload answered "
+                          f"{status}: {body}")
+            # re-enter rotation on the old model: a failed swap
+            # must degrade to "stale replica", never "lost replica"
+            self._set_state(replica, EVICTED, deliberate=True)
+            self.probe_and_update(replica)
+            return "skip"
+        self._refresh_version(replica)
+        self._set_state(replica, EVICTED, deliberate=True)
+        if not self._await(lambda: replica.state == READY,
+                           timeout=60.0, probe=replica):
+            errors.append(f"{replica.name}: not ready after reload")
+            return "skip"
+        return "swapped"
+
+    def _reload(self, replica: Replica,
+                instance_id: Optional[str] = None):
         """One replica's ``GET /reload`` — generous timeout: the warm
-        compile is exactly what we drained the replica to hide."""
+        compile is exactly what we drained the replica to hide. With
+        ``instance_id``, the replica reloads that SPECIFIC completed
+        instance (``?instance=`` — the canary rollback lane)."""
         try:
-            req = urllib.request.Request(f"{replica.base_url}/reload")
+            url = f"{replica.base_url}/reload"
+            if instance_id:
+                url += "?instance=" + urllib.parse.quote(instance_id)
+            req = urllib.request.Request(url)
             reload_timeout = metrics.env_float(
                 "PIO_FLEET_RELOAD_TIMEOUT", 300.0)
             with urllib.request.urlopen(req, timeout=reload_timeout) as resp:
@@ -773,6 +820,16 @@ class FleetSupervisor:
                 return False
             if self._swap.get("active"):
                 return False
+            if self._canary.get("active") or (
+                    self._canary_thread is not None
+                    and self._canary_thread.is_alive()):
+                # rolling everything would silently promote the
+                # candidate — including during the DEPLOY window, where
+                # _canary["active"] is still False but the canary
+                # thread is mid-drain/reload; the canary verdict (or an
+                # explicit promote/rollback) owns leaving the canary
+                # state
+                return False
             if (self._swap_thread is not None
                     and self._swap_thread.is_alive()):
                 return False
@@ -791,10 +848,229 @@ class FleetSupervisor:
                 self._swap = {"active": False,
                               "last": {"outcome": "crashed"}}
 
+    # -- canary lane ---------------------------------------------------------
+    def canary_mode(self) -> bool:
+        """Whether a new COMPLETED instance should land as a CANARY
+        (one replica + verdict) instead of a full rolling swap."""
+        if self._canary_mode is not None:
+            return self._canary_mode
+        return metrics.env_int("PIO_FLEET_CANARY", 0) > 0
+
+    def canary(self) -> Dict[str, Any]:
+        with self._state_lock:
+            return dict(self._canary)
+
+    def canary_replica_name(self) -> Optional[str]:
+        """The active canary replica's name, or None — the router's
+        hot-path check (a plain attribute read, no lock)."""
+        return self._canary_name
+
+    def start_canary(self) -> bool:
+        """Kick a canary deploy on a background thread: the newest
+        COMPLETED instance lands on exactly ONE replica through the
+        drain→reload→rejoin machinery; the router then tags lanes and
+        samples paired answers until a verdict (auto or operator)
+        promotes or rolls back. False when a swap or canary is already
+        running (or the fleet is stopping)."""
+        with self._state_lock:
+            if self._stop_evt.is_set():
+                return False
+            if self._swap.get("active") or self._canary.get("active"):
+                return False
+            if (self._swap_thread is not None
+                    and self._swap_thread.is_alive()):
+                return False
+            if (self._canary_thread is not None
+                    and self._canary_thread.is_alive()):
+                return False
+            self._canary_thread = threading.Thread(
+                target=self._canary_start_guarded, daemon=True,
+                name="fleet-canary")
+            self._canary_thread.start()
+            return True
+
+    def _canary_start_guarded(self) -> None:
+        try:
+            self._start_canary()
+        except Exception:  # noqa: BLE001 — a crashed canary deploy must
+            # leave a visible verdict, not a forever-"starting" state
+            log.exception("canary deploy failed")
+            with self._state_lock:
+                self._canary = {"active": False,
+                                "last": {"outcome": "crashed"}}
+            self._canary_name = None
+
+    def _start_canary(self) -> None:
+        from predictionio_tpu.obs import quality
+
+        with self._swap_lock:  # a canary IS a (one-replica) swap:
+            # serialize against rolling swaps so the two can never
+            # drain the same fleet concurrently
+            errors: List[str] = []
+            baseline = self.version()
+            candidate = None
+            if self._version_source is not None:
+                try:
+                    candidate = self._version_source()
+                except Exception as e:  # noqa: BLE001 — a storage blip
+                    # is an error verdict, not a crash
+                    errors.append(f"version source failed: {e}")
+            if baseline is None:
+                errors.append("fleet is not on a single version — "
+                              "converge (rolling reload) before a canary")
+            elif not candidate or candidate == baseline:
+                errors.append("no NEW completed instance to canary "
+                              f"(fleet already on {baseline})")
+            replica = None
+            if not errors:
+                # the LAST ready replica: a stable, predictable pick
+                # that keeps r0 (the one operators poke first) on the
+                # baseline
+                ready = self.ready_replicas()
+                replica = ready[-1] if ready else None
+                if replica is None:
+                    errors.append("no ready replica to canary onto")
+            if not errors:
+                outcome = self._swap_one(replica, errors)
+                if outcome != "swapped":
+                    errors.append(f"{replica.name}: canary deploy did "
+                                  "not reach READY on the candidate")
+            if errors:
+                with self._state_lock:
+                    self._canary = {"active": False,
+                                    "last": {"outcome": "error",
+                                             "errors": errors}}
+                log.warning("canary not started: %s", "; ".join(errors))
+                return
+            with self._state_lock:
+                self._canary = {
+                    "active": True,
+                    "replica": replica.name,
+                    "baseline_version": baseline,
+                    "candidate_version": replica.version or candidate,
+                    "started_unix": round(time.time(), 3),
+                }
+            self._canary_name = replica.name
+            quality.STATE.canary_begin(replica.name, baseline,
+                                       replica.version or candidate)
+            log.info("canary ACTIVE: %s serves candidate %s against "
+                     "baseline %s", replica.name, candidate, baseline)
+
+    def _end_canary(self, outcome: str, verdict: Optional[Dict[str, Any]],
+                    extra: Optional[Dict[str, Any]] = None) -> None:
+        from predictionio_tpu.obs import quality
+
+        with self._state_lock:
+            last = {**{k: v for k, v in self._canary.items()
+                       if k not in ("active", "last", "deciding")},
+                    "outcome": outcome, **(extra or {})}
+            self._canary = {"active": False, "last": last}
+        self._canary_name = None
+        quality.STATE.canary_end(
+            outcome, {"verdict": verdict} if verdict else None)
+
+    def promote_canary(self,
+                       verdict: Optional[Dict[str, Any]] = None
+                       ) -> Dict[str, Any]:
+        """The candidate won: roll the REST of the fleet onto it
+        through the ordinary rolling swap (the canary replica's reload
+        is an idempotent no-op there). Clears the canary state first so
+        the router stops shadow-sampling mid-promotion."""
+        info = self.canary()
+        if not info.get("active"):
+            raise ValueError("no active canary to promote")
+        log.info("canary verdict PROMOTE for %s: rolling the fleet onto "
+                 "%s", info.get("replica"), info.get("candidate_version"))
+        self._end_canary("promoted", verdict)
+        result = self.rolling_reload()
+        return {"action": "promote", "swap": result}
+
+    def rollback_canary(self,
+                        verdict: Optional[Dict[str, Any]] = None
+                        ) -> Dict[str, Any]:
+        """The candidate lost: swap the canary replica BACK onto the
+        baseline instance (``/reload?instance=``) through the same
+        drain→rejoin machinery — clients keep answering from the other
+        replicas throughout."""
+        info = self.canary()
+        if not info.get("active"):
+            raise ValueError("no active canary to roll back")
+        replica = next((r for r in self.replicas
+                        if r.name == info.get("replica")), None)
+        baseline = info.get("baseline_version")
+        log.warning("canary verdict ROLLBACK for %s: restoring baseline "
+                    "%s", info.get("replica"), baseline)
+        # stop shadow traffic first, then restore — the rejected
+        # candidate version is remembered so the canary-mode watch does
+        # not immediately re-canary it (see _maybe_auto_swap)
+        self._end_canary("rolled_back", verdict,
+                         extra={"rejected_version":
+                                info.get("candidate_version")})
+        errors: List[str] = []
+        if replica is None:
+            errors.append(f"canary replica {info.get('replica')!r} is "
+                          "gone")
+        elif baseline:
+            with self._swap_lock:
+                outcome = self._swap_one(replica, errors,
+                                         instance_id=baseline)
+            if outcome != "swapped":
+                errors.append(f"{replica.name}: rollback reload did not "
+                              "reach READY on the baseline")
+        else:
+            errors.append("no baseline version recorded — leaving the "
+                          "replica on the candidate")
+        return {"action": "rollback", "errors": errors,
+                "version": self.version()}
+
+    def _maybe_canary_decision(self) -> None:
+        """Monitor-loop hook: while a canary is active (and
+        ``PIO_CANARY_AUTO`` is on, the default), read the verdict off
+        obs/quality.py and act on it — promote/rollback run on a
+        background thread (a promotion is a full rolling swap; the
+        monitor must keep probing through it)."""
+        if self._canary_name is None:
+            return
+        if metrics.env_int("PIO_CANARY_AUTO", 1) <= 0:
+            return
+        with self._state_lock:
+            if not self._canary.get("active") or self._canary.get(
+                    "deciding"):
+                return
+        from predictionio_tpu.obs import quality
+
+        verdict = quality.STATE.canary_verdict()
+        action = verdict.get("verdict")
+        if action not in ("promote", "rollback"):
+            return
+        with self._state_lock:
+            if not self._canary.get("active") or self._canary.get(
+                    "deciding"):
+                return
+            self._canary["deciding"] = True
+
+        def decide() -> None:
+            try:
+                if action == "promote":
+                    self.promote_canary(verdict)
+                else:
+                    self.rollback_canary(verdict)
+            except Exception:  # noqa: BLE001 — a failed decision must
+                # not strand the canary "deciding" forever
+                log.exception("canary %s failed", action)
+                with self._state_lock:
+                    self._canary.pop("deciding", None)
+
+        threading.Thread(target=decide, daemon=True,
+                         name="fleet-canary-verdict").start()
+
     def _maybe_auto_swap(self) -> None:
         """With ``PIO_FLEET_WATCH_SEC`` > 0 and a version source, a new
         COMPLETED instance triggers the rolling swap automatically —
-        train-to-serving with no operator in the loop."""
+        train-to-serving with no operator in the loop. In canary mode
+        the same watch starts a CANARY instead, and a candidate the
+        last canary ROLLED BACK is never auto-retried (a fresh retrain
+        — a new instance id — re-arms the watch)."""
         watch = metrics.env_float("PIO_FLEET_WATCH_SEC", 0.0)
         if watch <= 0 or self._version_source is None:
             return
@@ -814,11 +1090,30 @@ class FleetSupervisor:
         # would leave the fleet stuck mixed forever) and replicas whose
         # version read failed (a redundant reload is idempotent)
         versions = {r.version for r in self.ready_replicas()}
-        if latest and versions and versions != {latest}:
+        if not (latest and versions and versions != {latest}):
+            return
+        with self._state_lock:
+            canary_active = self._canary.get("active")
+            last = self._canary.get("last") or {}
+        if last.get("rejected_version") == latest:
+            # the quality gate ROLLED THIS INSTANCE BACK: neither watch
+            # path may silently redeploy it (in non-canary mode the
+            # full rolling swap would undo the rollback one watch tick
+            # later) — a human decision or a NEW retrain re-arms
+            log.debug("watch: latest instance %s was canary-rejected; "
+                      "holding", latest)
+            return
+        if self.canary_mode():
+            if canary_active:
+                return  # the mixed fleet IS the canary
             log.info("COMPLETED instance %s vs fleet on %s: starting "
-                     "rolling swap", latest,
-                     sorted(str(v) for v in versions))
-            self.start_rolling_reload()
+                     "CANARY", latest, sorted(str(v) for v in versions))
+            self.start_canary()
+            return
+        log.info("COMPLETED instance %s vs fleet on %s: starting "
+                 "rolling swap", latest,
+                 sorted(str(v) for v in versions))
+        self.start_rolling_reload()
 
     # -- introspection -------------------------------------------------------
     def version(self) -> Optional[str]:
@@ -847,12 +1142,14 @@ class FleetSupervisor:
     def snapshot(self) -> Dict[str, Any]:
         with self._state_lock:
             swap = dict(self._swap)
+            canary = dict(self._canary)
         return {
             "size": self.size(),
             "ready": self.ready_count(),
             "version": self.version(),
             "replicas": [r.snapshot() for r in self.replicas],
             "swap": swap,
+            "canary": canary,
         }
 
     def apply_admin(self, payload: Dict[str, Any]) -> Dict[str, Any]:
@@ -860,17 +1157,48 @@ class FleetSupervisor:
         starts a rolling swap (202 from the route; ``started`` False
         when one is already running), ``{"drain": name}`` /
         ``{"readmit": name}`` move a replica out of / back into
-        rotation. Raises ValueError on anything else (the route
-        answers 400)."""
+        rotation, ``{"canary": "start"|"promote"|"rollback"}`` drives
+        the canary lane (start answers 202 and deploys on a background
+        thread; promote/rollback run their swap in the background
+        too — progress in the snapshot's ``canary`` block). Raises
+        ValueError on anything else (the route answers 400)."""
         if not isinstance(payload, dict):
             raise ValueError("fleet admin body must be a JSON object")
-        requested = [k for k in ("reload", "drain", "readmit")
+        requested = [k for k in ("reload", "drain", "readmit", "canary")
                      if payload.get(k)]
         if len(requested) > 1:
             # only the first in precedence would run; silently dropping
             # the rest would leave the operator believing both happened
             raise ValueError("one action per call, got: "
                              + ", ".join(requested))
+        if payload.get("canary"):
+            action = payload["canary"]
+            if action == "start":
+                started = self.start_canary()
+                return {"started": started,
+                        "message": ("canary deploy started" if started
+                                    else "a canary or rolling swap is "
+                                         "already running")}
+            if action in ("promote", "rollback"):
+                if not self.canary().get("active"):
+                    raise ValueError("no active canary to " + action)
+                runner = (self.promote_canary if action == "promote"
+                          else self.rollback_canary)
+
+                def run_decision() -> None:
+                    try:
+                        runner()
+                    except Exception:  # noqa: BLE001 — the operator
+                        # reads the outcome off the snapshot; a crashed
+                        # decision must be logged, not silent
+                        log.exception("canary %s failed", action)
+
+                threading.Thread(target=run_decision, daemon=True,
+                                 name="fleet-canary-admin").start()
+                return {"started": True,
+                        "message": f"canary {action} started"}
+            raise ValueError('canary action must be "start", "promote" '
+                             'or "rollback"')
         if payload.get("reload"):
             started = self.start_rolling_reload()
             return {"started": started,
@@ -909,8 +1237,8 @@ class FleetSupervisor:
                 if state == EVICTED:
                     self.probe_and_update(replica)  # readmit fast
                 return {"replica": name, "state": replica.state}
-        raise ValueError('fleet admin body needs "reload", "drain" or '
-                         '"readmit"')
+        raise ValueError('fleet admin body needs "reload", "drain", '
+                         '"readmit" or "canary"')
 
 
 def format_swap(swap: Optional[Dict[str, Any]]) -> str:

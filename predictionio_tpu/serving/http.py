@@ -24,8 +24,12 @@ Every server also inherits the shared operator surface from the
   GET  /admin/tail       tail-latency attribution    }
                          (above-p95 stage shares)    }
   GET/POST /admin/fleet  replica fleet snapshot /    }
-                         rolling-swap control (404   }
-                         on servers without a fleet) }
+                         rolling-swap + canary       }
+                         control (404 on servers     }
+                         without a fleet)            }
+  GET/POST /admin/quality model-quality report:      }
+                         drift gauges' source, last  }
+                         replay diff, canary verdict }
 
 ``/healthz``, ``/readyz`` and ``/metrics`` stay unauthenticated — a
 liveness prober or scraper holds no operator secrets; the ``/admin/*``
@@ -162,7 +166,11 @@ def _serve_metrics(handler, query: str) -> None:
 def _serve_admin_flight(handler, query: str) -> None:
     """``GET /admin/flight``: the flight-recorder dump as JSON.
     ``?n=N`` limits to the last N records, ``?slow=1`` keeps only
-    slow/errored ones."""
+    slow/errored ones. Captured query payloads (PIO_FLIGHT_PAYLOADS)
+    are included only when an admin token is CONFIGURED — the bearer
+    gate above then guarantees it was presented; on a token-less
+    (trusted-network-default) server the payload bodies stay redacted,
+    only the capture counts show."""
     params = parse_qs(query)
     try:
         n = int(params["n"][0]) if "n" in params else None
@@ -170,7 +178,47 @@ def _serve_admin_flight(handler, query: str) -> None:
         handler._send(400, {"message": "n must be an integer"})
         return
     slow_only = (params.get("slow") or ["0"])[0].lower() in ("1", "true")
-    handler._send(200, flight.RECORDER.dump(n, slow_only=slow_only))
+    include_payloads = bool(os.environ.get("PIO_ADMIN_TOKEN"))
+    handler._send(200, flight.RECORDER.dump(
+        n, slow_only=slow_only, include_payloads=include_payloads))
+
+
+def _serve_admin_quality(handler) -> None:
+    """``GET /admin/quality``: the model-quality report (obs/quality.py
+    STATE) — latest drift probe, latest replay comparison, canary
+    progress + verdict. ``POST /admin/quality`` with ``{"replay":
+    {...}}`` and/or ``{"drift": {...}}`` registers an
+    externally-computed report — the ``pio replay`` CLI pushes its
+    result here, and a split-deployment ``pio stream`` daemon pushes
+    its drift probes to the fleet it patches, so the fleet's one
+    quality surface carries both even when measured in another
+    process."""
+    from predictionio_tpu.obs import quality
+
+    if handler.command == "GET":
+        handler._send(200, quality.STATE.report())
+        return
+    if handler.command != "POST":
+        handler._send(405, {"message": "GET or POST"})
+        return
+    try:
+        payload = handler._read_json()
+    except json.JSONDecodeError as e:
+        handler._send(400, {"message": f"invalid JSON: {e}"})
+        return
+    registered = []
+    if isinstance(payload, dict):
+        if isinstance(payload.get("replay"), dict):
+            quality.STATE.set_replay(payload["replay"])
+            registered.append("replay")
+        if isinstance(payload.get("drift"), dict):
+            quality.STATE.set_drift(payload["drift"])
+            registered.append("drift")
+    if not registered:
+        handler._send(400, {"message": 'body needs a "replay" and/or '
+                                       '"drift" object'})
+        return
+    handler._send(200, {"message": "registered: " + ", ".join(registered)})
 
 
 def _serve_admin_profile(handler, query: str) -> None:
@@ -337,6 +385,9 @@ def _instrument(fn):
                 return
             if path == "/admin/fleet":
                 _serve_admin_fleet(self)
+                return
+            if path == "/admin/quality":
+                _serve_admin_quality(self)
                 return
             if self.command == "GET" and path == "/admin/resilience":
                 # breaker states + admission snapshot (when the server

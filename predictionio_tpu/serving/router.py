@@ -26,6 +26,16 @@ the supervisor's replicas (serving/fleet.py):
                wins — the direct lever on the straggler-set p99
                (idempotent queries only: ``X-PIO-Non-Idempotent: 1``
                or ``PIO_HEDGE_QUANTILE=0`` opts out)
+  canary lane  while the fleet runs a canary (serving/fleet.py), every
+               2xx answer is also observed into the per-lane
+               ``pio_canary_request_seconds{lane}`` histogram
+               (baseline vs canary), and every
+               ``PIO_CANARY_SAMPLE_EVERY``-th baseline-served
+               idempotent query is SHADOWED to the canary replica
+               after the client is answered: the paired answers are
+               diffed through obs/quality.py's comparer and feed the
+               promote/rollback verdict — the client never waits on
+               the shadow
   passthrough  a replica's application answer is the client's answer:
                ``429 Retry-After`` (admission shed) and
                ``X-PIO-Degraded`` pass through UN-retried — retrying
@@ -59,7 +69,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import urlparse
 
-from predictionio_tpu.obs import health, metrics, trace
+from predictionio_tpu.obs import health, metrics, quality, trace
 from predictionio_tpu.resilience.policy import breaker_for
 from predictionio_tpu.serving.fleet import FleetSupervisor, Replica
 from predictionio_tpu.serving.http import (HTTPServerBase,
@@ -393,6 +403,10 @@ class QueryRouter(HTTPServerBase):
         # reusable threads instead of a fresh spawn per query
         self._worker_pool = _WorkerPool(
             metrics.env_int("PIO_ROUTER_POOL_SIZE", 16))
+        # canary paired-sampling cadence (every Nth baseline answer
+        # shadows to the canary replica)
+        self._pair_lock = threading.Lock()
+        self._pair_counter = 0
         super().__init__(host, port, _RouterRequestHandler,
                          bind_retries=bind_retries)
 
@@ -468,7 +482,16 @@ class QueryRouter(HTTPServerBase):
         # a duplicate onto the overloaded fleet — the amplification the
         # 429 passthrough exists to prevent
         if 200 <= answer[0] < 300:
-            self.hedge.observe(time.perf_counter() - t0)
+            elapsed = time.perf_counter() - t0
+            self.hedge.observe(elapsed)
+            # canary analysis: the same served answers, tagged by lane,
+            # feed the verdict's latency gate (obs/quality.py reads the
+            # buckets back through the SLO burn math)
+            canary_name = self.fleet.canary_replica_name()
+            if canary_name is not None:
+                quality.CANARY_SECONDS.labels(
+                    quality.LANE_CANARY if replica.name == canary_name
+                    else quality.LANE_BASELINE).observe(elapsed)
         results.put((replica, answer))
 
     def route_query(self, body: bytes, idempotent: bool = True):
@@ -549,6 +572,11 @@ class QueryRouter(HTTPServerBase):
             status, data, replica_headers = outcome
             outstanding -= 1
             if 200 <= status < 300 or not outstanding:
+                if 200 <= status < 300 and idempotent:
+                    # canary paired sampling: AFTER the client has its
+                    # answer in hand (the shadow runs on the worker
+                    # pool, never on this request's latency budget)
+                    self._maybe_canary_pair(replica, body, data)
                 if (200 <= status < 300 and outstanding
                         and replica.name == hedge_name):
                     # the hedge SAVED this request: its answer returns
@@ -599,6 +627,60 @@ class QueryRouter(HTTPServerBase):
         ctype = replica_headers.get(
             "Content-Type", "application/json; charset=UTF-8")
         return status, data, extra, ctype
+
+    # -- canary paired sampling ----------------------------------------------
+    def _maybe_canary_pair(self, replica: Replica, body: bytes,
+                           base_data: bytes) -> None:
+        """While a canary is active: every ``PIO_CANARY_SAMPLE_EVERY``-th
+        baseline-served 2xx answer re-plays the SAME query against the
+        canary replica on a pool worker and feeds the answer diff into
+        obs/quality.py's paired accumulators — the online analogue of
+        the offline replay harness, through the identical differ."""
+        canary_name = self.fleet.canary_replica_name()
+        if canary_name is None or replica.name == canary_name:
+            return
+        every = max(1, metrics.env_int("PIO_CANARY_SAMPLE_EVERY", 4))
+        with self._pair_lock:
+            self._pair_counter += 1
+            if self._pair_counter % every:
+                return
+        canary_replica = next(
+            (r for r in self.fleet.replicas if r.name == canary_name), None)
+        if canary_replica is None:
+            return
+        self._worker_pool.submit(self._canary_shadow, canary_replica,
+                                 body, base_data)
+
+    def _canary_shadow(self, canary_replica: Replica, body: bytes,
+                       base_data: bytes) -> None:
+        timeout = metrics.env_float("PIO_ROUTER_TIMEOUT", 30.0)
+        canary_replica.begin_request()  # shadow load is real load:
+        # p2c must see it, or paired sampling would overload the canary
+        # invisibly
+        t0 = time.perf_counter()
+        try:
+            status, data, _headers = self._client(canary_replica).request(
+                "POST", "/queries.json", body,
+                {"Content-Type": "application/json"}, timeout=timeout)
+        except Exception as e:  # noqa: BLE001 — a failing canary IS the
+            # evidence: counted as a paired error, never raised
+            quality.STATE.add_paired(None, error=f"{type(e).__name__}: {e}")
+            return
+        finally:
+            canary_replica.end_request()
+        if not 200 <= status < 300:
+            quality.STATE.add_paired(None,
+                                     error=f"canary answered {status}")
+            return
+        quality.CANARY_SECONDS.labels(quality.LANE_CANARY).observe(
+            time.perf_counter() - t0)
+        try:
+            diff = quality.compare_answers(json.loads(base_data or b"null"),
+                                           json.loads(data or b"null"))
+        except ValueError as e:
+            quality.STATE.add_paired(None, error=f"unparseable answer: {e}")
+            return
+        quality.STATE.add_paired(diff)
 
     # -- operator surface ----------------------------------------------------
     def status(self) -> Dict[str, Any]:

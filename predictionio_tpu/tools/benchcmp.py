@@ -28,13 +28,17 @@ import re
 from typing import Dict, List, Optional, Tuple
 
 #: rate-shaped fragments where HIGHER is better — checked first so
-#: ``*_per_sec_per_chip`` is not misread as a duration and
-#: ``retrieval_qps_recall95`` is not misread via nothing at all
+#: ``*_per_sec_per_chip`` is not misread as a duration,
+#: ``retrieval_qps_recall95`` is not misread via nothing at all, and
+#: ``quality_recall_vs_retrain`` / replay ``overlap`` read as quality
+#: floors (a drop IS the regression)
 _HIGHER_BETTER = re.compile(r"(per_sec|_qps|qps$|throughput|mfu|"
-                            r"_per_chip|hit|recall)")
-#: metric-name fragments where a LOWER value is better
+                            r"_per_chip|hit|recall|overlap)")
+#: metric-name fragments where a LOWER value is better —
+#: ``canary_verdict_ms`` rides the ``_ms$`` tail, drift gauges the
+#: ``drift`` fragment
 _LOWER_BETTER = re.compile(r"(_ms$|_ms_|_sec$|_sec_|_seconds|latency|"
-                           r"_bytes$|p50|p99|debt|rmse)")
+                           r"_bytes$|p50|p99|debt|rmse|drift)")
 
 #: detail keys that are run configuration, not performance — a change
 #: is reported as CONFIG-CHANGED (never a regression verdict: comparing

@@ -36,6 +36,13 @@ Console.scala:128-735 command surface; bin/pio:17-42 wrapper):
   fleet                 (serving fleet via the router's /admin/fleet:
                          replica states, rolling hot-swap, drain/
                          readmit; `deploy --replicas N` runs one)
+  replay                (re-play captured query payloads against a
+                         candidate instance, diff answers vs the
+                         baseline — workflow/replay.py; report served
+                         at /admin/quality)
+  canary                (the fleet's canary lane: paired answer diffs,
+                         per-lane latency burn, promote/rollback —
+                         obs/quality.py's verdict via /admin/quality)
 
 Run as ``python -m predictionio_tpu.tools.cli <command> ...``.
 """
@@ -260,6 +267,10 @@ def cmd_deploy(args) -> int:
 
     replicas = (args.replicas if args.replicas is not None
                 else metrics.env_int("PIO_REPLICAS", 1))
+    if getattr(args, "canary", False) and replicas <= 1:
+        raise CommandError("--canary needs a fleet (--replicas >= 2): a "
+                           "canary is one replica serving the candidate "
+                           "while the rest serve the baseline")
     if replicas > 1:
         return _deploy_fleet(args, replicas)
     variant = _load_variant(args.engine_json)
@@ -341,13 +352,17 @@ def _deploy_fleet(args, replicas: int) -> int:
         members,
         version_source=lambda: latest_completed_instance_id(
             storage, engine_id, args.engine_version, variant.id),
+        canary_mode=True if getattr(args, "canary", False) else None,
     ).start()
     router = QueryRouter(fleet, host=args.ip, port=args.port)
     install_drain_handler(router)
+    lane = (" (CANARY mode: new COMPLETED instances land on one "
+            "replica and are promoted/rolled back by verdict)"
+            if getattr(args, "canary", False) else "")
     _p(f"Engine {engine_id} deployed: {replicas} "
        f"{args.replica_mode} replica(s) behind router on "
        f"{args.ip}:{router.port} (fleet status: /admin/fleet; rolling "
-       "hot-swap: GET /reload)")
+       f"hot-swap: GET /reload){lane}")
     try:
         router.serve_forever()
     finally:
@@ -378,10 +393,13 @@ def cmd_stream(args) -> int:
     engine_id = (args.engine_id or variant.raw.get("engineId")
                  or variant.engine_factory)
     urls = [u.strip() for u in (args.url or "").split(",") if u.strip()]
+    reload_urls = [u.strip() for u in (args.reload_url or "").split(",")
+                   if u.strip()]
     try:
         updater = StreamUpdater(
             engine, engine_id, engine_version=args.engine_version,
-            engine_variant=variant.id, patch_urls=urls)
+            engine_variant=variant.id, patch_urls=urls,
+            reload_urls=reload_urls)
     except StreamUnsupported as e:
         raise CommandError(str(e)) from e
     if args.once:
@@ -839,6 +857,150 @@ def cmd_chaos(args) -> int:
     return 0
 
 
+def cmd_replay(args) -> int:
+    """`pio replay`: re-play logged query payloads (the flight
+    recorder's PIO_FLIGHT_PAYLOADS capture) against a candidate
+    instance, diffing every answer against the baseline (top-k overlap,
+    score deltas, latency — workflow/replay.py); prints the
+    machine-readable report and registers it on the baseline's
+    ``/admin/quality`` surface unless --no-push. Exit 1 when
+    --fail-under is given and the mean overlap lands below it."""
+    import urllib.error
+
+    from predictionio_tpu.workflow import replay as replay_mod
+
+    baseline = args.baseline or args.flight_url
+    flight_url = args.flight_url or baseline
+    if not baseline:
+        raise CommandError("--baseline (or --flight-url) is required: "
+                           "the diff needs a reference lane")
+    try:
+        report = replay_mod.replay_urls(
+            args.url, baseline, flight_url=flight_url, n=args.n,
+            k=args.k)
+    except urllib.error.URLError as e:
+        raise CommandError(f"replay failed: {e.reason}") from e
+    except RuntimeError as e:
+        raise CommandError(str(e)) from e
+    if not args.no_push:
+        try:
+            replay_mod.push_report(report, baseline)
+        except Exception as e:  # noqa: BLE001 — the report is already
+            # in hand; a failed push must not eat it
+            _p(f"(report push to {baseline} failed: {e})")
+    if args.json:
+        json.dump(report, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        _p(f"replayed {report['n']} logged quer(ies): "
+           f"{report['diffed']} diffed, errors {report['errors']}")
+        _p(f"  mean top-{report['k']} overlap {report['mean_overlap']}, "
+           f"worst {report['worst_overlap']}, mean |score delta| "
+           f"{report['mean_score_delta']}")
+        for lane in ("baseline", "candidate"):
+            lat = report["latency_ms"].get(lane) or {}
+            if lat:
+                _p(f"  {lane:>9}: p50 {lat['p50_ms']} ms, "
+                   f"p99 {lat['p99_ms']} ms")
+    if (args.fail_under is not None
+            and (report["mean_overlap"] is None
+                 or report["mean_overlap"] < args.fail_under)):
+        _p(f"FAIL: mean overlap below --fail-under {args.fail_under:g}")
+        return 1
+    return 0
+
+
+def cmd_canary(args) -> int:
+    """`pio canary`: drive/inspect the fleet's canary lane through the
+    router. Default output renders the quality surface's verdict
+    (``GET /admin/quality`` — drift gauges, replay report and canary
+    analysis all read obs/quality.py's one state); --start/--promote/
+    --rollback POST the action to ``/admin/fleet``. Exit 1 while an
+    active canary's verdict says rollback."""
+    import urllib.error
+    import urllib.request
+
+    base = args.url.rstrip("/")
+    action = ("start" if args.start else "promote" if args.promote
+              else "rollback" if args.rollback else None)
+    if action:
+        req = urllib.request.Request(
+            base + "/admin/fleet",
+            data=json.dumps({"canary": action}).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        _add_admin_auth(req)
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                body = json.load(resp)
+        except urllib.error.HTTPError as e:
+            raise CommandError(
+                f"canary {action} failed ({e.code}): "
+                f"{e.read().decode(errors='replace')[:200]}")
+        except urllib.error.URLError as e:
+            raise CommandError(f"cannot reach {args.url}: {e.reason}")
+        _p(body.get("message") or json.dumps(body))
+        return 0
+    req = urllib.request.Request(base + "/admin/quality")
+    _add_admin_auth(req)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            report = json.load(resp)
+    except urllib.error.HTTPError as e:
+        raise CommandError(
+            f"quality request failed ({e.code}): "
+            f"{e.read().decode(errors='replace')[:200]}")
+    except urllib.error.URLError as e:
+        raise CommandError(f"cannot reach {args.url}: {e.reason}")
+    if args.json:
+        json.dump(report, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+        canary = report.get("canary") or {}
+        verdict = (canary.get("verdict") or {}).get("verdict")
+        return 1 if (canary.get("active") and verdict == "rollback") else 0
+    drift = report.get("drift")
+    if drift:
+        breached = drift.get("breached") or []
+        _p(f"drift (band {report['band']:g}, shadow "
+           f"{str(drift.get('shadow_instance'))[:16]}): "
+           f"recall_vs_retrain={drift.get('recall_vs_retrain')} "
+           f"rmse_drift={drift.get('rmse_drift')} "
+           f"factor_drift={drift.get('factor_drift')}"
+           + (f"  BREACHED: {', '.join(breached)}" if breached else ""))
+    else:
+        _p("drift: no probe yet (run `pio stream` against a trained "
+           "instance)")
+    rep = report.get("replay")
+    if rep:
+        _p(f"replay: {rep.get('n')} queries, mean overlap "
+           f"{rep.get('mean_overlap')}, worst {rep.get('worst_overlap')}")
+    canary = report.get("canary") or {}
+    if not canary:
+        _p("canary: none")
+        return 0
+    state = "ACTIVE" if canary.get("active") else (
+        canary.get("outcome") or "inactive")
+    _p(f"canary [{state}]: replica {canary.get('replica')} candidate "
+       f"{str(canary.get('candidate_version'))[:16]} vs baseline "
+       f"{str(canary.get('baseline_version'))[:16]}")
+    paired = canary.get("paired") or {}
+    if paired:
+        _p(f"  paired samples: {paired.get('n')} "
+           f"(errors {paired.get('errors')}), mean overlap "
+           f"{paired.get('mean_overlap')}, worst "
+           f"{paired.get('worst_overlap')}")
+    verdict = canary.get("verdict") or {}
+    if verdict:
+        _p(f"  verdict: {verdict.get('verdict', '?').upper()}")
+        for lane, info in (verdict.get("latency") or {}).items():
+            _p(f"    {lane:>9}: {info.get('answers')} answers, "
+               f"over-threshold rate {info.get('over_threshold_rate')} "
+               f"(burn {info.get('burn')})")
+        for reason in verdict.get("reasons") or []:
+            _p(f"    - {reason}")
+    return 1 if (canary.get("active")
+                 and verdict.get("verdict") == "rollback") else 0
+
+
 def cmd_fleet(args) -> int:
     """Inspect or control a serving fleet through its router's
     ``/admin/fleet`` (serving/fleet.py): default output is one line per
@@ -1154,6 +1316,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="replica isolation: subprocesses on ephemeral "
                         "ports (production) or in-process threaded "
                         "servers (single-host / tests)")
+    p.add_argument("--canary", action="store_true",
+                   help="canary mode (needs --replicas >= 2): a new "
+                        "COMPLETED instance lands on ONE replica; the "
+                        "router samples paired answers + per-lane "
+                        "latency and the verdict auto-promotes or "
+                        "auto-rolls-back (PIO_CANARY_* knobs; watch "
+                        "cadence PIO_FLEET_WATCH_SEC)")
     p.set_defaults(func=cmd_deploy)
 
     p = sub.add_parser(
@@ -1174,6 +1343,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "or 1.0)")
     p.add_argument("--once", action="store_true",
                    help="one tail->fold->publish cycle, print stats JSON")
+    p.add_argument("--reload-url", default=None,
+                   help="comma-separated base URLs whose GET /reload "
+                        "the drift-band breach auto-triggers (normally "
+                        "the fleet router; PIO_QUALITY_DRIFT_BAND sets "
+                        "the band)")
     p.set_defaults(func=cmd_stream)
 
     p = sub.add_parser("undeploy", help="stop a deployed engine server")
@@ -1336,6 +1510,58 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", action="store_true",
                    help="dump the raw fleet snapshot JSON")
     p.set_defaults(func=cmd_fleet)
+
+    p = sub.add_parser(
+        "replay",
+        help="re-play captured query payloads (PIO_FLIGHT_PAYLOADS) "
+             "against a candidate instance and diff the answers vs the "
+             "baseline (workflow/replay.py); report lands on "
+             "/admin/quality",
+    )
+    p.add_argument("--url", required=True,
+                   help="base URL of the CANDIDATE server")
+    p.add_argument("--baseline", default=None,
+                   help="base URL of the baseline server (default: "
+                        "--flight-url)")
+    p.add_argument("--flight-url", default=None,
+                   help="server whose /admin/flight holds the captured "
+                        "payloads (default: --baseline; requires "
+                        "PIO_ADMIN_TOKEN — payloads only travel under "
+                        "the bearer gate)")
+    p.add_argument("-n", type=int, default=None,
+                   help="replay only the newest N captured payloads")
+    p.add_argument("--k", type=int, default=None,
+                   help="top-k depth for the overlap diff (default "
+                        "PIO_QUALITY_K)")
+    p.add_argument("--no-push", action="store_true",
+                   help="do not register the report on the baseline's "
+                        "/admin/quality")
+    p.add_argument("--fail-under", type=float, default=None,
+                   help="exit 1 when mean overlap is below this floor")
+    p.add_argument("--json", action="store_true",
+                   help="dump the raw comparison report")
+    p.set_defaults(func=cmd_replay)
+
+    p = sub.add_parser(
+        "canary",
+        help="inspect or drive the fleet's canary lane through the "
+             "router (GET /admin/quality, POST /admin/fleet): paired "
+             "answer diffs, per-lane latency burn, promote/rollback",
+    )
+    p.add_argument("--url", default="http://127.0.0.1:8000",
+                   help="base URL of the fleet's router (sends the "
+                        "PIO_ADMIN_TOKEN bearer header when set)")
+    p.add_argument("--start", action="store_true",
+                   help="deploy the newest COMPLETED instance onto one "
+                        "replica as the canary")
+    p.add_argument("--promote", action="store_true",
+                   help="roll the whole fleet onto the candidate")
+    p.add_argument("--rollback", action="store_true",
+                   help="restore the canary replica to the baseline "
+                        "instance")
+    p.add_argument("--json", action="store_true",
+                   help="dump the raw /admin/quality report")
+    p.set_defaults(func=cmd_canary)
 
     p = sub.add_parser(
         "top",

@@ -30,6 +30,12 @@ reference — operator views of this process's diagnostics:
                            request rate, plus the data-path ledger's
                            per-run stage table. JSON at
                            /admin/timeline.
+  GET /quality          -> HTML panel of the model-quality plane
+                           (obs/quality.py): drift-vs-shadow-retrain
+                           sparklines off the ``quality.*`` timeline
+                           series, the latest replay comparison
+                           report, and the canary verdict. JSON at
+                           /admin/quality.
   GET /fleet            -> HTML panel of the serving fleet(s)
                            supervised IN THIS PROCESS
                            (serving/fleet.py ACTIVE registry —
@@ -94,6 +100,10 @@ class _DashboardRequestHandler(JSONRequestHandler):
             return
         if path == "/fleet":
             self._send_cors(200, self.server_ref.fleet_html(),
+                            "text/html; charset=UTF-8")
+            return
+        if path == "/quality":
+            self._send_cors(200, self.server_ref.quality_html(),
                             "text/html; charset=UTF-8")
             return
         parts = [p for p in path.split("/") if p]
@@ -164,6 +174,7 @@ class DashboardServer(HTTPServerBase):
             '<a href="/slo">SLO burn rates</a> · '
             '<a href="/resilience">resilience</a> · '
             '<a href="/timeline">timelines</a> · '
+            '<a href="/quality">model quality</a> · '
             '<a href="/fleet">fleet</a> · '
             '<a href="/metrics">metrics</a> · '
             '<a href="/readyz">readiness</a></p>'
@@ -308,6 +319,104 @@ class DashboardServer(HTTPServerBase):
         ).format(interval=payload["interval_sec"], cap=payload["capacity"],
                  series_rows=series_rows,
                  stale=datapath["staleness_seconds"], run_rows=run_rows)
+
+    def quality_html(self) -> str:
+        """The model-quality plane as an operator panel: drift values
+        + their timeline sparklines (the ``quality.*`` series the
+        timeline samples off the gauges), the latest replay comparison
+        report, and the canary verdict — every number read from
+        obs/quality.py's one STATE, so this panel, ``pio canary`` and
+        the gauges can never disagree."""
+        from predictionio_tpu.obs import quality
+        from predictionio_tpu.obs.timeline import TIMELINE, sparkline
+
+        report = quality.STATE.report()
+        TIMELINE.sample()
+        series = TIMELINE.series()["series"]
+        spark_rows = "".join(
+            "<tr><td>{name}</td><td><code>{spark}</code></td>"
+            "<td>{last:.4g}</td></tr>".format(
+                name=html.escape(name),
+                spark=html.escape(
+                    sparkline([p[1] for p in series[name]], 48)),
+                last=series[name][-1][1])
+            for name in sorted(series)
+            if name.startswith("quality.") and series[name])
+        drift = report.get("drift")
+        if drift:
+            breached = drift.get("breached") or []
+            verdict = ("<b style='color:#c0392b'>BREACHED: "
+                       + html.escape(", ".join(breached)) + "</b>"
+                       if breached else
+                       "<b style='color:#27ae60'>inside band</b>")
+            drift_html = (
+                f"<p>shadow instance <code>"
+                f"{html.escape(str(drift.get('shadow_instance'))[:16])}"
+                f"</code>, band {report['band']:g} — {verdict}</p>"
+                "<table border='1'><tr><th>recall_vs_retrain</th>"
+                "<th>rmse_drift</th><th>factor_drift</th>"
+                "<th>sampled users</th></tr>"
+                f"<tr><td>{drift.get('recall_vs_retrain')}</td>"
+                f"<td>{drift.get('rmse_drift')}</td>"
+                f"<td>{drift.get('factor_drift')}</td>"
+                f"<td>{drift.get('sampled_users')}</td></tr></table>")
+        else:
+            drift_html = ("<p>no drift probe yet — <code>pio stream"
+                          "</code> against a trained instance feeds the "
+                          "gauges.</p>")
+        rep = report.get("replay")
+        if rep:
+            replay_html = (
+                "<table border='1'><tr><th>queries</th><th>diffed</th>"
+                "<th>mean overlap</th><th>worst overlap</th>"
+                "<th>mean |score Δ|</th><th>errors</th></tr>"
+                f"<tr><td>{rep.get('n')}</td><td>{rep.get('diffed')}</td>"
+                f"<td>{rep.get('mean_overlap')}</td>"
+                f"<td>{rep.get('worst_overlap')}</td>"
+                f"<td>{rep.get('mean_score_delta')}</td>"
+                f"<td>{html.escape(_json.dumps(rep.get('errors')))}</td>"
+                "</tr></table>")
+        else:
+            replay_html = ("<p>no replay report yet — <code>pio replay"
+                           "</code> registers one here.</p>")
+        canary = report.get("canary")
+        if canary:
+            verdict = canary.get("verdict") or {}
+            state = ("ACTIVE" if canary.get("active")
+                     else canary.get("outcome") or "inactive")
+            paired = canary.get("paired") or {}
+            reasons = "".join(f"<li>{html.escape(r)}</li>"
+                              for r in verdict.get("reasons") or [])
+            canary_html = (
+                f"<p>[{html.escape(state)}] replica <code>"
+                f"{html.escape(str(canary.get('replica')))}</code>: "
+                f"candidate <code>"
+                f"{html.escape(str(canary.get('candidate_version'))[:16])}"
+                "</code> vs baseline <code>"
+                f"{html.escape(str(canary.get('baseline_version'))[:16])}"
+                f"</code> — verdict <b>"
+                f"{html.escape(str(verdict.get('verdict', '–')).upper())}"
+                f"</b></p><p>paired samples: {paired.get('n')} "
+                f"(errors {paired.get('errors')}), mean overlap "
+                f"{paired.get('mean_overlap')}</p><ul>{reasons}</ul>")
+        else:
+            canary_html = ("<p>no canary — <code>pio canary --start"
+                           "</code> (or <code>pio deploy --canary"
+                           "</code> mode) runs one.</p>")
+        return (
+            "<!DOCTYPE html><html><head><title>Model quality</title>"
+            "</head><body><h1>Model quality</h1>"
+            "<h2>Drift vs shadow retrain</h2>"
+            f"{drift_html}"
+            "<table border='1'><tr><th>Series</th><th>Sparkline</th>"
+            f"<th>Last</th></tr>{spark_rows}</table>"
+            "<h2>Replay comparison</h2>"
+            f"{replay_html}"
+            "<h2>Canary</h2>"
+            f"{canary_html}"
+            '<p><a href="/admin/quality">JSON</a> · '
+            '<a href="/">index</a></p></body></html>'
+        )
 
     def fleet_html(self) -> str:
         """The serving fleet(s) supervised in THIS process as an

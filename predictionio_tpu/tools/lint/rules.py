@@ -1,4 +1,4 @@
-"""graftlint rules JT01-JT14: the TPU hazards this codebase has hit.
+"""graftlint rules JT01-JT15: the TPU hazards this codebase has hit.
 
 Each rule encodes a failure class with a concrete precedent in this
 tree's history (the bf16-Gramian divergence behind JT03 is recorded in
@@ -1351,3 +1351,101 @@ class FullSortForTopK(Rule):
                 "np.argpartition (host) or jax.lax.top_k (device) and "
                 "sort only the k survivors",
             )
+
+
+# -- JT15 ----------------------------------------------------------------------
+
+@register
+class NonMonotonicDurationClock(Rule):
+    id = "JT15"
+    name = "nonmonotonic-duration-clock"
+    rationale = (
+        "A duration or deadline measured as a difference of time.time() "
+        "readings jumps with every NTP step/slew: watchdog windows "
+        "mis-fire, cadence checks freeze (a backwards step makes "
+        "`now - last < interval` true forever), drain deadlines expire "
+        "instantly or never. Durations and deadlines belong on "
+        "time.monotonic()/time.perf_counter(); time.time() is for "
+        "TIMESTAMPS that leave the process (records, filenames, "
+        "series). The tell is a SUBTRACTION whose operands are BOTH "
+        "wall-clock-derived; timestamp arithmetic against a plain "
+        "number (`now - window`) stays silent."
+    )
+
+    _WALL_CALLS = {"time.time", "time.time_ns"}
+    #: value-preserving wrappers to look through: round(time.time(), 3)
+    #: is as wall as time.time()
+    _WRAPPERS = {"round", "min", "max", "float", "int", "abs"}
+
+    def _is_wall_call(self, node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and dotted(node.func) in self._WALL_CALLS)
+
+    def _derives_from_wall(self, node: ast.AST, tainted: Set[str]) -> bool:
+        """Whether an expression's VALUE is a wall-clock reading:
+        deliberately shape-restricted (names, arithmetic, conditionals,
+        value-preserving wrappers) — a dict/list that merely CONTAINS a
+        timestamp does not make every read through it a wall value."""
+        if self._is_wall_call(node):
+            return True
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            d = dotted(node)
+            return bool(d) and d in tainted
+        if isinstance(node, ast.IfExp):
+            return (self._derives_from_wall(node.body, tainted)
+                    or self._derives_from_wall(node.orelse, tainted))
+        if isinstance(node, ast.BinOp):
+            return (self._derives_from_wall(node.left, tainted)
+                    or self._derives_from_wall(node.right, tainted))
+        if isinstance(node, ast.UnaryOp):
+            return self._derives_from_wall(node.operand, tainted)
+        if isinstance(node, ast.BoolOp):
+            return any(self._derives_from_wall(v, tainted)
+                       for v in node.values)
+        if isinstance(node, ast.Call):
+            fn = dotted(node.func).rsplit(".", 1)[-1]
+            if fn in self._WRAPPERS:
+                return any(self._derives_from_wall(a, tainted)
+                           for a in node.args)
+        return False
+
+    def _tainted_names(self, tree: ast.AST) -> Set[str]:
+        """Names/attribute chains ever assigned a value containing a
+        time.time() read — file-local dataflow like JT03's taint, with
+        a second pass so one name-to-name hop propagates
+        (``now = time.time(); self._last = now``). A linter
+        over-approximates (no reassignment clearing); suppress with a
+        justification where the wall clock is the reviewed intent."""
+        tainted: Set[str] = set()
+        for _ in range(2):
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and (
+                        node.value is not None):
+                    targets, value = [node.target], node.value
+                else:
+                    continue
+                if self._derives_from_wall(value, tainted):
+                    for tgt in targets:
+                        d = dotted(tgt)
+                        if d:
+                            tainted.add(d)
+        return tainted
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        tainted = self._tainted_names(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)):
+                continue
+            if self._derives_from_wall(node.left, tainted) and (
+                    self._derives_from_wall(node.right, tainted)):
+                yield Finding(
+                    self.id, ctx.path, node.lineno, node.col_offset,
+                    "duration/deadline computed as a difference of "
+                    "wall-clock (time.time()) readings — an NTP "
+                    "step/slew skews or freezes it; measure durations "
+                    "with time.monotonic()/time.perf_counter() and "
+                    "keep time.time() for exported timestamps",
+                )

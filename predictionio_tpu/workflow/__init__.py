@@ -6,10 +6,14 @@ Submodules:
   train    — run_train (ref: CoreWorkflow.runTrain:42)
   evaluate — run_evaluation (ref: CoreWorkflow.runEvaluation:96)
   deploy   — model reload for serving (ref: Engine.prepareDeploy:174)
+  stream   — streaming events→model: delta tailer + fold-in updates
+  replay   — logged-traffic replay harness: re-play captured queries
+             against a candidate instance, diff answers (ROADMAP D)
 """
 
 # Submodules are imported lazily to keep core <-> workflow imports acyclic.
-_SUBMODULES = ("config", "variant", "train", "evaluate", "deploy")
+_SUBMODULES = ("config", "variant", "train", "evaluate", "deploy",
+               "stream", "replay")
 
 
 def __getattr__(name):

@@ -52,9 +52,23 @@ the updater measures recall@k of the PATCHED retrieval index (the same
 ``upsert`` lane the serving patches ride) against brute force over the
 current factor tables, exporting ``pio_stream_index_recall``; a value
 below ``PIO_STREAM_RECALL_FLOOR`` logs and increments
-``pio_stream_recall_breaches_total`` — index drift is visible long
-before a full shadow-retrain harness (ROADMAP item D) exists to
-arbitrate it.
+``pio_stream_recall_breaches_total`` — index drift visible without any
+reference model.
+
+Model-quality drift probe (the fold-in quality gate ROADMAP item D
+closes): at bind time the updater snapshots a SHADOW reference of each
+fold-capable model — the last full-retrain COMPLETED instance, before
+any fold touches it (obs/quality.ShadowRef) — and every
+``PIO_QUALITY_EVERY`` folds scores the live patched model against it:
+recall@k-vs-retrain on sampled users, rmse drift on a held-out slice,
+factor-norm drift, exported as the ``pio_model_quality_*`` gauges with
+the ``PIO_QUALITY_DRIFT_BAND`` band (obs/quality.py owns the math and
+the ``GET /admin/quality`` surface). A breach AUTO-TRIGGERS the
+existing rolling ``/reload`` lane (``--reload-url``, normally the
+fleet router) exactly once per breach episode — the trigger latches
+until a NEW trained instance binds, so a slow retrain cannot be
+storm-reloaded — and the updater resyncs its own model to the bound
+instance so serving and streamer agree again.
 
 Config (env):
   PIO_STREAM_INTERVAL_SEC   daemon poll cadence (default 1.0)
@@ -68,6 +82,8 @@ Config (env):
   PIO_STREAM_RECALL_FLOOR   breach threshold for the probe (0.95)
   PIO_STREAM_RECALL_SAMPLE  probe query sample size (16)
   PIO_STREAM_RECALL_K       probe k (10)
+  PIO_QUALITY_EVERY         applied folds between shadow-drift probes
+                            (20; band/sample/k: obs/quality.py env)
 """
 
 from __future__ import annotations
@@ -502,6 +518,8 @@ class StreamUpdater:
         instance=None,
         patch_urls: Sequence[str] = (),
         patch_servers: Sequence[Any] = (),
+        reload_urls: Sequence[str] = (),
+        reload_trigger: Optional[Any] = None,
     ):
         from predictionio_tpu.models.als import ALSAlgorithm, ALSModel
         from predictionio_tpu.models.twotower import TwoTowerAlgorithm
@@ -516,6 +534,12 @@ class StreamUpdater:
         self.engine_variant = engine_variant
         self.patch_urls = [u.rstrip("/") for u in patch_urls]
         self.patch_servers = list(patch_servers)
+        #: where a drift-band breach fires the rolling reload: a
+        #: callable (tests, embedders) or server/router base URLs whose
+        #: GET /reload lane rolls serving back onto the last full
+        #: retrain (bearer-authed when PIO_ADMIN_TOKEN is set)
+        self.reload_urls = [u.rstrip("/") for u in reload_urls]
+        self.reload_trigger = reload_trigger
         self._als_cls = ALSAlgorithm
         self._tt_cls = TwoTowerAlgorithm
         self._als_model_cls = ALSModel
@@ -573,7 +597,23 @@ class StreamUpdater:
         # instance binds — its own run_train publish reconciled the log
         if prev_instance_id is None or instance.id != prev_instance_id:
             self._staleness_debt = False
+            # the drift→reload trigger re-arms ONLY here: one reload
+            # per breach episode, no storm while the retrain that will
+            # actually fix the drift is still in flight
+            self._quality_reload_fired = False
         self._folds_since_probe = 0
+        self._folds_since_quality = 0
+        # shadow reference: the freshly loaded COMPLETED instance,
+        # snapshotted BEFORE any fold touches it — "drift" is always
+        # distance from the last full retrain (obs/quality.py)
+        from predictionio_tpu.obs import quality
+
+        self._shadows: Dict[int, quality.ShadowRef] = {}
+        for folder in self._folders:
+            model = getattr(folder, "model", None)
+            if model is not None and quality.ShadowRef.supports(model):
+                self._shadows[folder.index] = quality.ShadowRef(
+                    model, instance.id)
 
     def resync(self) -> None:
         """Rebind to the newest COMPLETED instance (after a retrain or
@@ -701,6 +741,16 @@ class StreamUpdater:
             recall = self.probe_recall()
             if recall is not None:
                 out["index_recall"] = recall
+        self._folds_since_quality += 1
+        if (self._folds_since_quality
+                >= metrics.env_int("PIO_QUALITY_EVERY", 20)):
+            self._folds_since_quality = 0
+            report = self.probe_quality()
+            if report is not None:
+                out["quality"] = {
+                    k: report.get(k)
+                    for k in ("recall_vs_retrain", "rmse_drift",
+                              "factor_drift", "breached")}
         return out
 
     # -- retrieval drift probe -----------------------------------------------
@@ -745,6 +795,129 @@ class StreamUpdater:
                 "factor tables; run a full retrain (rolling /reload)",
                 worst, floor)
         return worst
+
+    # -- shadow-retrain drift probe (the fold-in quality gate) ---------------
+    def probe_quality(self) -> Optional[Dict[str, Any]]:
+        """Score every fold-capable live model against its shadow
+        reference (the last full-retrain COMPLETED instance) and
+        publish the worst case to the ``pio_model_quality_*`` gauges +
+        ``GET /admin/quality`` (obs/quality.py owns the math). A
+        drift-band breach fires the rolling ``/reload`` lane exactly
+        once per breach episode and resyncs the updater itself — see
+        the module docstring. Returns the published report, or None
+        when nothing was probeable."""
+        from predictionio_tpu.obs import quality
+
+        reports = []
+        for folder in self._folders:
+            shadow = self._shadows.get(folder.index)
+            if shadow is None:
+                continue
+            report = quality.drift_report(folder.model, shadow)
+            if report.get("recall_vs_retrain") is not None:
+                reports.append(report)
+        if not reports:
+            return None
+        # worst-case merge across algorithms: one gauge set, the most
+        # pessimistic verdict (a healthy ALS must not mask a drifted
+        # two-tower)
+        merged = dict(min(reports, key=lambda r: r["recall_vs_retrain"]))
+        merged["recall_vs_retrain"] = min(r["recall_vs_retrain"]
+                                          for r in reports)
+        for name, pick in (("rmse_drift", max), ("factor_drift", max)):
+            values = [r[name] for r in reports if r.get(name) is not None]
+            if values:
+                merged[name] = pick(values)
+        merged["algorithms_probed"] = len(reports)
+        merged = quality.publish_drift(merged)
+        # split deployments: this daemon's in-memory STATE is not the
+        # fleet's — push the report onto every patch target's quality
+        # surface so THEIR /admin/quality, dashboard panel and `pio
+        # canary` carry the drift the stream measured (best-effort,
+        # same stance as patch delivery; in-process patch_servers share
+        # this process's STATE already)
+        if self.patch_urls:
+            self._push_drift(merged)
+        if merged["breached"] and not self._quality_reload_fired:
+            self._quality_reload_fired = True
+            quality.note_auto_reload()
+            log.warning(
+                "model-quality drift breached the band %.2f (%s: "
+                "recall_vs_retrain=%s rmse_drift=%s factor_drift=%s) — "
+                "triggering the rolling /reload lane and resyncing; a "
+                "full retrain owns closing the episode",
+                merged["band"], ",".join(merged["breached"]),
+                merged.get("recall_vs_retrain"), merged.get("rmse_drift"),
+                merged.get("factor_drift"))
+            self._trigger_reload()
+            try:
+                # the updater's OWN model is the drifted one: rebind to
+                # the instance serving just rolled back onto, so the
+                # next folds extend the reference, not the drift
+                self.resync()
+            except Exception:  # noqa: BLE001 — resync is advisory
+                log.exception("post-breach stream resync failed")
+        return merged
+
+    def _push_drift(self, report: Dict[str, Any]) -> None:
+        """POST the drift report to each patch target's
+        ``/admin/quality`` (bearer-authed like the patch lane; failures
+        are logged, never raised — drift delivery is telemetry)."""
+        import os as _os
+
+        body = json.dumps({"drift": report}).encode()
+        headers = {"Content-Type": "application/json"}
+        token = _os.environ.get("PIO_ADMIN_TOKEN")
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        timeout = metrics.env_float("PIO_STREAM_PATCH_TIMEOUT", 10.0)
+        for url in self.patch_urls:
+            try:
+                req = urllib.request.Request(
+                    url + "/admin/quality", data=body, headers=headers,
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    resp.read()
+            except Exception as e:  # noqa: BLE001 — telemetry delivery
+                # must not break the fold loop
+                log.warning("drift report push to %s failed: %s", url, e)
+
+    def _trigger_reload(self) -> None:
+        """Fire the rolling-reload lane: the injected callable when one
+        was given (tests, in-process fleets), else ``GET /reload`` on
+        every configured reload URL (a router's route answers 202 and
+        rolls the fleet; a single engine server reloads in place)."""
+        if self.reload_trigger is not None:
+            try:
+                self.reload_trigger()
+            except Exception:  # noqa: BLE001 — the trigger is operator
+                # plumbing; its failure must not kill the fold loop
+                log.exception("drift reload trigger failed")
+            return
+        if not self.reload_urls:
+            log.warning("drift band breached but no reload lane is "
+                        "configured (pio stream --reload-url) — run a "
+                        "full retrain + rolling /reload manually")
+            return
+        import os as _os
+
+        headers = {}
+        token = _os.environ.get("PIO_ADMIN_TOKEN")
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        timeout = metrics.env_float("PIO_STREAM_PATCH_TIMEOUT", 10.0)
+        for url in self.reload_urls:
+            try:
+                req = urllib.request.Request(url + "/reload",
+                                             headers=headers)
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    resp.read()
+                log.warning("drift breach: rolling reload triggered at "
+                            "%s", url)
+            except Exception as e:  # noqa: BLE001 — counted+logged, the
+                # daemon keeps folding either way
+                log.warning("drift-breach reload trigger to %s failed: "
+                            "%s", url, e)
 
     # -- patch delivery ------------------------------------------------------
     def _publish(self, blocks: List[dict]) -> bool:

@@ -38,7 +38,7 @@ def rule_ids(findings):
 def test_all_rules_registered():
     assert {"JT01", "JT02", "JT03", "JT04", "JT05", "JT06",
             "JT07", "JT08", "JT09", "JT10", "JT11", "JT12",
-            "JT13", "JT14"} <= set(RULES)
+            "JT13", "JT14", "JT15"} <= set(RULES)
 
 
 def test_syntax_error_is_reported_not_raised(tmp_path):
@@ -1094,4 +1094,65 @@ def test_jt14_suppressible_with_justification(tmp_path):
         def rank(scores, k):
             return np.argsort(-scores)[:k]  # graftlint: disable=JT14 — fixture: scores is a dozen rows
     """, relpath="models/m.py")
+    assert findings == []
+
+
+# -- JT15 nonmonotonic-duration-clock ------------------------------------------
+
+def test_jt15_positive_direct_wall_delta(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import time
+
+        def timed(work):
+            t0 = time.time()
+            work()
+            return time.time() - t0
+    """)
+    assert rule_ids(findings) == ["JT15"]
+    assert "monotonic" in findings[0].message
+
+
+def test_jt15_positive_cadence_through_attribute(tmp_path):
+    # the cadence-freeze pattern: now = time.time(); now - self._last
+    findings = lint_src(tmp_path, """\
+        import time
+
+        class Sampler:
+            def tick(self, now=None):
+                now = time.time() if now is None else now
+                if now - self._last < 5.0:
+                    return False
+                self._last = now
+                return True
+    """)
+    assert rule_ids(findings) == ["JT15"]
+
+
+def test_jt15_negative_monotonic_and_timestamp_arithmetic(tmp_path):
+    # monotonic deltas, one-sided timestamp arithmetic (now - window),
+    # wall timestamps stored in records, and deltas of values merely
+    # READ OUT of a container that holds a timestamp all stay silent
+    findings = lint_src(tmp_path, """\
+        import time
+
+        def fine(window, record):
+            t0 = time.monotonic()
+            dur = time.monotonic() - t0
+            start = time.time() - window
+            record = {"start_unix": round(time.time(), 3), "t0": 1.0}
+            total = record["t0"] - sum(record.values())
+            name = int(time.time() * 1e3)
+            return dur, start, total, name
+    """)
+    assert findings == []
+
+
+def test_jt15_suppressible_with_justification(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import time
+
+        def staleness(first):
+            now = time.time()
+            return now - first  # graftlint: disable=JT15 — fixture: cross-process wall horizon by design
+    """)
     assert findings == []
