@@ -176,6 +176,13 @@ class LocalFSModelsRepo(S.ModelsRepo):
         except FileNotFoundError:
             return None
 
+    def size(self, id: str) -> Optional[int]:
+        # one stat, no blob read (the OOM preflight's cheap question)
+        try:
+            return os.path.getsize(self._path(id))
+        except OSError:
+            return None
+
     def delete(self, id: str) -> None:
         try:
             os.remove(self._path(id))

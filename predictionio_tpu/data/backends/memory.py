@@ -415,6 +415,11 @@ class MemoryModelsRepo(S.ModelsRepo):
             m = self._models.get(id)
             return Model(id=m.id, models=m.models) if m is not None else None
 
+    def size(self, id):
+        with self._lock:
+            m = self._models.get(id)
+            return None if m is None else len(m.models)
+
     def delete(self, id):
         with self._lock:
             self._models.pop(id, None)

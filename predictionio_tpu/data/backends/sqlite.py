@@ -599,6 +599,13 @@ class SqliteModelsRepo(S.ModelsRepo):
             return None
         return Model(id=rows[0]["id"], models=rows[0]["blob"])
 
+    def size(self, id) -> Optional[int]:
+        # length() in SQL — the blob never crosses into Python (the
+        # OOM preflight's cheap question)
+        rows = self._db.query(
+            "SELECT length(blob) AS n FROM models WHERE id=?", (id,))
+        return None if not rows else int(rows[0]["n"])
+
     def delete(self, id):
         self._db.execute("DELETE FROM models WHERE id=?", (id,))
 

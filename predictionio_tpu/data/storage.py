@@ -794,6 +794,16 @@ class ModelsRepo(abc.ABC):
     def get(self, id: str) -> Optional[Model]: ...
     @abc.abstractmethod
     def delete(self, id: str) -> None: ...
+
+    def size(self, id: str) -> Optional[int]:
+        """Blob length in bytes, or None when absent — the OOM
+        preflight's question (obs/memacct.py prices a deploy BEFORE
+        anything loads). Backends override with a metadata read
+        (stat / SELECT length) so the preflight never downloads the
+        blob the deploy is about to fetch anyway; this base fallback
+        fetches and measures."""
+        model = self.get(id)
+        return None if model is None else len(model.models)
     @abc.abstractmethod
     def list(self) -> List[Dict[str, Any]]:
         """Inventory for replica reconciliation: one

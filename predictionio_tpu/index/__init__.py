@@ -98,6 +98,11 @@ class AnnIndex(abc.ABC):
 
     backend: str = "abstract"
 
+    #: device-memory ledger attribution (obs/memacct.py): the owning
+    #: model sets this to ITS label before build, so the index's bytes
+    #: land under pio_model_device_bytes{model=<owner>,component=index}
+    mem_model: Optional[str] = None
+
     @abc.abstractmethod
     def build(self, item_vectors: np.ndarray) -> None:
         """(Re)build over the full table; records build metrics."""
@@ -124,6 +129,16 @@ class AnnIndex(abc.ABC):
     def _note_build(self, seconds: float) -> None:
         BUILD_SECONDS.labels(self.backend).set(seconds)
         SIZE_ITEMS.labels(self.backend).set(float(len(self)))
+
+    def _register_mem(self, nbytes: int) -> None:
+        """Price this index's resident tables in the device-memory
+        ledger (obs/memacct.py) — build/upsert/device-copy seams call
+        it with their current total, re-pricing under the same owner."""
+        from predictionio_tpu.obs import memacct
+
+        memacct.LEDGER.register(
+            self, self.mem_model or f"index:{self.backend}", "index",
+            int(nbytes))
 
     def _note_query(self) -> None:
         QUERIES_TOTAL.labels(self.backend).inc()

@@ -71,6 +71,7 @@ class ExactIndex(AnnIndex):
             self._plan_kernel()
         self.build_seconds = time.perf_counter() - t0
         self._note_build(self.build_seconds)
+        self._register_mem(self._mem_nbytes())
         MEASURED_RECALL.labels(self.backend).set(1.0)  # exact by design
 
     def upsert(self, rows: np.ndarray, vectors: np.ndarray) -> None:
@@ -102,9 +103,17 @@ class ExactIndex(AnnIndex):
             if grow > 0:
                 self._fns.clear()   # n_items is a static kernel arg
             self._note_build(self.build_seconds)
+        self._register_mem(self._mem_nbytes())
 
     def __len__(self) -> int:
         return int(self._vectors.shape[0])
+
+    def _mem_nbytes(self) -> int:
+        """Resident bytes this index owns: the host table plus, once
+        materialized, the tile-padded device copy the kernel streams."""
+        padded = self._device_padded
+        return int(self._vectors.nbytes
+                   + (padded.nbytes if padded is not None else 0))
 
     @property
     def vectors(self) -> np.ndarray:
@@ -165,6 +174,9 @@ class ExactIndex(AnnIndex):
             padded = tkd.pad_items(jnp.asarray(self._vectors),
                                    self.block_items)
             self._device_padded = padded
+            # a NEW long-lived device allocation: re-price the ledger
+            # footprint with the padded copy included (JT16 contract)
+            self._register_mem(self._mem_nbytes())
         return padded
 
     def _fallback(self):

@@ -150,6 +150,7 @@ class IVFIndex(AnnIndex):
             self._autotune_nprobe()
         self.build_seconds = time.perf_counter() - t0
         self._note_build(self.build_seconds)
+        self._register_mem(self._mem_nbytes())
         if self.measured_recall is not None:
             MEASURED_RECALL.labels(self.backend).set(self.measured_recall)
 
@@ -229,9 +230,19 @@ class IVFIndex(AnnIndex):
                 # the updated table so a hot new row can't clip
                 self._requantize()
             self._note_build(self.build_seconds)
+        self._register_mem(self._mem_nbytes())
 
     def __len__(self) -> int:
         return int(self._vectors.shape[0])
+
+    def _mem_nbytes(self) -> int:
+        """Resident bytes: full-precision table + coarse quantizer +
+        (when int8 is on) the code table."""
+        total = int(self._vectors.nbytes)
+        for arr in (self._centroids, self._codes, self._scale):
+            if arr is not None:
+                total += int(arr.nbytes)
+        return total
 
     @property
     def vectors(self) -> np.ndarray:

@@ -103,6 +103,10 @@ class ALSParams(Params):
 class ALSModel:
     """Factor matrices + id maps; scorer compiled lazily and kept on device."""
 
+    #: ledger attribution label (obs/memacct.py); TwoTowerModel
+    #: overrides — the same per-model key perfacct's MFU gauges use
+    memacct_model = "als"
+
     def __init__(self, factors: ALSFactors, user_ids: BiMap, item_ids: BiMap,
                  index_backend: str = "auto", index_kernel: str = "auto"):
         self.user_factors = factors.user_factors
@@ -118,6 +122,7 @@ class ALSModel:
         # picklable record that sharded serving was enabled (the mesh
         # itself never pickles); load_persistent_model re-enables it
         self.sharded_axis: Optional[str] = None
+        self._register_memory()
 
     def __getstate__(self):
         d = dict(self.__dict__)
@@ -131,6 +136,26 @@ class ALSModel:
         d.setdefault("index_backend", "auto")
         d.setdefault("index_kernel", "auto")
         self.__dict__.update(d)
+        # model LOAD seam (prepare_deploy unpickle): this instance's
+        # residency lands in the device-memory ledger; the hot-swap /
+        # replica-stop paths release it (obs/memacct.py)
+        self._register_memory()
+
+    def _register_memory(self) -> None:
+        """(Re-)price this model's footprints in the device-memory
+        ledger: the factor tables and (estimated) id maps. Called at
+        construction, load (unpickle) and after every fold-in patch —
+        a grown table re-prices itself under the same owner key."""
+        from predictionio_tpu.obs import memacct
+
+        memacct.LEDGER.register(
+            self, self.memacct_model, "factors",
+            int(self.user_factors.nbytes + self.item_factors.nbytes))
+        # id maps: a cheap structural estimate (dict slot + interned
+        # key + inverse list per entry) — attribution, not malloc truth
+        memacct.LEDGER.register(
+            self, self.memacct_model, "id_maps",
+            (len(self.user_ids) + len(self.item_ids)) * 24)
 
     def scorer(self) -> TopKScorer:
         if self._scorer is None:
@@ -146,9 +171,13 @@ class ALSModel:
         if self._index is None:
             from predictionio_tpu.index import make_index
 
-            self._index = make_index(
-                self.item_factors, backend=self.index_backend,
-                kernel=self.index_kernel)
+            index = make_index(backend=self.index_backend,
+                               kernel=self.index_kernel)
+            # ledger attribution BEFORE the build registers bytes, so
+            # the index's footprints land under this model's label
+            index.mem_model = self.memacct_model
+            index.build(np.asarray(self.item_factors, np.float32))
+            self._index = index
         return self._index
 
     def retrieval_stats(self) -> Optional[dict]:
@@ -242,6 +271,9 @@ class ALSModel:
                     (ids[iid] for iid, _ in item_rows), np.int64,
                     count=len(item_rows))
                 self._index.upsert(touched, factors[touched])
+        if new_users or new_items or user_rows or item_rows:
+            # grown/overwritten tables re-price their ledger footprints
+            self._register_memory()
         return new_users, new_items
 
     def recommend(

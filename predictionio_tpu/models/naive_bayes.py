@@ -93,6 +93,13 @@ class CategoricalNaiveBayesModel:
         self._likelihoods = jnp.asarray(likelihoods_arr, dtype=jnp.float32)
         self._seen = seen
         self._unk = likelihoods_arr.shape[-1] - 1  # sentinel column
+        # long-lived device residency -> the memory ledger (JT16):
+        # these dense tables serve every query until the model retires
+        from predictionio_tpu.obs import memacct
+
+        memacct.LEDGER.register(
+            self, "naive_bayes", "params",
+            int(self._priors.nbytes + self._likelihoods.nbytes))
 
     # -- reference-shaped views ----------------------------------------------
     @property

@@ -61,6 +61,9 @@ class TwoTowerModel(ALSModel):
     are L2-normalized so scores — including the index's item -> similar
     answers — are cosine similarities."""
 
+    #: device-memory ledger attribution (obs/memacct.py)
+    memacct_model = "twotower"
+
 
 class TwoTowerAlgorithm(Algorithm):
     """DASE wrapper over ops.twotower."""

@@ -338,6 +338,12 @@ def install_default_probes() -> None:
         REGISTRY.register("compile_cache", _compile_cache_probe)
         REGISTRY.register("flight_errors", _flight_error_probe)
         REGISTRY.register("disk", _disk_probe)
+        # device-memory headroom (obs/memacct.py): DEGRADED under the
+        # PIO_MEM_HEADROOM_FLOOR fraction of capacity — the operator
+        # warning that the next deploy will be preflight-refused
+        from predictionio_tpu.obs import memacct
+
+        REGISTRY.register("device_memory", memacct.device_memory_probe)
         _defaults_installed = True
 
 

@@ -14,6 +14,7 @@ the obs registry so they show up on every server's ``/metrics``:
   pio_train_step_seconds_bucket                     per-train-step wall time
   pio_train_seconds_bucket{engine=...}              whole-train wall time
   pio_device_memory_bytes{device,kind}              allocator stats per device
+                                                    (owned by obs/memacct.py)
   pio_pallas_kernel_enabled{kernel=}                Pallas vs XLA path choice
 
 ``install()`` never imports jax at module import time and never raises:
@@ -65,13 +66,6 @@ TRAIN_SECONDS = metrics.histogram(
     ("engine",),
     buckets=(0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0, 300.0, 600.0,
              1800.0, 3600.0),
-)
-
-DEVICE_MEMORY_BYTES = metrics.gauge(
-    "pio_device_memory_bytes",
-    "Per-device allocator stats (bytes_in_use / peak_bytes_in_use / "
-    "bytes_limit) where the backend reports them",
-    ("device", "kind"),
 )
 
 PALLAS_KERNEL_ENABLED = metrics.gauge(
@@ -158,25 +152,11 @@ def observe_train_step(seconds: float) -> None:
 def update_device_memory_gauges() -> int:
     """Refresh pio_device_memory_bytes from each local device's
     ``memory_stats()``; returns the number of devices reporting. CPU
-    backends often report nothing — that is a 0, not an error."""
-    try:
-        import jax
+    backends often report nothing — that is a 0, not an error.
 
-        devices = jax.local_devices()
-    except Exception as e:  # noqa: BLE001 — never fail the caller
-        log.debug("device memory gauges unavailable: %s", e)
-        return 0
-    reported = 0
-    for dev in devices:
-        try:
-            stats = dev.memory_stats() or {}
-        except Exception:  # noqa: BLE001 — per-device best effort
-            continue
-        picked = False
-        for kind in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
-            if kind in stats:
-                DEVICE_MEMORY_BYTES.labels(str(dev.id), kind).set(
-                    float(stats[kind]))
-                picked = True
-        reported += int(picked)
-    return reported
+    Thin delegate: the gauge moved to obs/memacct.py (the one owner of
+    device-memory accounting, which also refreshes it continuously on
+    the flight-recorder snapshot cadence instead of only post-train)."""
+    from predictionio_tpu.obs import memacct
+
+    return memacct.update_device_memory_gauges()

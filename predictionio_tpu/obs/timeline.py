@@ -19,7 +19,9 @@ watching.
 Default series: per-model MFU (``mfu.<model>``), model staleness
 (``staleness_sec``), serving p50/p99 per engine
 (``serve_p50_ms.<engine>`` / ``serve_p99_ms.<engine>``), the HTTP
-request rate (``http_rps``), in-flight count (``inflight``), and the
+request rate (``http_rps``), in-flight count (``inflight``), the
+device-memory plane (``mem.headroom`` / ``mem.model_bytes.<model>`` —
+obs/memacct.py's headroom and per-model ledger totals), and the
 model-quality drift gauges (``quality.recall`` /
 ``quality.rmse_drift`` — obs/quality.py's recall-vs-retrain and
 normalized rmse drift, the dashboard ``/quality`` sparklines).
@@ -146,10 +148,26 @@ def staleness_collector(series: str = "staleness_sec") -> Collector:
     return collect
 
 
+def memacct_collector() -> Collector:
+    """Sample the device-memory plane by ASKING it (obs/memacct.py):
+    ``mem.headroom`` plus per-model ``mem.model_bytes.<model>`` ledger
+    totals — recomputed at the sample instant so the headroom gauge is
+    also fresh for plain /metrics scrapes (same stance as
+    :func:`staleness_collector`)."""
+
+    def collect(now: float) -> Dict[str, float]:
+        from predictionio_tpu.obs import memacct
+
+        return memacct.timeline_points(now)
+
+    return collect
+
+
 def default_collectors() -> List[Collector]:
     return [
         gauge_collector("pio_train_mfu", "mfu"),
         staleness_collector(),
+        memacct_collector(),
         quantile_collector("pio_serving_request_seconds", 0.50,
                            "serve_p50_ms", scale=1e3),
         quantile_collector("pio_serving_request_seconds", 0.99,

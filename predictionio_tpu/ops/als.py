@@ -891,6 +891,13 @@ class ALSTrainer:
         self._host_refs = (user_side, item_side)
         self._transfer_lock = threading.Lock()
         self._transfer_noted = False
+        # device-memory ledger (obs/memacct.py): the chunked-put lane's
+        # device-resident binned sides live as long as this trainer —
+        # weakly referenced, so a dropped trainer's footprint sweeps
+        from predictionio_tpu.obs import memacct
+
+        memacct.LEDGER.register(self, "als", "train_data",
+                                int(self.transfer_bytes))
 
         key = jax.random.PRNGKey(cfg.seed)
         ku, ki = jax.random.split(key)
@@ -1085,11 +1092,19 @@ class ALSTrainer:
         # deliberately NOT attempted here (compile() documents why
         # lower().compile() misbehaves on tunneled backends)
         if self._acct is None:
-            from predictionio_tpu.obs import perfacct
+            from predictionio_tpu.obs import memacct, perfacct
 
             wm = self.work_model()
             self._acct = perfacct.StepAccountant(
                 "als", wm["flops_per_iter"], wm["hbm_bytes_per_iter"])
+            # train high-water (obs/memacct.py): analytic for the same
+            # reason as the FLOP basis above — resident binned sides +
+            # both factor tables twice (donated in/out under the scan)
+            memacct.note_train_peak(
+                "als",
+                int(self.transfer_bytes) + 2 * int(self._X.nbytes
+                                                   + self._Y.nbytes),
+                source="analytic")
         self._acct.observe(time.perf_counter() - t0, steps=n)
 
     def run(self, iterations: Optional[int] = None) -> ALSFactors:

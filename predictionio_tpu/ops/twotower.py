@@ -533,6 +533,27 @@ class TwoTowerTrainer:
         # MFU accounting (obs/perfacct.py): built lazily after the
         # first dispatch so cost_analysis can reuse the compiled step
         self._acct = None
+        # device-memory ledger (obs/memacct.py): the whole-run device
+        # residents — embedding tables + tail MLPs as params, adagrad
+        # accumulators + adamw state as opt_state, the on-device
+        # dataset as train_data — priced once, swept when the trainer
+        # is dropped
+        from predictionio_tpu.obs import memacct
+
+        def _tree_bytes(tree) -> int:
+            return sum(int(getattr(leaf, "nbytes", 0))
+                       for leaf in jax.tree_util.tree_leaves(tree))
+
+        self._param_bytes = _tree_bytes((tables, dense))
+        self._opt_bytes = _tree_bytes((acc, opt_state))
+        data_bytes = _tree_bytes((self._u, self._i, self._w))
+        memacct.LEDGER.register(self, "twotower", "params",
+                                self._param_bytes)
+        memacct.LEDGER.register(self, "twotower", "opt_state",
+                                self._opt_bytes)
+        memacct.LEDGER.register(self, "twotower", "train_data",
+                                data_bytes)
+        self._data_bytes = data_bytes
 
         # mid-training checkpoint/resume (core.checkpoint — beyond the
         # reference's train-to-completion-or-nothing, SURVEY.md §5.4)
@@ -744,6 +765,24 @@ class TwoTowerTrainer:
                     "twotower", self._epoch_fn, (*self._state, key),
                     fallback_flops=(self.matmul_flops_per_step()
                                     * self.steps_per_epoch))
+                # train high-water (obs/memacct.py): memory_analysis of
+                # the SAME compiled epoch when the backend reports one
+                # (AOT lower, compile-cache-absorbed like the cost
+                # basis), else the analytic floor — every whole-run
+                # resident plus one gradient-sized temp set
+                from predictionio_tpu.obs import memacct
+
+                peak = memacct.peak_from_jitted(
+                    self._epoch_fn, *self._state, key)
+                if peak is not None:
+                    memacct.note_train_peak("twotower", peak,
+                                            source="memory_analysis")
+                else:
+                    memacct.note_train_peak(
+                        "twotower",
+                        2 * self._param_bytes + self._opt_bytes
+                        + self._data_bytes,
+                        source="analytic")
             self._acct.observe(epoch_sec)
             self._epochs_done += 1
             if self._ckpt is not None:

@@ -541,12 +541,11 @@ class EngineServer(HTTPServerBase):
         super().__init__(host, port, _EngineRequestHandler, bind_retries=bind_retries)
 
     # -- deployment management ----------------------------------------------
-    def _load_latest(self, instance_id: Optional[str] = None) -> Deployment:
-        """Build a warm deployment of the latest COMPLETED instance —
-        or of a SPECIFIC completed instance when ``instance_id`` names
-        one (the canary rollback lane: the fleet swaps its canary
-        replica back onto the baseline instance, not onto "latest",
-        which IS the candidate being rolled back)."""
+    def _resolve_instance(self, instance_id: Optional[str] = None):
+        """The COMPLETED instance a (re)load targets: a SPECIFIC one
+        when ``instance_id`` names it (the canary rollback lane), else
+        the latest. Resolution only — the OOM preflight must see the
+        target id before anything is unpickled or device-put."""
         if instance_id:
             instance = self.storage.engine_instances().get(instance_id)
             if instance is None or instance.status != "COMPLETED":
@@ -562,6 +561,15 @@ class EngineServer(HTTPServerBase):
                 f"No valid engine instance found for engine {self.engine_id} "
                 f"{self.engine_version} {self.engine_variant}"
             )
+        return instance
+
+    def _load_latest(self, instance_id: Optional[str] = None) -> Deployment:
+        """Build a warm deployment of the latest COMPLETED instance —
+        or of a SPECIFIC completed instance when ``instance_id`` names
+        one (the canary rollback lane: the fleet swaps its canary
+        replica back onto the baseline instance, not onto "latest",
+        which IS the candidate being rolled back)."""
+        instance = self._resolve_instance(instance_id)
         deployment = prepare_deploy(self.engine, instance, self.ctx, self.storage)
         self._warmup(deployment)
         return deployment
@@ -580,24 +588,50 @@ class EngineServer(HTTPServerBase):
                 log.exception("warmup failed for %s", type(algo).__name__)
         log.info("serve warm-up done in %.2fs", time.perf_counter() - t0)
 
-    def reload(self, instance_id: Optional[str] = None) -> str:
+    def reload(self, instance_id: Optional[str] = None,
+               force: bool = False) -> str:
         """Hot-swap to the latest completed instance (ref: /reload :592)
         — or to the specific completed instance ``instance_id`` names
         (``GET /reload?instance=<id>``, the canary rollback lane).
         The swap happens only after the new deployment is warm — live
         traffic never waits on the new model's compiles. A reload that
         fails on storage feeds the degraded-mode circuit; one that
-        succeeds closes it (recovery path)."""
+        succeeds closes it (recovery path).
+
+        OOM preflight (obs/memacct.py): the target instance is priced
+        from its stored blob BEFORE anything loads; an estimate beyond
+        current headroom raises :class:`memacct.PreflightRefused`
+        (route: 507 + the JSON reason) unless ``force`` — load+warm
+        precedes the swap, so during the window BOTH deployments are
+        resident and the un-subtracted headroom check is exactly
+        right. The successful swap releases the OLD deployment's
+        ledger footprints, so gauges drop with the swap, not the GC."""
         from predictionio_tpu.data.storage import StorageError
+        from predictionio_tpu.obs import memacct
 
         try:
-            deployment = self._load_latest(instance_id)
+            instance = self._resolve_instance(instance_id)
         except (StorageError, ConnectionError):
             self._storage_breaker.record_failure()
             raise
+        # may raise PreflightRefused — deliberately OUTSIDE the breaker
+        # accounting: a refused deploy is a capacity verdict, not a
+        # storage failure, and must not push the server degraded
+        memacct.preflight_check(instance.id, self.storage, force=force)
+        try:
+            deployment = prepare_deploy(self.engine, instance, self.ctx,
+                                        self.storage)
+        except (StorageError, ConnectionError):
+            self._storage_breaker.record_failure()
+            raise
+        self._warmup(deployment)
         self._storage_breaker.record_success()
         with self._deployment_lock:
-            self.deployment = deployment
+            old, self.deployment = self.deployment, deployment
+        # retire the swapped-out instance's residency (weakref sweep is
+        # the backstop; the deliberate seam keeps gauges honest NOW)
+        for model in old.models:
+            memacct.release_model(model)
         return deployment.instance.id
 
     # -- streaming model patches (workflow/stream.py) -----------------------
@@ -778,6 +812,15 @@ class EngineServer(HTTPServerBase):
     def stop(self) -> None:
         if self._batcher is not None:
             self._batcher.stop()
+        # fleet replica stop: retire this server's residency from the
+        # memory ledger — a stopped replica's models must not keep
+        # exporting pio_model_device_bytes until the GC happens by
+        from predictionio_tpu.obs import memacct
+
+        with self._deployment_lock:
+            models = list(self.deployment.models)
+        for model in models:
+            memacct.release_model(model)
         super().stop()
 
     def status(self) -> dict:
@@ -874,11 +917,22 @@ class _EngineRequestHandler(JSONRequestHandler):
         elif path == "/reload":
             from urllib.parse import parse_qs
 
-            target = (parse_qs(urlparse(self.path).query)
-                      .get("instance") or [None])[0]
+            from predictionio_tpu.obs import memacct
+
+            params = parse_qs(urlparse(self.path).query)
+            target = (params.get("instance") or [None])[0]
+            force = (params.get("force") or ["0"])[0].lower() in (
+                "1", "true")
             try:
-                instance_id = self.server_ref.reload(target)
+                instance_id = self.server_ref.reload(target, force=force)
                 self._send(200, {"message": "reloaded", "engineInstanceId": instance_id})
+            except memacct.PreflightRefused as e:
+                # 507 Insufficient Storage: the candidate would exceed
+                # device-memory headroom — refused BEFORE any load, the
+                # serving model untouched; ?force=1 (or the fleet
+                # admin's {"force": true}) overrides
+                self._send(507, {"message": str(e),
+                                 "preflight": e.decision})
             except RuntimeError as e:
                 self.server_ref.remote_log(f"reload failed: {e}")
                 self._send(404, {"message": str(e)})

@@ -640,7 +640,7 @@ class FleetSupervisor:
         self._launch(replica)
 
     # -- rolling hot-swap ----------------------------------------------------
-    def rolling_reload(self) -> Dict[str, Any]:
+    def rolling_reload(self, force: bool = False) -> Dict[str, Any]:
         """Roll every live replica onto the newest COMPLETED instance,
         one at a time: wait for the REST of the fleet to be ready,
         drain this replica from rotation (router in-flight falls to
@@ -649,18 +649,21 @@ class FleetSupervisor:
         model), then rejoin before the next replica drains — the fleet
         never drops below N-1 ready replicas and traffic never waits
         on a compile. DEAD replicas are skipped: their restart path
-        already boots from the latest instance."""
+        already boots from the latest instance. ``force`` overrides
+        each replica's device-memory preflight (obs/memacct.py — a
+        refusal otherwise answers 507 and the replica rejoins on its
+        old model)."""
         with self._swap_lock:
             with self._state_lock:
                 self._swap = {"active": True, "started_unix": time.time(),
                               "last": self._swap.get("last")}
-            result = self._rolling_reload_locked()
+            result = self._rolling_reload_locked(force=force)
             with self._state_lock:
                 self._swap = {"active": False, "last": result}
             _SWAPS.labels(result["outcome"]).inc()
             return result
 
-    def _rolling_reload_locked(self) -> Dict[str, Any]:
+    def _rolling_reload_locked(self, force: bool = False) -> Dict[str, Any]:
         swapped: List[str] = []
         errors: List[str] = []
         for replica in list(self.replicas):
@@ -677,7 +680,7 @@ class FleetSupervisor:
                 errors.append(f"{replica.name}: operator-drained; "
                               "skipped")
                 continue
-            outcome = self._swap_one(replica, errors)
+            outcome = self._swap_one(replica, errors, force=force)
             if outcome == "abort":
                 break
             if outcome == "swapped":
@@ -691,14 +694,16 @@ class FleetSupervisor:
         }
 
     def _swap_one(self, replica: Replica, errors: List[str],
-                  instance_id: Optional[str] = None) -> str:
+                  instance_id: Optional[str] = None,
+                  force: bool = False) -> str:
         """Drain→reload→rejoin ONE replica under the fleet's N-1 floor
         guards — the shared core of the rolling swap and the canary
         lane (``instance_id`` targets a specific completed instance,
-        the canary rollback). Appends operator-facing error strings;
-        returns "swapped", "skip" (this replica failed/was skipped but
-        siblings may proceed) or "abort" (the fleet never converged —
-        nothing later can safely drain either)."""
+        the canary rollback; ``force`` overrides the replica's
+        device-memory preflight). Appends operator-facing error
+        strings; returns "swapped", "skip" (this replica failed/was
+        skipped but siblings may proceed) or "abort" (the fleet never
+        converged — nothing later can safely drain either)."""
         # hold the N-1 floor: every OTHER live replica must be
         # back in rotation before this one leaves it
         if not self._await_others_ready(replica, timeout=60.0):
@@ -721,10 +726,20 @@ class FleetSupervisor:
                           f"with {replica.outstanding()} in flight")
             # proceed anyway: the replica keeps answering its
             # stragglers from the OLD model while it reloads
-        status, body = self._reload(replica, instance_id)
-        if status != 200:
+        status, body = self._reload(replica, instance_id, force=force)
+        if status == 507:
+            # the replica's OOM preflight (obs/memacct.py) refused the
+            # candidate: a capacity verdict, not a failure — the
+            # replica rejoins on its old model and the reason (sizes,
+            # headroom) surfaces through `pio fleet` / /admin/fleet;
+            # retry with {"force": true} to override
+            errors.append(f"{replica.name}: preflight refused the "
+                          f"deploy (507 insufficient device memory): "
+                          f"{body}")
+        elif status != 200:
             errors.append(f"{replica.name}: reload answered "
                           f"{status}: {body}")
+        if status != 200:
             # re-enter rotation on the old model: a failed swap
             # must degrade to "stale replica", never "lost replica"
             self._set_state(replica, EVICTED, deliberate=True)
@@ -739,15 +754,23 @@ class FleetSupervisor:
         return "swapped"
 
     def _reload(self, replica: Replica,
-                instance_id: Optional[str] = None):
+                instance_id: Optional[str] = None,
+                force: bool = False):
         """One replica's ``GET /reload`` — generous timeout: the warm
         compile is exactly what we drained the replica to hide. With
         ``instance_id``, the replica reloads that SPECIFIC completed
-        instance (``?instance=`` — the canary rollback lane)."""
+        instance (``?instance=`` — the canary rollback lane);
+        ``force=1`` overrides its device-memory preflight."""
         try:
-            url = f"{replica.base_url}/reload"
+            params = []
             if instance_id:
-                url += "?instance=" + urllib.parse.quote(instance_id)
+                params.append(
+                    "instance=" + urllib.parse.quote(instance_id))
+            if force:
+                params.append("force=1")
+            url = f"{replica.base_url}/reload"
+            if params:
+                url += "?" + "&".join(params)
             req = urllib.request.Request(url)
             reload_timeout = metrics.env_float(
                 "PIO_FLEET_RELOAD_TIMEOUT", 300.0)
@@ -808,10 +831,11 @@ class FleetSupervisor:
 
         return self._await(others_converged, timeout)
 
-    def start_rolling_reload(self) -> bool:
+    def start_rolling_reload(self, force: bool = False) -> bool:
         """Kick a rolling swap on a background thread (the admin/route
         entry point — a swap can take minutes of warm compile per
-        replica). False when one is already running."""
+        replica). False when one is already running. ``force``
+        overrides each replica's device-memory preflight."""
         with self._state_lock:
             # check-and-spawn atomically: two concurrent callers (an
             # operator /reload racing the auto-swap watch) must not both
@@ -834,13 +858,14 @@ class FleetSupervisor:
                     and self._swap_thread.is_alive()):
                 return False
             self._swap_thread = threading.Thread(
-                target=self._swap_guarded, daemon=True, name="fleet-swap")
+                target=self._swap_guarded, args=(force,), daemon=True,
+                name="fleet-swap")
             self._swap_thread.start()
             return True
 
-    def _swap_guarded(self) -> None:
+    def _swap_guarded(self, force: bool = False) -> None:
         try:
-            self.rolling_reload()
+            self.rolling_reload(force=force)
         except Exception:  # noqa: BLE001 — a crashed background swap
             # must leave a visible verdict, not a forever-"active" state
             log.exception("rolling reload failed")
@@ -865,13 +890,16 @@ class FleetSupervisor:
         hot-path check (a plain attribute read, no lock)."""
         return self._canary_name
 
-    def start_canary(self) -> bool:
+    def start_canary(self, force: bool = False) -> bool:
         """Kick a canary deploy on a background thread: the newest
         COMPLETED instance lands on exactly ONE replica through the
         drain→reload→rejoin machinery; the router then tags lanes and
         samples paired answers until a verdict (auto or operator)
         promotes or rolls back. False when a swap or canary is already
-        running (or the fleet is stopping)."""
+        running (or the fleet is stopping). ``force`` overrides the
+        canary replica's device-memory preflight — an oversized
+        candidate is otherwise refused (507) before it can OOM the
+        replica, and the canary ends in an error verdict."""
         with self._state_lock:
             if self._stop_evt.is_set():
                 return False
@@ -884,14 +912,14 @@ class FleetSupervisor:
                     and self._canary_thread.is_alive()):
                 return False
             self._canary_thread = threading.Thread(
-                target=self._canary_start_guarded, daemon=True,
-                name="fleet-canary")
+                target=self._canary_start_guarded, args=(force,),
+                daemon=True, name="fleet-canary")
             self._canary_thread.start()
             return True
 
-    def _canary_start_guarded(self) -> None:
+    def _canary_start_guarded(self, force: bool = False) -> None:
         try:
-            self._start_canary()
+            self._start_canary(force=force)
         except Exception:  # noqa: BLE001 — a crashed canary deploy must
             # leave a visible verdict, not a forever-"starting" state
             log.exception("canary deploy failed")
@@ -900,7 +928,7 @@ class FleetSupervisor:
                                 "last": {"outcome": "crashed"}}
             self._canary_name = None
 
-    def _start_canary(self) -> None:
+    def _start_canary(self, force: bool = False) -> None:
         from predictionio_tpu.obs import quality
 
         with self._swap_lock:  # a canary IS a (one-replica) swap:
@@ -931,7 +959,7 @@ class FleetSupervisor:
                 if replica is None:
                     errors.append("no ready replica to canary onto")
             if not errors:
-                outcome = self._swap_one(replica, errors)
+                outcome = self._swap_one(replica, errors, force=force)
                 if outcome != "swapped":
                     errors.append(f"{replica.name}: canary deploy did "
                                   "not reach READY on the candidate")
@@ -949,6 +977,11 @@ class FleetSupervisor:
                     "baseline_version": baseline,
                     "candidate_version": replica.version or candidate,
                     "started_unix": round(time.time(), 3),
+                    # a force-started canary (the candidate failed the
+                    # memory preflight) must promote with the same
+                    # force, or every OTHER replica's 507 would strand
+                    # the fleet permanently mixed
+                    "forced": bool(force),
                 }
             self._canary_name = replica.name
             quality.STATE.canary_begin(replica.name, baseline,
@@ -982,7 +1015,9 @@ class FleetSupervisor:
         log.info("canary verdict PROMOTE for %s: rolling the fleet onto "
                  "%s", info.get("replica"), info.get("candidate_version"))
         self._end_canary("promoted", verdict)
-        result = self.rolling_reload()
+        # a force-started canary promotes with the same force — the
+        # operator already owned the OOM risk at start
+        result = self.rolling_reload(force=bool(info.get("forced")))
         return {"action": "promote", "swap": result}
 
     def rollback_canary(self,
@@ -1012,8 +1047,14 @@ class FleetSupervisor:
                           "gone")
         elif baseline:
             with self._swap_lock:
+                # force=True: restoring the KNOWN-GOOD baseline is the
+                # emergency exit from a degraded candidate — the
+                # replica's in-use still counts the fat candidate it
+                # is about to drop, so a preflight here could 507 the
+                # very rollback that frees the memory
                 outcome = self._swap_one(replica, errors,
-                                         instance_id=baseline)
+                                         instance_id=baseline,
+                                         force=True)
             if outcome != "swapped":
                 errors.append(f"{replica.name}: rollback reload did not "
                               "reach READY on the baseline")
@@ -1160,10 +1201,14 @@ class FleetSupervisor:
         rotation, ``{"canary": "start"|"promote"|"rollback"}`` drives
         the canary lane (start answers 202 and deploys on a background
         thread; promote/rollback run their swap in the background
-        too — progress in the snapshot's ``canary`` block). Raises
+        too — progress in the snapshot's ``canary`` block).
+        ``{"force": true}`` beside ``reload``/``canary: start``
+        overrides the replicas' device-memory preflight — the admin
+        acknowledgment lane for a 507-refused deploy. Raises
         ValueError on anything else (the route answers 400)."""
         if not isinstance(payload, dict):
             raise ValueError("fleet admin body must be a JSON object")
+        force = bool(payload.get("force"))
         requested = [k for k in ("reload", "drain", "readmit", "canary")
                      if payload.get(k)]
         if len(requested) > 1:
@@ -1174,7 +1219,7 @@ class FleetSupervisor:
         if payload.get("canary"):
             action = payload["canary"]
             if action == "start":
-                started = self.start_canary()
+                started = self.start_canary(force=force)
                 return {"started": started,
                         "message": ("canary deploy started" if started
                                     else "a canary or rolling swap is "
@@ -1200,7 +1245,7 @@ class FleetSupervisor:
             raise ValueError('canary action must be "start", "promote" '
                              'or "rollback"')
         if payload.get("reload"):
-            started = self.start_rolling_reload()
+            started = self.start_rolling_reload(force=force)
             return {"started": started,
                     "message": ("rolling reload started" if started
                                 else "a rolling reload is already "

@@ -30,6 +30,10 @@ Every server also inherits the shared operator surface from the
   GET/POST /admin/quality model-quality report:      }
                          drift gauges' source, last  }
                          replay diff, canary verdict }
+  GET  /admin/memory     device-memory accounting:   }
+                         per-model HBM ledger,       }
+                         headroom, train peaks,      }
+                         preflight state             }
 
 ``/healthz``, ``/readyz`` and ``/metrics`` stay unauthenticated — a
 liveness prober or scraper holds no operator secrets; the ``/admin/*``
@@ -388,6 +392,14 @@ def _instrument(fn):
                 return
             if path == "/admin/quality":
                 _serve_admin_quality(self)
+                return
+            if self.command == "GET" and path == "/admin/memory":
+                # device-memory accounting plane (obs/memacct.py):
+                # per-model ledger attribution, headroom + basis,
+                # train peaks and the last preflight decision
+                from predictionio_tpu.obs import memacct
+
+                self._send(200, memacct.report())
                 return
             if self.command == "GET" and path == "/admin/resilience":
                 # breaker states + admission snapshot (when the server

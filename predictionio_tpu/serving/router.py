@@ -359,7 +359,12 @@ class _RouterRequestHandler(JSONRequestHandler):
                                             "token (PIO_ADMIN_TOKEN)"},
                            extra_headers={"WWW-Authenticate": "Bearer"})
                 return
-            started = self.server_ref.fleet.start_rolling_reload()
+            from urllib.parse import parse_qs
+
+            force = (parse_qs(urlparse(self.path).query)
+                     .get("force") or ["0"])[0].lower() in ("1", "true")
+            started = self.server_ref.fleet.start_rolling_reload(
+                force=force)
             self._send(
                 202 if started else 409,
                 {"message": ("rolling reload started — progress at "

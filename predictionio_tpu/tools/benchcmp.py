@@ -36,7 +36,11 @@ _HIGHER_BETTER = re.compile(r"(per_sec|_qps|qps$|throughput|mfu|"
                             r"_per_chip|hit|recall|overlap)")
 #: metric-name fragments where a LOWER value is better —
 #: ``canary_verdict_ms`` rides the ``_ms$`` tail, drift gauges the
-#: ``drift`` fragment
+#: ``drift`` fragment, and the device-memory plane's
+#: ``model_hbm_bytes`` / ``train_peak_bytes`` the anchored ``_bytes$``
+#: tail (resident bytes growing IS the regression the memacct keys
+#: gate; the anchor stays — a bare ``bytes`` fragment would flip
+#: direction on any future metric merely containing the word)
 _LOWER_BETTER = re.compile(r"(_ms$|_ms_|_sec$|_sec_|_seconds|latency|"
                            r"_bytes$|p50|p99|debt|rmse|drift)")
 

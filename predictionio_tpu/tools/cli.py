@@ -1013,6 +1013,10 @@ def cmd_fleet(args) -> int:
     body = {}
     if args.reload:
         body["reload"] = True
+        if getattr(args, "force", False):
+            # acknowledge a 507 preflight refusal: the operator owns
+            # the OOM risk now (obs/memacct.py)
+            body["force"] = True
     if args.drain is not None:
         body["drain"] = args.drain
     if args.readmit is not None:
@@ -1052,6 +1056,85 @@ def cmd_fleet(args) -> int:
     swap = state.get("swap") or {}
     if swap.get("active") or swap.get("last"):
         _p(format_swap(swap))
+    return 0
+
+
+def _fmt_bytes(n) -> str:
+    """Human bytes for the mem report (binary units — HBM is sized in
+    GiB); None renders as '-'."""
+    if n is None:
+        return "-"
+    n = float(n)
+    sign = "-" if n < 0 else ""
+    n = abs(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return (f"{sign}{n:.0f} {unit}" if unit == "B"
+                    else f"{sign}{n:.2f} {unit}")
+        n /= 1024.0
+    return f"{sign}{n:.2f} TiB"
+
+
+def cmd_mem(args) -> int:
+    """Device-memory accounting (obs/memacct.py): headroom + basis,
+    the per-model HBM ledger, train high-water peaks and the last OOM
+    preflight decision — from a live server's ``GET /admin/memory``
+    with --url, else this process's own ledger (useful after an
+    in-process `pio train`)."""
+    if args.url:
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            args.url.rstrip("/") + "/admin/memory")
+        _add_admin_auth(req)
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                report = json.load(resp)
+        except urllib.error.HTTPError as e:
+            raise CommandError(
+                f"memory report failed ({e.code}): "
+                f"{e.read().decode(errors='replace')[:200]}")
+        except urllib.error.URLError as e:
+            raise CommandError(f"cannot reach {args.url}: {e.reason}")
+    else:
+        from predictionio_tpu.obs import memacct
+
+        report = memacct.report()
+    if args.json:
+        json.dump(report, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+        return 0
+    _p(f"device memory ({report['basis']} basis): "
+       f"{_fmt_bytes(report['in_use_bytes'])} in use of "
+       f"{_fmt_bytes(report['capacity_bytes'])} — headroom "
+       f"{_fmt_bytes(report['headroom_bytes'])}")
+    models = report.get("models") or {}
+    if not models:
+        _p("  (no ledgered model residency in this process)")
+    for model in sorted(models):
+        block = models[model]
+        components = " ".join(
+            f"{name}={_fmt_bytes(nbytes)}"
+            for name, nbytes in sorted(block["components"].items()))
+        _p(f"  {model:>12} {_fmt_bytes(block['total_bytes']):>12}  "
+           f"{components}")
+    peaks = report.get("train_peaks") or {}
+    for model in sorted(peaks):
+        peak = peaks[model]
+        _p(f"  train peak {model}: {_fmt_bytes(peak['bytes'])} "
+           f"({peak['source']})")
+    pre = report.get("preflight") or {}
+    state = "on" if pre.get("enabled") else "OFF (PIO_MEM_PREFLIGHT=0)"
+    line = (f"preflight {state}, estimate scale "
+            f"x{pre.get('estimate_scale')}")
+    last = pre.get("last")
+    if last:
+        line += (f"; last: {last.get('result')} instance "
+                 f"{last.get('instance')} "
+                 f"(est {_fmt_bytes(last.get('estimated_bytes'))} vs "
+                 f"headroom {_fmt_bytes(last.get('headroom_bytes'))})")
+    _p(line)
     return 0
 
 
@@ -1507,9 +1590,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--readmit", default=None, metavar="REPLICA",
                    help="put REPLICA back into rotation (readiness "
                         "probes permitting)")
+    p.add_argument("--force", action="store_true",
+                   help="with --reload: override the replicas' "
+                        "device-memory preflight (a 507-refused swap)")
     p.add_argument("--json", action="store_true",
                    help="dump the raw fleet snapshot JSON")
     p.set_defaults(func=cmd_fleet)
+
+    p = sub.add_parser(
+        "mem",
+        help="device-memory accounting (obs/memacct.py): per-model "
+             "HBM ledger, headroom, train peaks and the OOM-preflight "
+             "state (GET /admin/memory)",
+    )
+    p.add_argument("--url", default=None,
+                   help="base URL of any PIO server (sends the "
+                        "PIO_ADMIN_TOKEN bearer header when set); "
+                        "default: this process's own ledger")
+    p.add_argument("--json", action="store_true",
+                   help="dump the raw /admin/memory payload")
+    p.set_defaults(func=cmd_mem)
 
     p = sub.add_parser(
         "replay",
@@ -1600,7 +1700,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(func=cmd_bench_compare)
 
     p = sub.add_parser("lint", help="run graftlint (JAX/TPU-aware static "
-                                    "analysis, rules JT01-JT11) over the tree")
+                                    "analysis, rules JT01-JT16) over the tree")
     p.add_argument("paths", nargs="*", default=[],
                    help="files/dirs (default: the installed package)")
     p.add_argument("--format", choices=["human", "json"], default="human")

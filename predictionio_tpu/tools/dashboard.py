@@ -36,6 +36,11 @@ reference — operator views of this process's diagnostics:
                            series, the latest replay comparison
                            report, and the canary verdict. JSON at
                            /admin/quality.
+  GET /memory           -> HTML panel of the device-memory
+                           accounting plane (obs/memacct.py):
+                           headroom + basis, the per-model HBM
+                           ledger, train peaks and the last OOM
+                           preflight decision. JSON at /admin/memory.
   GET /fleet            -> HTML panel of the serving fleet(s)
                            supervised IN THIS PROCESS
                            (serving/fleet.py ACTIVE registry —
@@ -104,6 +109,10 @@ class _DashboardRequestHandler(JSONRequestHandler):
             return
         if path == "/quality":
             self._send_cors(200, self.server_ref.quality_html(),
+                            "text/html; charset=UTF-8")
+            return
+        if path == "/memory":
+            self._send_cors(200, self.server_ref.memory_html(),
                             "text/html; charset=UTF-8")
             return
         parts = [p for p in path.split("/") if p]
@@ -175,6 +184,7 @@ class DashboardServer(HTTPServerBase):
             '<a href="/resilience">resilience</a> · '
             '<a href="/timeline">timelines</a> · '
             '<a href="/quality">model quality</a> · '
+            '<a href="/memory">device memory</a> · '
             '<a href="/fleet">fleet</a> · '
             '<a href="/metrics">metrics</a> · '
             '<a href="/readyz">readiness</a></p>'
@@ -415,6 +425,84 @@ class DashboardServer(HTTPServerBase):
             "<h2>Canary</h2>"
             f"{canary_html}"
             '<p><a href="/admin/quality">JSON</a> · '
+            '<a href="/">index</a></p></body></html>'
+        )
+
+    def memory_html(self) -> str:
+        """The device-memory accounting plane (obs/memacct.py) as an
+        operator panel: capacity/headroom with their basis, a
+        ``mem.headroom`` timeline sparkline, the per-model component
+        ledger, train peaks and the last OOM-preflight decision —
+        every number read from memacct's one report, so this panel,
+        ``pio mem`` and ``GET /admin/memory`` can never disagree."""
+        import html as _html
+
+        from predictionio_tpu.obs import memacct
+        from predictionio_tpu.obs.timeline import TIMELINE, sparkline
+
+        report = memacct.report()
+
+        def esc(v) -> str:
+            return _html.escape(str(v))
+
+        model_rows = []
+        for model in sorted(report.get("models") or {}):
+            block = report["models"][model]
+            components = ", ".join(
+                f"{name}: {nbytes:,} B" for name, nbytes in
+                sorted(block["components"].items()))
+            model_rows.append(
+                f"<tr><td>{esc(model)}</td>"
+                f"<td>{block['total_bytes']:,} B</td>"
+                f"<td>{esc(components)}</td></tr>")
+        peak_rows = [
+            f"<tr><td>{esc(model)}</td><td>{peak['bytes']:,} B</td>"
+            f"<td>{esc(peak['source'])}</td></tr>"
+            for model, peak in sorted(
+                (report.get("train_peaks") or {}).items())]
+        series = (TIMELINE.series().get("series") or {}).get(
+            "mem.headroom") or []
+        spark = sparkline([p[1] for p in series], 40)
+        pre = report.get("preflight") or {}
+        last = pre.get("last")
+
+        def bytes_or_dash(v) -> str:
+            # an unknown_size decision stores estimated_bytes=None —
+            # render '-' like `pio mem`, never the Python None repr
+            return "-" if v is None else f"{int(v):,} B"
+
+        last_line = ("no preflight decision yet" if not last else
+                     f"last: {esc(last.get('result'))} for instance "
+                     f"{esc(last.get('instance'))} (estimated "
+                     f"{bytes_or_dash(last.get('estimated_bytes'))} vs "
+                     f"headroom "
+                     f"{bytes_or_dash(last.get('headroom_bytes'))})")
+        return (
+            "<!DOCTYPE html><html><head><title>Device memory</title>"
+            "</head><body><h1>Device memory</h1>"
+            f"<p>Basis <b>{esc(report['basis'])}</b>: "
+            f"{report['in_use_bytes']:,} B in use of "
+            f"{report['capacity_bytes']:,} B — headroom "
+            f"<b>{report['headroom_bytes']:,} B</b> (floor "
+            f"{report['headroom_floor_fraction']:.0%} of capacity; "
+            "PIO_PEAK_HBM_BYTES / PIO_MEM_HEADROOM_FLOOR).</p>"
+            f"<p>headroom <code>{esc(spark) or '(no samples yet)'}"
+            "</code></p>"
+            "<h2>Per-model ledger</h2>"
+            "<table border='1'><tr><th>Model</th><th>Total</th>"
+            "<th>Components</th></tr>"
+            f"{''.join(model_rows) or '<tr><td colspan=3>(empty)</td></tr>'}"
+            "</table>"
+            "<h2>Train peaks</h2>"
+            "<table border='1'><tr><th>Model</th><th>Peak bytes</th>"
+            "<th>Basis</th></tr>"
+            f"{''.join(peak_rows) or '<tr><td colspan=3>(none)</td></tr>'}"
+            "</table>"
+            "<h2>OOM preflight</h2>"
+            f"<p>{'enabled' if pre.get('enabled') else 'DISABLED'} "
+            f"(estimate scale x{pre.get('estimate_scale')}); "
+            f"{last_line}</p>"
+            '<p><a href="/admin/memory">JSON</a> · '
             '<a href="/">index</a></p></body></html>'
         )
 

@@ -267,7 +267,7 @@ def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="python -m predictionio_tpu.tools.lint",
         description="graftlint — JAX/TPU-aware static analysis "
-                    "(rules JT01-JT11; see --list-rules)",
+                    "(rules JT01-JT16; see --list-rules)",
     )
     parser.add_argument("paths", nargs="*", default=[],
                         help="files or directories to lint (default: the "

@@ -1,4 +1,4 @@
-"""graftlint rules JT01-JT15: the TPU hazards this codebase has hit.
+"""graftlint rules JT01-JT16: the TPU hazards this codebase has hit.
 
 Each rule encodes a failure class with a concrete precedent in this
 tree's history (the bf16-Gramian divergence behind JT03 is recorded in
@@ -1449,3 +1449,129 @@ class NonMonotonicDurationClock(Rule):
                     "with time.monotonic()/time.perf_counter() and "
                     "keep time.time() for exported timestamps",
                 )
+
+
+# -- JT16 ----------------------------------------------------------------------
+
+def _is_device_transfer_call(func: ast.AST) -> bool:
+    """``jax.device_put`` / ``jnp.array`` / ``jnp.asarray`` — the calls
+    that place bytes on device (shared tell of JT13 and JT16)."""
+    d = dotted(func)
+    if not d:
+        return False
+    head, _, tail = d.rpartition(".")
+    if tail == "device_put":
+        return head in ("jax", "") or head.endswith("jax")
+    if tail in ("array", "asarray"):
+        return head in _JNP_MODULES
+    return False
+
+
+@register
+class UnledgeredDeviceResidency(Rule):
+    id = "JT16"
+    name = "unledgered-device-residency"
+    rationale = (
+        "A jax.device_put / jnp.array / jnp.asarray result stored on a "
+        "self.* attribute is a LONG-LIVED device allocation: it serves "
+        "queries and owns HBM until the object dies. Unledgered, it is "
+        "invisible to the device-memory accounting plane "
+        "(obs/memacct.MemLedger) — per-model gauges under-report, "
+        "headroom over-reports, and the OOM preflight approves deploys "
+        "that cannot fit: a serving process OOMs with every gauge "
+        "reading healthy. Pair the assignment with a "
+        "MemLedger.register / *_register_mem call in the same scope "
+        "(re-pricing under the same owner is idempotent), or justify "
+        "the suppression."
+    )
+
+    #: the hazard lives where serving objects hold device tables;
+    #: ops-layer trainers price themselves at a coarser seam and
+    #: short-lived compute temporaries would be all noise
+    def applies_to(self, abspath: str) -> bool:
+        return ("/models/" in abspath or "/index/" in abspath
+                or "/serving/" in abspath)
+
+    @staticmethod
+    def _contains_transfer(node: ast.AST) -> bool:
+        return any(isinstance(n, ast.Call)
+                   and _is_device_transfer_call(n.func)
+                   for n in ast.walk(node))
+
+    @staticmethod
+    def _body_walk(fn: ast.AST) -> Iterator[ast.AST]:
+        """Walk a function's OWN body — nested defs are their own
+        scope (their register call cannot vouch for the outer one and
+        vice versa)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            body = list(self._body_walk(fn))
+            # the pairing tell: any register-shaped call in the same
+            # scope (memacct.LEDGER.register, self._register_mem, a
+            # release/re-register helper) vouches for the residency
+            has_register = any(
+                isinstance(n, ast.Call)
+                and "register" in dotted(n.func).lower()
+                for n in body)
+            if has_register:
+                continue
+            # one-hop local taint: `padded = jnp.asarray(...);
+            # self._cache = padded` is the same residency spelled in
+            # two statements (AnnAssign included — an annotation does
+            # not launder the transfer)
+            tainted: Set[str] = set()
+            for node in body:
+                if isinstance(node, ast.Assign):
+                    t_targets, t_value = node.targets, node.value
+                elif (isinstance(node, ast.AnnAssign)
+                      and node.value is not None):
+                    t_targets, t_value = [node.target], node.value
+                else:
+                    continue
+                if self._contains_transfer(t_value):
+                    for tgt in t_targets:
+                        if isinstance(tgt, ast.Name):
+                            tainted.add(tgt.id)
+            for node in body:
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif (isinstance(node, ast.AnnAssign)
+                      and node.value is not None):
+                    targets, value = [node.target], node.value
+                else:
+                    continue
+                # flatten tuple/list targets: `self._u, self._i = ...`
+                # is two residency stores, not an exempt Tuple node
+                flat = []
+                for t in targets:
+                    flat.extend(t.elts if isinstance(
+                        t, (ast.Tuple, ast.List)) else [t])
+                stores_on_self = any(
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    for t in flat)
+                if not stores_on_self:
+                    continue
+                resident = self._contains_transfer(value) or (
+                    isinstance(value, ast.Name) and value.id in tainted)
+                if resident:
+                    yield Finding(
+                        self.id, ctx.path, node.lineno, node.col_offset,
+                        "device-transfer result stored on self.* with "
+                        "no MemLedger.register in the same scope — a "
+                        "long-lived allocation the memory ledger (and "
+                        "the OOM preflight) cannot see; register a "
+                        "Footprint (obs/memacct) beside it or justify "
+                        "a suppression",
+                    )

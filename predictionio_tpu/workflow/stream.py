@@ -559,6 +559,7 @@ class StreamUpdater:
 
         if prepare_deploy is None:
             from predictionio_tpu.workflow.deploy import prepare_deploy
+        old_folders = getattr(self, "_folders", None)
         deployment = prepare_deploy(self.engine, instance, self._ctx,
                                     self.storage)
         prev_instance_id = getattr(self, "instance_id", None)
@@ -614,6 +615,23 @@ class StreamUpdater:
             if model is not None and quality.ShadowRef.supports(model):
                 self._shadows[folder.index] = quality.ShadowRef(
                     model, instance.id)
+        # LAST: retire the PREVIOUS bind's fold-lane models from the
+        # device-memory ledger (obs/memacct.py) — only once the rebind
+        # fully succeeded. resync is advisory (callers catch failures
+        # anywhere above — resolve_app, the delta-capability check,
+        # delta_cursor — and keep folding on the OLD models), and
+        # releasing still-active models would under-report residency,
+        # over-report headroom, and let the preflight approve deploys
+        # that cannot fit. A failure AFTER _folders was reassigned errs
+        # the safe way: the old models stay ledgered until GC sweeps
+        # their weakrefs.
+        if old_folders:
+            from predictionio_tpu.obs import memacct
+
+            for folder in old_folders:
+                old_model = getattr(folder, "model", None)
+                if old_model is not None:
+                    memacct.release_model(old_model)
 
     def resync(self) -> None:
         """Rebind to the newest COMPLETED instance (after a retrain or

@@ -25,7 +25,7 @@ from predictionio_tpu.core.params import EngineParams, params_to_dict
 from predictionio_tpu.core.persistent_model import PersistentModel, manifest_for
 from predictionio_tpu.data.metadata import EngineInstance, Model
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.obs import health, jaxmon, perfacct, profiler
+from predictionio_tpu.obs import health, jaxmon, memacct, perfacct, profiler
 from predictionio_tpu.parallel.mesh import MeshContext
 from predictionio_tpu.workflow.config import WorkflowParams
 
@@ -233,7 +233,10 @@ def run_train(
         train_sec = _time.perf_counter() - t_train
         jaxmon.TRAIN_SECONDS.labels(engine_id).observe(train_sec)
         perfacct.LEDGER.note_stage("train", train_sec)
-        jaxmon.update_device_memory_gauges()
+        # device-memory plane (obs/memacct.py, the single owner of the
+        # gauges): post-train refresh of allocator stats, ledger and
+        # headroom — the continuous cadence rides the flight snapshots
+        memacct.refresh()
         if result.stopped_after:
             # debug interruption (ref: Engine.scala:624-648): no model persisted
             instance.status = "COMPLETED"

@@ -139,6 +139,22 @@ class MaybeBoomAlgo(Algorithm):
         return {"result": model * query["mult"]}
 
 
+def _await_sealed(trace_id, timeout=5.0):
+    """The flight record seals on the HANDLER thread after the response
+    bytes already reached the client (obs/flight.py finish runs in the
+    instrument wrapper's finally) — a test reading the ring right after
+    its request must wait for the seal, not race it."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for r in flight.RECORDER.records():
+            if r["trace"] == trace_id:
+                return r
+        time.sleep(0.02)
+    raise AssertionError(
+        f"record for trace {trace_id} never sealed within {timeout}s: "
+        f"{[(r.get('route'), r.get('trace')) for r in flight.RECORDER.records()]}")
+
+
 @pytest.fixture()
 def flight_server(memory_storage):
     engine = Engine(OneDataSource, IdentityPreparator,
@@ -209,6 +225,9 @@ def test_induced_error_lands_in_dump_without_operator_action(
     assert status == 500
     failed_trace = headers[trace.TRACE_HEADER]
 
+    # the record seals (and the error dump writes) on the handler
+    # thread AFTER the 500 already reached the client — wait for it
+    _await_sealed(failed_trace)
     status, _, body = http("GET", f"{base}/admin/flight")
     assert status == 200
     record = next(r for r in json.loads(body)["records"]
@@ -220,8 +239,14 @@ def test_induced_error_lands_in_dump_without_operator_action(
     status, _, body = http("GET", f"{base}/admin/flight?slow=1")
     assert any(r["trace"] == failed_trace
                for r in json.loads(body)["records"])
-    # the automatic on-disk dump was written and parses
-    dumps = list((tmp_path / "dumps").glob("flight-*.json"))
+    # the automatic on-disk dump was written and parses (the write
+    # follows the seal on the handler thread — poll briefly)
+    deadline = time.monotonic() + 5.0
+    dumps = []
+    while not dumps and time.monotonic() < deadline:
+        dumps = list((tmp_path / "dumps").glob("flight-*.json"))
+        if not dumps:
+            time.sleep(0.02)
     assert dumps, "error must trigger an automatic dump file"
     on_disk = json.loads(dumps[0].read_text())
     assert any(r.get("trace") == failed_trace for r in on_disk["records"])
@@ -246,12 +271,17 @@ def test_slow_request_flag_stage_sums_and_json_log(flight_server,
                                   {"mult": 7})
         assert status == 200
         trace_id = headers[trace.TRACE_HEADER]
+        record = _await_sealed(trace_id)
+        # the pio.slow line fires on the handler thread right after
+        # the seal — keep our log handler attached until it lands
+        deadline = time.monotonic() + 5.0
+        while trace_id not in buf.getvalue() and (
+                time.monotonic() < deadline):
+            time.sleep(0.02)
     finally:
         slow_logger.removeHandler(handler)
         slow_logger.setLevel(old_level)
 
-    record = next(r for r in flight.RECORDER.records()
-                  if r["trace"] == trace_id)
     assert record["slow"] is True
     assert sum(record["stages"].values()) == pytest.approx(
         record["duration_ms"], abs=0.1)

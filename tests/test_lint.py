@@ -38,7 +38,7 @@ def rule_ids(findings):
 def test_all_rules_registered():
     assert {"JT01", "JT02", "JT03", "JT04", "JT05", "JT06",
             "JT07", "JT08", "JT09", "JT10", "JT11", "JT12",
-            "JT13", "JT14", "JT15"} <= set(RULES)
+            "JT13", "JT14", "JT15", "JT16"} <= set(RULES)
 
 
 def test_syntax_error_is_reported_not_raised(tmp_path):
@@ -1155,4 +1155,104 @@ def test_jt15_suppressible_with_justification(tmp_path):
             now = time.time()
             return now - first  # graftlint: disable=JT15 — fixture: cross-process wall horizon by design
     """)
+    assert findings == []
+
+
+# -- JT16 unledgered-device-residency ------------------------------------------
+
+def test_jt16_positive_direct_self_assignment(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+
+        class Model:
+            def load(self, table):
+                self._table = jax.device_put(table)
+    """, relpath="models/m.py")
+    assert rule_ids(findings) == ["JT16"]
+    assert "MemLedger" in findings[0].message
+
+
+def test_jt16_positive_one_hop_local(tmp_path):
+    # the two-statement spelling of the same residency: a local holds
+    # the transfer result, then lands on self
+    findings = lint_src(tmp_path, """\
+        import jax.numpy as jnp
+
+        class Index:
+            def warm(self, vectors):
+                padded = pad(jnp.asarray(vectors), 128)
+                self._device_padded = padded
+    """, relpath="index/i.py")
+    assert rule_ids(findings) == ["JT16"]
+
+
+def test_jt16_positive_tuple_targets_and_annassign_taint(tmp_path):
+    # `self._u, self._i = device_put(...), device_put(...)` is two
+    # residency stores, and an ANNOTATED local carries the taint too
+    findings = lint_src(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+
+        class Model:
+            def load(self, u, i):
+                self._u, self._i = jax.device_put(u), jax.device_put(i)
+
+            def warm(self, x):
+                padded: object = jnp.asarray(x)
+                self._cache = padded
+    """, relpath="models/m.py")
+    assert rule_ids(findings) == ["JT16", "JT16"]
+
+
+def test_jt16_negative_register_in_same_scope(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import jax
+        from predictionio_tpu.obs import memacct
+
+        class Model:
+            def load(self, table):
+                self._table = jax.device_put(table)
+                memacct.LEDGER.register(self, "m", "table",
+                                        int(self._table.nbytes))
+
+            def load_helper(self, table):
+                self._table = jax.device_put(table)
+                self._register_mem(self._table.nbytes)
+    """, relpath="models/m.py")
+    assert findings == []
+
+
+def test_jt16_negative_out_of_scope_paths_and_locals(tmp_path):
+    # ops-layer trainers price at their own coarser seam (out of the
+    # rule's path scope), and a LOCAL device array is a compute
+    # temporary, not residency
+    src = """\
+        import jax
+        import jax.numpy as jnp
+
+        class Trainer:
+            def step(self, x):
+                dev = jnp.asarray(x)
+                return dev * 2
+    """
+    assert lint_src(tmp_path, src, relpath="ops/t.py") == []
+    src2 = """\
+        import jax
+
+        class Trainer:
+            def place(self, x):
+                self._x = jax.device_put(x)
+    """
+    assert lint_src(tmp_path, src2, relpath="ops/t.py") == []
+
+
+def test_jt16_suppressible_with_justification(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import jax
+
+        class Model:
+            def load(self, table):
+                self._table = jax.device_put(table)  # graftlint: disable=JT16 — fixture: test-only toy table, bytes negligible
+    """, relpath="models/m.py")
     assert findings == []

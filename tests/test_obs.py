@@ -366,13 +366,23 @@ def test_trace_chain_engine_to_storage_server(memory_storage):
         # the trace id round-trips in the response
         assert headers[trace.TRACE_HEADER] == trace_id
 
-        spans = trace.recent_spans(trace_id=trace_id)
-        names = [s["name"] for s in spans]
-        # engine-server request -> serve.query -> worker dispatch ->
-        # rest client scan -> storage-server request: one trace id
-        for expected in ("http.engineserver", "serve.query",
-                         "serve.dispatch", "storage.find",
-                         "http.storageserver"):
+        # each server's outer http span is emitted by ITS handler
+        # thread as the instrument wrapper unwinds — AFTER the response
+        # bytes already reached the caller, so the full chain lands
+        # asynchronously with the client's return: poll briefly
+        import time as _time
+
+        wanted = ("http.engineserver", "serve.query", "serve.dispatch",
+                  "storage.find", "http.storageserver")
+        deadline = _time.monotonic() + 5.0
+        while True:
+            spans = trace.recent_spans(trace_id=trace_id)
+            names = [s["name"] for s in spans]
+            if all(e in names for e in wanted) or (
+                    _time.monotonic() >= deadline):
+                break
+            _time.sleep(0.02)
+        for expected in wanted:
             assert expected in names, (expected, names)
         assert {s["trace"] for s in spans} == {trace_id}
         # parenthood: serve.query is a child of the engine-server span
