@@ -1150,6 +1150,10 @@ def _fleet_stage(storage, cfg, detail):
                     "srv_p99_ms": round(_pct(srv, 0.99) * 1e3, 2),
                 }
                 sweep.append(point)
+                if n_replicas == max(replica_counts):
+                    _federation_bench(router,
+                                      {"user": users[0], "num": 10},
+                                      detail)
             finally:
                 if router is not None:
                     router.stop()
@@ -1169,6 +1173,50 @@ def _fleet_stage(storage, cfg, detail):
         "threaded replicas share the bench host's core(s): the sweep "
         "prices the router hop + redundancy, not multi-core scaling; "
         "server-side percentiles merge all replicas' serving times")
+
+
+def _federation_bench(router, payload, detail):
+    """Price the observability federation plane (obs/collect.py) over
+    the live bench fleet: one full member /metrics merge
+    (``fleet_scrape_ms`` — the cost of a fleet-wide scrape pass) and
+    one cross-process trace stitch (``trace_stitch_ms`` — query the
+    router, then assemble the spans into the annotated tree). Both are
+    benchcmp-gated lower-better (`_ms` suffix). Best-effort: a failed
+    probe query leaves a note, never fails the fleet stage."""
+    import urllib.request as _ur
+
+    from predictionio_tpu.obs import collect, trace as trace_mod
+
+    members = collect.default_members(router)
+    t0 = time.perf_counter()
+    fed = collect.federate_metrics(members)
+    detail["fleet_scrape_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+    detail["fleet_members_scraped"] = len(fed["merged_from"])
+    req = _ur.Request(
+        f"http://127.0.0.1:{router.port}/queries.json",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with _ur.urlopen(req, timeout=30) as resp:
+            resp.read()
+            trace_id = resp.headers.get(trace_mod.TRACE_HEADER)
+    except Exception as e:  # noqa: BLE001 — the stitch number is
+        # telemetry about telemetry; never fail the sweep over it
+        detail["trace_stitch_note"] = f"stitch probe query failed: {e}"
+        return
+    if not trace_id:
+        return
+    # the edge spans seal as the handler threads unwind, AFTER the
+    # response bytes: wait for the ring to carry the trace so the
+    # stitch timing prices assembly, not an empty fan-out
+    deadline = time.perf_counter() + 2.0
+    while (not trace_mod.recent_spans(trace_id=trace_id)
+           and time.perf_counter() < deadline):
+        time.sleep(0.01)
+    t0 = time.perf_counter()
+    doc = collect.stitch_trace(trace_id, members)
+    detail["trace_stitch_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+    detail["trace_stitch_spans"] = doc["span_count"]
 
 
 def stage_loadgen(config_json):
@@ -1842,6 +1890,11 @@ def emit_headline(detail, detail_path=None):
         # count; bench-compare gates the p99 lower-better, qps higher)
         "fleet_qps_128conn": detail.get("fleet_qps_128conn"),
         "fleet_srv_p99_ms_128conn": detail.get("fleet_srv_p99_ms_128conn"),
+        # observability federation (obs/collect.py): one full member
+        # /metrics merge and one cross-process trace stitch over the
+        # bench fleet (benchcmp: _ms suffix = lower-better)
+        "fleet_scrape_ms": detail.get("fleet_scrape_ms"),
+        "trace_stitch_ms": detail.get("trace_stitch_ms"),
         # streaming freshness (PR 9): append->servable-changed-prediction
         # through the fold-in path (benchcmp: _ms suffix = lower-better)
         # and fold-in throughput (per_sec = higher-better)

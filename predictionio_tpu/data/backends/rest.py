@@ -111,11 +111,13 @@ class _Transport:
         )
         if self.auth_key:
             req.add_header("X-PIO-Storage-Key", self.auth_key)
-        trace_id = trace.current_trace_id()
-        if trace_id:
-            # propagate the serving request's trace id so the storage
-            # server's span records join the same chain
-            req.add_header(trace.TRACE_HEADER, trace_id)
+        # propagate the serving request's trace id (and the active
+        # span as X-PIO-Parent-Span) so the storage server's span
+        # records join the same chain — and the federation collector
+        # (obs/collect.py) can parent its edge span under this
+        # client's storage.* span in the stitched cross-process tree
+        for name, value in trace.traced_headers().items():
+            req.add_header(name, value)
         return req
 
     def _error(self, path: str, e: urllib.error.HTTPError) -> S.StorageError:

@@ -19,6 +19,13 @@ This module is a deliberately small tracer:
   - context travels in a contextvar; spans nest (parent ids) within a
     thread, and ``current_context()``/``activate_context()`` hand the
     trace across explicit thread hops (the serving micro-batcher)
+  - cross-process parenting: outbound intra-fleet calls attach the
+    active span id as ``X-PIO-Parent-Span`` (``traced_headers()``)
+    beside the trace id; the receiving edge (serving/http.py) parents
+    its span to it, so obs/collect.py can stitch the per-process rings
+    into one tree. The ring is sized by ``PIO_SPAN_RING`` and counts
+    evictions in ``pio_trace_spans_evicted_total`` — the collector's
+    "why is this trace partial" evidence.
 
 Spans only record while a trace is active — background work that no
 request asked about stays silent, so the ring buffer and trace log hold
@@ -46,18 +53,47 @@ log = logging.getLogger(__name__)
 #: propagation header, engine server -> storage client -> storage server
 TRACE_HEADER = "X-PIO-Trace-Id"
 
+#: the CALLER's active span id, riding beside the trace id on every
+#: intra-fleet request: the receiving server parents its edge span to
+#: it, so the federation collector (obs/collect.py) can stitch the
+#: per-process rings into ONE cross-process tree instead of a forest
+#: of per-process roots
+PARENT_HEADER = "X-PIO-Parent-Span"
+
 #: ids we mint are 32-hex; inbound ids must at least be id-SHAPED (hex
 #: + hyphens, bounded length) — anything else is discarded and re-minted
 #: at the edge, so untrusted header bytes never reach response headers,
 #: downstream requests or the span log
 _TRACE_ID_RE = re.compile(r"^[0-9a-fA-F-]{8,64}$")
 
+#: span ids we mint are 16-hex; same inbound-shape discipline as trace
+#: ids (an invalid parent is dropped, the edge span simply roots)
+_SPAN_ID_RE = re.compile(r"^[0-9a-fA-F]{8,32}$")
+
 
 def valid_trace_id(value: str) -> bool:
     return bool(value and _TRACE_ID_RE.match(value))
 
-#: ring buffer size: enough for a test run or a quick operator look-back
+
+def valid_span_id(value: str) -> bool:
+    return bool(value and _SPAN_ID_RE.match(value))
+
+#: default ring buffer size: enough for a test run or a quick operator
+#: look-back; serving hosts size it via PIO_SPAN_RING (a fleet member
+#: whose ring evicts a trace's spans makes that trace PARTIAL at the
+#: collector — pio_trace_spans_evicted_total says why)
 RECENT_LIMIT = 4096
+
+
+def ring_capacity() -> int:
+    """The span ring size (``PIO_SPAN_RING``, default
+    :data:`RECENT_LIMIT`; read per emit so env changes and test
+    monkeypatching take effect without a restart)."""
+    try:
+        cap = int(os.environ.get("PIO_SPAN_RING", RECENT_LIMIT))
+    except ValueError:
+        return RECENT_LIMIT
+    return max(1, cap)
 
 #: PIO_TRACE_LOG rotation threshold: when the current file outgrows
 #: this many bytes it is rolled to ``<path>.1`` (replacing any previous
@@ -76,6 +112,13 @@ _LOG_ROTATIONS_TOTAL = metrics.counter(
     "rolled file's spans)",
 )
 
+_SPANS_EVICTED_TOTAL = metrics.counter(
+    "pio_trace_spans_evicted_total",
+    "Span records evicted from the in-process ring (PIO_SPAN_RING) — "
+    "a trace the federation collector reports as partial lost its "
+    "spans here",
+)
+
 
 class SpanContext(NamedTuple):
     """Immutable (trace id, active span id) — safe to hand across threads."""
@@ -89,7 +132,7 @@ _ctx: "contextvars.ContextVar[Optional[SpanContext]]" = contextvars.ContextVar(
 )
 
 _recent: "collections.deque[Dict[str, Any]]" = collections.deque(
-    maxlen=RECENT_LIMIT
+    maxlen=ring_capacity()
 )
 _emit_lock = threading.Lock()
 
@@ -192,8 +235,20 @@ def remove_sink(fn) -> None:
 
 
 def _emit(record: Dict[str, Any]) -> None:
+    global _recent
     _SPANS_TOTAL.labels(record["name"]).inc()
     with _emit_lock:
+        cap = ring_capacity()
+        if _recent.maxlen != cap:
+            # PIO_SPAN_RING changed since the last emit: re-bound the
+            # ring in place (a shrink drops the oldest spans — those
+            # ARE evictions, the collector must be able to say so)
+            dropped = max(0, len(_recent) - cap)
+            _recent = collections.deque(_recent, maxlen=cap)
+            if dropped:
+                _SPANS_EVICTED_TOTAL.inc(dropped)
+        if len(_recent) == _recent.maxlen:
+            _SPANS_EVICTED_TOTAL.inc()
         _recent.append(record)
         sinks = list(_sinks)
     for fn in sinks:
@@ -220,6 +275,44 @@ def recent_spans(n: Optional[int] = None,
 def clear_recent() -> None:
     with _emit_lock:
         _recent.clear()
+
+
+@contextlib.contextmanager
+def new_trace():
+    """Activate a FRESH trace for the scope of a background job (a
+    stream fold cycle, a replay run): its spans and the trace headers
+    its outbound calls attach (:func:`traced_headers`) all correlate
+    under one minted id, so ``pio trace`` can follow the job across
+    the fleet. Yields the trace id."""
+    token = activate(new_trace_id())
+    try:
+        yield current_trace_id()
+    finally:
+        deactivate(token)
+
+
+def evicted_total() -> int:
+    """Spans this process's ring has evicted so far (the collector
+    quotes it when it reports a trace as partial)."""
+    return int(_SPANS_EVICTED_TOTAL.value)
+
+
+def traced_headers(headers: Optional[Dict[str, str]] = None
+                   ) -> Dict[str, str]:
+    """A copy of ``headers`` carrying the active trace context: the
+    trace id (``X-PIO-Trace-Id``) and, when a span is open, its id as
+    the ``X-PIO-Parent-Span`` the receiving server parents its edge
+    span to. No active trace -> the headers pass through untouched
+    (background probes and daemons stay silent) — so every intra-fleet
+    call site can attach propagation unconditionally (graftlint JT17
+    audits that they do)."""
+    out = dict(headers or {})
+    ctx = _ctx.get()
+    if ctx is not None:
+        out[TRACE_HEADER] = ctx.trace_id
+        if ctx.span_id:
+            out[PARENT_HEADER] = ctx.span_id
+    return out
 
 
 @contextlib.contextmanager

@@ -771,7 +771,12 @@ class EngineServer(HTTPServerBase):
             req = urllib.request.Request(
                 url,
                 data=json.dumps(payload).encode(),
-                headers={"Content-Type": "application/json"},
+                # the feedback loop posts to the EVENT SERVER — a fleet
+                # member: the trace context (when one is active on this
+                # thread) lets the collector stitch prediction ->
+                # feedback into one tree (JT17)
+                headers=trace.traced_headers(
+                    {"Content-Type": "application/json"}),
                 method="POST",
             )
             urllib.request.urlopen(req, timeout=5)

@@ -72,7 +72,7 @@ import urllib.parse
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional
 
-from predictionio_tpu.obs import health, metrics, timeline
+from predictionio_tpu.obs import health, metrics, timeline, trace
 from predictionio_tpu.resilience.policy import Policy
 from predictionio_tpu.serving.http import drain_timeout
 
@@ -539,7 +539,8 @@ class FleetSupervisor:
     def _probe(self, replica: Replica):
         """(status, parsed body) — (None, error) on transport failure."""
         try:
-            req = urllib.request.Request(f"{replica.base_url}/readyz")
+            req = urllib.request.Request(f"{replica.base_url}/readyz",
+                                         headers=trace.traced_headers())
             with urllib.request.urlopen(
                     req, timeout=self._policy.deadline) as resp:
                 return resp.status, json.loads(resp.read() or b"{}")
@@ -560,7 +561,8 @@ class FleetSupervisor:
             # straggling swap thread must not re-mint them
             return
         try:
-            req = urllib.request.Request(f"{replica.base_url}/")
+            req = urllib.request.Request(f"{replica.base_url}/",
+                                         headers=trace.traced_headers())
             with urllib.request.urlopen(
                     req, timeout=self._policy.deadline) as resp:
                 status = json.loads(resp.read() or b"{}")
@@ -771,7 +773,8 @@ class FleetSupervisor:
             url = f"{replica.base_url}/reload"
             if params:
                 url += "?" + "&".join(params)
-            req = urllib.request.Request(url)
+            req = urllib.request.Request(
+                url, headers=trace.traced_headers())
             reload_timeout = metrics.env_float(
                 "PIO_FLEET_RELOAD_TIMEOUT", 300.0)
             with urllib.request.urlopen(req, timeout=reload_timeout) as resp:

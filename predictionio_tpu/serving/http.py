@@ -34,6 +34,20 @@ Every server also inherits the shared operator surface from the
                          per-model HBM ledger,       }
                          headroom, train peaks,      }
                          preflight state             }
+  GET  /admin/spans      this process's span ring    }
+                         (?trace=&n=; the federation }
+                         collector's query surface)  }
+  GET  /admin/trace      cross-process stitched      }
+                         trace (?id=; obs/collect.py }
+                         fans out to the fleet)      }
+  GET  /admin/fleet/metrics merged member /metrics   }
+                         (counters sum, histograms   }
+                         bucket-wise, gauges get a   }
+                         member label) + fleet SLO   }
+                         burn (404 without a fleet)  }
+  GET  /admin/fleet/tail fleet-wide tail attribution }
+                         over every member's flight  }
+                         recorder (404 w/o a fleet)  }
 
 ``/healthz``, ``/readyz`` and ``/metrics`` stay unauthenticated — a
 liveness prober or scraper holds no operator secrets; the ``/admin/*``
@@ -300,6 +314,118 @@ def _serve_admin_tail(handler, query: str) -> None:
     handler._send(200, report)
 
 
+def _serve_admin_spans(handler, query: str) -> None:
+    """``GET /admin/spans?trace=<id>&n=N``: THIS process's span ring
+    (obs/trace.py) — the federation collector's (obs/collect.py)
+    span-query surface, served on every server like ``/metrics``. The
+    payload carries the ring capacity (``PIO_SPAN_RING``) and the
+    eviction counter so a partial trace comes with its why."""
+    from predictionio_tpu.obs import collect
+
+    params = parse_qs(query)
+    trace_id = (params.get("trace") or [None])[0]
+    if trace_id is not None and not trace.valid_trace_id(trace_id):
+        handler._send(400, {"message": "trace must be id-shaped"})
+        return
+    try:
+        n = int(params["n"][0]) if "n" in params else None
+    except ValueError:
+        handler._send(400, {"message": "n must be an integer"})
+        return
+    server = handler.server_version.split("/", 1)[0]
+    handler._send(200, collect.span_page(server, trace_id, n))
+
+
+def _serve_admin_trace(handler, query: str) -> None:
+    """``GET /admin/trace?id=<trace>``: the CROSS-PROCESS stitched
+    trace — this server fans out to its federation members (its fleet's
+    replicas, the ACTIVE supervisors of this process, and the
+    ``PIO_OBS_MEMBERS`` extras), dedupes and assembles one annotated
+    tree (obs/collect.py). ``pio trace <id>`` and the dashboard's
+    ``/trace`` view render the same document."""
+    from predictionio_tpu.obs import collect
+
+    params = parse_qs(query)
+    trace_id = (params.get("id") or params.get("trace") or [None])[0]
+    if not trace_id or not trace.valid_trace_id(trace_id):
+        handler._send(400, {"message": "need an id-shaped ?id=<trace>"})
+        return
+    members = collect.default_members(handler.server_ref)
+    handler._send(200, collect.stitch_trace(trace_id, members))
+
+
+def _fleet_federation_members(handler):
+    """The member list for the fleet-scoped federations (metrics,
+    tail): the supervised fleet's replicas plus configured extras —
+    None (-> 404) on a server with neither, mirroring /admin/fleet."""
+    from predictionio_tpu.obs import collect
+
+    fleet = getattr(handler.server_ref, "fleet", None)
+    members = collect.fleet_members(fleet) + collect.env_members()
+    # first occurrence wins (same contract as collect.default_members):
+    # a replica ALSO listed in PIO_OBS_MEMBERS must not be scraped
+    # twice — the merge would double-sum its counters and buckets
+    seen: set = set()
+    deduped = []
+    for m in members:
+        key = (m.name, m.url)
+        if m.name in seen or m.url in seen:
+            continue
+        seen.update(key)
+        deduped.append(m)
+    return deduped or None
+
+
+def _serve_fleet_metrics(handler, query: str) -> None:
+    """``GET /admin/fleet/metrics``: the members' /metrics snapshots
+    merged (counters sum, histograms bucket-wise, gauges keep a
+    ``member`` label) + the fleet-level SLO burn over the merged
+    serving histogram. ``?format=prom`` answers the merged document in
+    Prometheus text form for a fleet-level scraper; default is the
+    JSON report. A member mid-restart degrades the merge, never fails
+    it."""
+    from predictionio_tpu.obs import collect
+
+    members = _fleet_federation_members(handler)
+    if members is None:
+        handler._send(404, {"message": "no fleet supervised by this "
+                                       "server and no PIO_OBS_MEMBERS "
+                                       "configured"})
+        return
+    report = collect.federate_metrics(members)
+    merged = report.pop("_merged")
+    fmt = (parse_qs(query).get("format") or [""])[0]
+    if fmt in ("prom", "prometheus", "text"):
+        handler._send(200, collect.render_merged(merged),
+                      content_type=metrics.CONTENT_TYPE)
+        return
+    handler._send(200, report)
+
+
+def _serve_fleet_tail(handler, query: str) -> None:
+    """``GET /admin/fleet/tail?q=``: tail attribution over the WHOLE
+    fleet's flight recorders — the members' stage timings merged
+    through the same perfacct.tail_report a single process serves at
+    /admin/tail, plus the per-member tail split."""
+    from predictionio_tpu.obs import collect
+
+    members = _fleet_federation_members(handler)
+    if members is None:
+        handler._send(404, {"message": "no fleet supervised by this "
+                                       "server and no PIO_OBS_MEMBERS "
+                                       "configured"})
+        return
+    params = parse_qs(query)
+    try:
+        q = float((params.get("q") or ["0.95"])[0])
+        n = int(params["n"][0]) if "n" in params else None
+        report = collect.federate_tail(members, q=q, n=n)
+    except ValueError as e:
+        handler._send(400, {"message": str(e)})
+        return
+    handler._send(200, report)
+
+
 def _serve_admin_fleet(handler) -> None:
     """``GET /admin/fleet``: the replica fleet's snapshot (states,
     versions, restart counts, swap progress). ``POST /admin/fleet``:
@@ -387,6 +513,18 @@ def _instrument(fn):
             if self.command == "GET" and path == "/admin/tail":
                 _serve_admin_tail(self, parsed.query)
                 return
+            if self.command == "GET" and path == "/admin/spans":
+                _serve_admin_spans(self, parsed.query)
+                return
+            if self.command == "GET" and path == "/admin/trace":
+                _serve_admin_trace(self, parsed.query)
+                return
+            if self.command == "GET" and path == "/admin/fleet/metrics":
+                _serve_fleet_metrics(self, parsed.query)
+                return
+            if self.command == "GET" and path == "/admin/fleet/tail":
+                _serve_fleet_tail(self, parsed.query)
+                return
             if path == "/admin/fleet":
                 _serve_admin_fleet(self)
                 return
@@ -417,9 +555,17 @@ def _instrument(fn):
         # injection attempts, oversized strings) is re-minted, never
         # echoed into response headers or span logs
         raw_id = self.headers.get(trace.TRACE_HEADER, "")
-        trace_id = raw_id if trace.valid_trace_id(raw_id) else (
-            trace.new_trace_id())
-        token = trace.activate(trace_id)
+        accepted = trace.valid_trace_id(raw_id)
+        trace_id = raw_id if accepted else trace.new_trace_id()
+        # cross-process parenting (obs/collect.py stitching): the
+        # caller's span id rides X-PIO-Parent-Span; this edge's span
+        # parents to it so the per-process rings assemble into ONE
+        # tree. Only honored beside an ACCEPTED trace id — a parent
+        # with no trace is noise, same shape discipline as the id.
+        raw_parent = self.headers.get(trace.PARENT_HEADER, "")
+        parent_span = raw_parent if (
+            accepted and trace.valid_span_id(raw_parent)) else None
+        token = trace.activate(trace_id, parent_span)
         route = metrics_route(path)
         fkey = flight.begin(trace_id, server, self.command, route)
         inflight = _IN_FLIGHT.labels(server)
@@ -429,8 +575,12 @@ def _instrument(fn):
         name = name.removeprefix("pio") or name
         error: Optional[str] = None
         try:
+            # server= stamps the owning process on the edge span: the
+            # trace collector attributes every descendant span to the
+            # nearest ancestor edge's server (a shared-ring threaded
+            # fleet cannot attribute by which member answered)
             with trace.span(f"http.{name}", method=self.command,
-                            route=route):
+                            route=route, server=name):
                 fn(self)
         except BaseException as e:
             # an exception ESCAPING a handler (their own except blocks

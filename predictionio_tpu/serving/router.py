@@ -458,17 +458,33 @@ class QueryRouter(HTTPServerBase):
     def _attempt(self, replica: Replica, body: bytes,
                  headers: Dict[str, str], deadline: float,
                  results: "queue.Queue",
-                 idempotent: bool = True) -> None:
+                 idempotent: bool = True,
+                 ctx: Optional[trace.SpanContext] = None,
+                 hedge: bool = False) -> None:
         """One forwarded request; its verdict lands in ``results`` as
-        (replica, (status, data, headers)) or (replica, exception)."""
+        (replica, (status, data, headers)) or (replica, exception).
+
+        Each attempt runs under its OWN ``router.attempt`` span (the
+        request's trace context is re-activated on this pool thread):
+        a hedged second attempt is a SIBLING span marked ``hedge``, and
+        the replica's edge span parents to the attempt via the headers
+        ``trace.traced_headers`` attaches — the federation collector
+        (obs/collect.py) stitches the whole placement decision into one
+        tree."""
         breaker = breaker_for(f"replica:{replica.name}")
         replica.begin_request()
+        token = trace.activate_context(ctx) if ctx is not None else None
         t0 = time.perf_counter()
         try:
-            answer = self._client(replica).request(
-                "POST", "/queries.json", body, headers,
-                timeout=max(0.05, deadline - time.monotonic()),
-                replay_safe=idempotent)
+            attrs = {"replica": replica.name}
+            if hedge:
+                attrs["hedge"] = True
+            with trace.span("router.attempt", **attrs):
+                answer = self._client(replica).request(
+                    "POST", "/queries.json", body,
+                    trace.traced_headers(headers),
+                    timeout=max(0.05, deadline - time.monotonic()),
+                    replay_safe=idempotent)
         except ConnectionError as e:
             breaker.record_failure()
             results.put((replica, e))
@@ -480,6 +496,8 @@ class QueryRouter(HTTPServerBase):
             return
         finally:
             replica.end_request()
+            if token is not None:
+                trace.deactivate(token)
         breaker.record_success()
         # only SERVED answers train the hedge clock: sub-millisecond
         # 429 sheds (or error fast-paths) under overload would collapse
@@ -507,17 +525,18 @@ class QueryRouter(HTTPServerBase):
         total = metrics.env_float("PIO_ROUTER_TIMEOUT", 30.0)
         deadline = time.monotonic() + total
         headers = {"Content-Type": "application/json"}
-        trace_id = trace.current_trace_id()
-        if trace_id:
-            headers[trace.TRACE_HEADER] = trace_id
+        # the trace context travels to the attempt's pool thread, where
+        # each attempt opens its own span and attaches the trace/parent
+        # headers (trace.TRACE_HEADER propagation lives there now)
+        ctx = trace.current_context()
         results: "queue.Queue" = queue.Queue()
         tried: set = set()
 
-        def launch(replica: Replica) -> None:
+        def launch(replica: Replica, hedge: bool = False) -> None:
             tried.add(replica.name)
             self._worker_pool.submit(
                 self._attempt, replica, body, headers, deadline, results,
-                idempotent)
+                idempotent, ctx, hedge)
 
         first = self._select(tried)
         if first is None:
@@ -551,7 +570,7 @@ class QueryRouter(HTTPServerBase):
                     if second is not None:
                         _HEDGES.inc()
                         hedge_name = second.name
-                        launch(second)
+                        launch(second, hedge=True)
                         outstanding += 1
                     continue
                 if time.monotonic() >= deadline:
@@ -654,25 +673,37 @@ class QueryRouter(HTTPServerBase):
         if canary_replica is None:
             return
         self._worker_pool.submit(self._canary_shadow, canary_replica,
-                                 body, base_data)
+                                 body, base_data, trace.current_context())
 
     def _canary_shadow(self, canary_replica: Replica, body: bytes,
-                       base_data: bytes) -> None:
+                       base_data: bytes,
+                       ctx: Optional[trace.SpanContext] = None) -> None:
         timeout = metrics.env_float("PIO_ROUTER_TIMEOUT", 30.0)
         canary_replica.begin_request()  # shadow load is real load:
         # p2c must see it, or paired sampling would overload the canary
         # invisibly
+        # the shadow rides the ORIGINAL request's trace as its own
+        # marked sibling span: a stitched trace shows exactly which
+        # query was shadow-sampled and what the canary did with it
+        token = trace.activate_context(ctx) if ctx is not None else None
         t0 = time.perf_counter()
         try:
-            status, data, _headers = self._client(canary_replica).request(
-                "POST", "/queries.json", body,
-                {"Content-Type": "application/json"}, timeout=timeout)
+            with trace.span("router.shadow", replica=canary_replica.name,
+                            shadow=True):
+                status, data, _headers = self._client(
+                    canary_replica).request(
+                    "POST", "/queries.json", body,
+                    trace.traced_headers(
+                        {"Content-Type": "application/json"}),
+                    timeout=timeout)
         except Exception as e:  # noqa: BLE001 — a failing canary IS the
             # evidence: counted as a paired error, never raised
             quality.STATE.add_paired(None, error=f"{type(e).__name__}: {e}")
             return
         finally:
             canary_replica.end_request()
+            if token is not None:
+                trace.deactivate(token)
         if not 200 <= status < 300:
             quality.STATE.add_paired(None,
                                      error=f"canary answered {status}")

@@ -719,6 +719,45 @@ def cmd_flight(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Cross-process stitched trace (obs/collect.py): fan out to the
+    fleet's span surfaces (``GET /admin/spans``) and render ONE
+    annotated tree — process, replica, parent-edge latency, hedge/
+    shadow siblings, and explicit placeholders where a member's ring
+    evicted a span. With --url the server assembles (it knows its
+    fleet: ``GET /admin/trace?id=``); without, this process assembles
+    from its own ring + ACTIVE fleets + PIO_OBS_MEMBERS. Exit 1 when
+    no spans were found for the id."""
+    from predictionio_tpu.obs import collect
+
+    if args.url:
+        import urllib.error
+        import urllib.request
+
+        url = (args.url.rstrip("/") + "/admin/trace?id="
+               + args.trace_id)
+        req = urllib.request.Request(url)
+        _add_admin_auth(req)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                doc = json.load(resp)
+        except urllib.error.HTTPError as e:
+            raise CommandError(
+                f"trace request failed ({e.code}): "
+                f"{e.read().decode(errors='replace')[:200]}")
+        except urllib.error.URLError as e:
+            raise CommandError(f"cannot reach {args.url}: {e.reason}")
+    else:
+        doc = collect.stitch_trace(args.trace_id,
+                                   collect.default_members())
+    if args.json:
+        json.dump(doc, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        _p(collect.format_trace_tree(doc))
+    return 0 if doc.get("span_count") else 1
+
+
 def cmd_profile(args) -> int:
     """Ask a live server for an on-demand JAX profiler capture
     (``POST /admin/profile?seconds=N``, obs/profiler.py) and print the
@@ -1200,36 +1239,129 @@ def _render_top_frame(payload: dict) -> str:
     return "\n".join(lines)
 
 
+def _fetch_fleet_report(url: str) -> dict:
+    """One federation report off the router's ``GET
+    /admin/fleet/metrics`` (obs/collect.py) — the ``pio top --fleet``
+    data source."""
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(url.rstrip("/") + "/admin/fleet/metrics")
+    _add_admin_auth(req)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.load(resp)
+    except urllib.error.HTTPError as e:
+        raise CommandError(
+            f"fleet metrics request failed ({e.code}): "
+            f"{e.read().decode(errors='replace')[:200]}")
+    except urllib.error.URLError as e:
+        raise CommandError(f"cannot reach {url}: {e.reason}")
+
+
+def _render_fleet_frame(report: dict, history: Optional[dict] = None) -> str:
+    """One `pio top --fleet` frame: fleet-wide percentiles off the
+    MERGED serving histogram, the fleet SLO burn, and a per-member
+    table. ``history`` (the live loop's client-side rings) adds
+    sparklines — the federated endpoint is the data source, the view
+    stays the familiar one."""
+    from predictionio_tpu.obs import collect
+    from predictionio_tpu.obs.timeline import sparkline
+
+    lines = []
+    samples = report.get("samples") or {}
+    slo = report.get("slo") or {}
+    p50 = collect.quantile_from_flat(
+        samples, "pio_serving_request_seconds", 0.5)
+    p99 = collect.quantile_from_flat(
+        samples, "pio_serving_request_seconds", 0.99)
+    requests = sum(v for k, v in samples.items()
+                   if k.startswith("pio_http_requests_total"))
+    if history is not None:
+        for name, value in (("fleet.srv_p50_ms",
+                             None if p50 is None else p50 * 1e3),
+                            ("fleet.srv_p99_ms",
+                             None if p99 is None else p99 * 1e3),
+                            ("fleet.http_requests", requests)):
+            if value is not None:
+                history.setdefault(name, []).append(value)
+                del history[name][:-120]
+    burn = slo.get("burn")
+    lines.append(
+        "fleet serving: p50 {} p99 {} — SLO burn {} "
+        "(<= {:g}ms objective {:.1%}, {} of {} good)".format(
+            "–" if p50 is None else f"{p50 * 1e3:.2f}ms",
+            "–" if p99 is None else f"{p99 * 1e3:.2f}ms",
+            "–" if burn is None else f"{burn:g}",
+            slo.get("threshold_ms", 0.0), slo.get("objective", 0.0),
+            int(slo.get("good") or 0), int(slo.get("total") or 0)))
+    if history:
+        width = max(len(n) for n in history)
+        for name in sorted(history):
+            values = history[name]
+            lines.append(f"{name:>{width}}  "
+                         f"{sparkline(values, 40):<40} "
+                         f"{values[-1]:>12.4g}")
+    lines.append("")
+    lines.append(f"{'member':>12} {'role':>10} {'status':>8} "
+                 f"{'http_reqs':>10} {'served':>8}")
+    for member in report.get("members") or []:
+        status = "ok" if member.get("ok") else "ERROR"
+        lines.append(
+            f"{member.get('name', '?'):>12} "
+            f"{member.get('role', ''):>10} {status:>8} "
+            f"{int(member.get('http_requests') or 0):>10} "
+            f"{int(member.get('serving_requests') or 0):>8}"
+            + (f"  ({member.get('error')})" if not member.get("ok")
+               else ""))
+    return "\n".join(lines)
+
+
 def cmd_top(args) -> int:
     """Live performance view (obs/timeline.py + obs/perfacct.py): the
     tracked gauge/quantile timelines as terminal sparklines, refreshed
     every ``--interval`` seconds; ``--once`` prints a single frame and
-    exits; ``--json`` (with --once) dumps the raw timeline payload."""
+    exits; ``--json`` (with --once) dumps the raw payload. With
+    ``--fleet`` the SAME live view is driven from the router's
+    federated ``GET /admin/fleet/metrics`` instead of a single
+    process: fleet-wide merged percentiles, SLO burn and a per-member
+    table."""
     if args.json and not args.once:
         raise CommandError("--json requires --once (one machine-readable "
                            "frame; stream consumers should poll "
                            "/admin/timeline)")
-    if args.once:
+    if args.fleet and not args.url:
+        raise CommandError("--fleet needs --url (the fleet's router)")
+
+    def fetch_and_render(history=None):
+        if args.fleet:
+            report = _fetch_fleet_report(args.url)
+            return report, _render_fleet_frame(report, history)
         payload = _fetch_timeline(args.url)
+        return payload, _render_top_frame(payload)
+
+    if args.once:
+        payload, frame = fetch_and_render()
         if args.json:
             json.dump(payload, sys.stdout, indent=1, sort_keys=True)
             sys.stdout.write("\n")
         else:
-            _p(_render_top_frame(payload))
+            _p(frame)
         return 0
+    history: dict = {}
     try:
         while True:
             # a transient fetch failure (server restarting, one poll
             # timing out) shows in the frame and the watch continues —
             # only --once hard-fails
             try:
-                payload = _fetch_timeline(args.url)
-                frame = _render_top_frame(payload)
+                _payload, frame = fetch_and_render(history)
             except CommandError as e:
                 frame = f"(fetch failed, retrying: {e})"
             # ANSI clear + home, like every terminal top
             sys.stdout.write("\x1b[2J\x1b[H")
-            _p(f"pio top — {args.url or 'in-process'} "
+            _p(f"pio top — {args.url or 'in-process'}"
+               f"{' [fleet]' if args.fleet else ''} "
                f"(interval {args.interval:g}s, ctrl-c to quit)\n")
             _p(frame)
             sys.stdout.flush()
@@ -1254,7 +1386,7 @@ def cmd_bench_compare(args) -> int:
 
 def cmd_lint(args) -> int:
     """graftlint: the JAX/TPU-aware static analysis over the tree
-    (rules JT01-JT12; tier-1 CI runs the same pass via tests/test_lint_clean.py)."""
+    (rules JT01-JT17; tier-1 CI runs the same pass via tests/test_lint_clean.py)."""
     from predictionio_tpu.tools.lint import run_cli
 
     try:
@@ -1528,6 +1660,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(func=cmd_flight)
 
     p = sub.add_parser(
+        "trace",
+        help="stitch one trace id across the fleet (GET /admin/trace "
+             "via --url, else assembled in-process from this process's "
+             "ring + ACTIVE fleets + PIO_OBS_MEMBERS) and render the "
+             "annotated cross-process tree",
+    )
+    p.add_argument("trace_id",
+                   help="the trace id (X-PIO-Trace-Id of any response)")
+    p.add_argument("--url", default=None,
+                   help="base URL of the assembling server — normally "
+                        "the fleet's router (sends the PIO_ADMIN_TOKEN "
+                        "bearer header when set)")
+    p.add_argument("--json", action="store_true",
+                   help="dump the raw stitched-trace document")
+    p.set_defaults(func=cmd_trace)
+
+    p = sub.add_parser(
         "profile",
         help="capture an on-demand JAX profiler window on a live server "
              "(POST /admin/profile); prints the artifact path, exits 1 "
@@ -1679,6 +1828,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="print one frame and exit")
     p.add_argument("--json", action="store_true",
                    help="with --once: dump the raw timeline payload")
+    p.add_argument("--fleet", action="store_true",
+                   help="drive the view from the router's federated "
+                        "GET /admin/fleet/metrics (requires --url): "
+                        "fleet-wide merged percentiles, SLO burn and "
+                        "a per-member table")
     p.set_defaults(func=cmd_top)
 
     p = sub.add_parser(
@@ -1700,7 +1854,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(func=cmd_bench_compare)
 
     p = sub.add_parser("lint", help="run graftlint (JAX/TPU-aware static "
-                                    "analysis, rules JT01-JT16) over the tree")
+                                    "analysis, rules JT01-JT17) over the tree")
     p.add_argument("paths", nargs="*", default=[],
                    help="files/dirs (default: the installed package)")
     p.add_argument("--format", choices=["human", "json"], default="human")

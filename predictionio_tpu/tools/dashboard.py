@@ -41,6 +41,12 @@ reference — operator views of this process's diagnostics:
                            headroom + basis, the per-model HBM
                            ledger, train peaks and the last OOM
                            preflight decision. JSON at /admin/memory.
+  GET /trace[?id=...]   -> HTML view of the cross-process trace
+                           stitcher (obs/collect.py): a lookup form +
+                           this process's recently seen traces, and —
+                           given an id — the stitched tree assembled
+                           from the federation members, rendered by
+                           the same ASCII renderer ``pio trace`` uses.
   GET /fleet            -> HTML panel of the serving fleet(s)
                            supervised IN THIS PROCESS
                            (serving/fleet.py ACTIVE registry —
@@ -109,6 +115,11 @@ class _DashboardRequestHandler(JSONRequestHandler):
             return
         if path == "/quality":
             self._send_cors(200, self.server_ref.quality_html(),
+                            "text/html; charset=UTF-8")
+            return
+        if path == "/trace":
+            trace_id = (parse_qs(url.query).get("id") or [None])[0]
+            self._send_cors(200, self.server_ref.trace_html(trace_id),
                             "text/html; charset=UTF-8")
             return
         if path == "/memory":
@@ -185,6 +196,7 @@ class DashboardServer(HTTPServerBase):
             '<a href="/timeline">timelines</a> · '
             '<a href="/quality">model quality</a> · '
             '<a href="/memory">device memory</a> · '
+            '<a href="/trace">trace stitcher</a> · '
             '<a href="/fleet">fleet</a> · '
             '<a href="/metrics">metrics</a> · '
             '<a href="/readyz">readiness</a></p>'
@@ -425,6 +437,56 @@ class DashboardServer(HTTPServerBase):
             "<h2>Canary</h2>"
             f"{canary_html}"
             '<p><a href="/admin/quality">JSON</a> · '
+            '<a href="/">index</a></p></body></html>'
+        )
+
+    def trace_html(self, trace_id: Optional[str] = None) -> str:
+        """The cross-process trace view (obs/collect.py): without an
+        id, a lookup form plus the traces recently seen by THIS
+        process's ring; with ``?id=``, the stitched tree fan-out over
+        the federation members (this process, ACTIVE fleets,
+        PIO_OBS_MEMBERS) rendered through the SAME ASCII renderer
+        ``pio trace`` uses — one renderer, no drift."""
+        from predictionio_tpu.obs import collect, trace as _trace
+
+        form = (
+            '<form method="get" action="/trace">'
+            '<input name="id" size="40" placeholder="trace id '
+            '(X-PIO-Trace-Id)" value="{}"/> '
+            "<button>stitch</button></form>"
+        ).format(html.escape(trace_id or ""))
+        if trace_id and _trace.valid_trace_id(trace_id):
+            doc = collect.stitch_trace(trace_id,
+                                       collect.default_members())
+            body = ("<pre>"
+                    + html.escape(collect.format_trace_tree(doc))
+                    + "</pre>")
+        elif trace_id:
+            body = "<p>that is not an id-shaped trace id.</p>"
+        else:
+            recent: dict = {}
+            for record in _trace.recent_spans():
+                entry = recent.setdefault(
+                    record["trace"], {"spans": 0, "names": set()})
+                entry["spans"] += 1
+                entry["names"].add(record["name"])
+            rows = "".join(
+                '<tr><td><a href="/trace?id={t}"><code>{t}</code></a>'
+                "</td><td>{n}</td><td><code>{names}</code></td></tr>"
+                .format(t=html.escape(t), n=entry["spans"],
+                        names=html.escape(", ".join(
+                            sorted(entry["names"])[:6])))
+                for t, entry in list(recent.items())[-20:][::-1]
+            ) or ("<tr><td colspan='3'>no spans in this process's "
+                  "ring yet</td></tr>")
+            body = ("<table border='1'><tr><th>Trace</th><th>Spans "
+                    "here</th><th>Span names</th></tr>" + rows
+                    + "</table>")
+        return (
+            "<!DOCTYPE html><html><head><title>Trace</title></head>"
+            "<body><h1>Cross-process trace</h1>"
+            f"{form}{body}"
+            '<p><a href="/admin/trace">JSON (?id=)</a> · '
             '<a href="/">index</a></p></body></html>'
         )
 

@@ -1,4 +1,4 @@
-"""graftlint rules JT01-JT16: the TPU hazards this codebase has hit.
+"""graftlint rules JT01-JT17: the TPU hazards this codebase has hit.
 
 Each rule encodes a failure class with a concrete precedent in this
 tree's history (the bf16-Gramian divergence behind JT03 is recorded in
@@ -1575,3 +1575,138 @@ class UnledgeredDeviceResidency(Rule):
                         "Footprint (obs/memacct) beside it or justify "
                         "a suppression",
                     )
+
+
+# -- JT17 ----------------------------------------------------------------------
+
+@register
+class UntracedIntraFleetCall(Rule):
+    id = "JT17"
+    name = "untraced-intra-fleet-call"
+    rationale = (
+        "An outbound HTTP request between fleet members that does not "
+        "attach the trace headers (trace.TRACE_HEADER + "
+        "X-PIO-Parent-Span, i.e. trace.traced_headers()) breaks the "
+        "cross-process trace exactly at the hop an operator is trying "
+        "to follow: the federation collector (obs/collect.py) stitches "
+        "per-process span rings by propagated ids, and one untraced "
+        "lane turns a stitched tree back into disconnected fragments. "
+        "Every intra-fleet urlopen/Request/HTTPConnection site must "
+        "attach the context (traced_headers is a no-op without an "
+        "active trace, so probes and daemons stay cheap) or carry a "
+        "justified suppression naming why the peer is not a fleet "
+        "member."
+    )
+
+    #: request-construction call tails audited (the places headers go)
+    _CONN_CTORS = {"HTTPConnection", "HTTPSConnection"}
+    #: helper calls that attach the context for the site
+    _MARKER_CALLS = {"traced_headers", "inject_headers"}
+    #: manual-attach evidence: the header constants referenced directly
+    _MARKER_NAMES = {"TRACE_HEADER", "PARENT_HEADER"}
+
+    def applies_to(self, abspath: str) -> bool:
+        # the layers that call other fleet members; tools/ (interactive
+        # one-shot CLI) and tests are out of scope by design
+        return any(frag in abspath for frag in (
+            "/serving/", "/workflow/", "/obs/", "/resilience/",
+            "/data/backends/"))
+
+    @staticmethod
+    def _enclosing_function(node: ast.AST, parents) -> Optional[ast.AST]:
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = parents.get(cur)
+        return None
+
+    def _has_marker(self, scope: ast.AST) -> bool:
+        for sub in ast.walk(scope):
+            if isinstance(sub, (ast.Name, ast.Attribute)):
+                if dotted(sub).rsplit(".", 1)[-1] in self._MARKER_NAMES:
+                    return True
+            if isinstance(sub, ast.Call) and (
+                    dotted(sub.func).rsplit(".", 1)[-1]
+                    in self._MARKER_CALLS):
+                return True
+        return False
+
+    @staticmethod
+    def _call_assigned_names(scope: ast.AST) -> Set[str]:
+        """Names assigned from a CALL result in ``scope`` — the
+        ``req = Request(...)`` / ``req = self._build(...)`` shapes
+        whose urlopen use defers to the construction site."""
+        out: Set[str] = set()
+        for sub in ast.walk(scope):
+            value = None
+            targets: List[ast.AST] = []
+            if isinstance(sub, ast.Assign):
+                value, targets = sub.value, sub.targets
+            elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                value, targets = sub.value, [sub.target]
+            if not isinstance(value, ast.Call):
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        return out
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        parents = _parent_map(ctx.tree)
+        marker_cache: Dict[ast.AST, bool] = {}
+        assigned_cache: Dict[ast.AST, Set[str]] = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = dotted(node.func).rsplit(".", 1)[-1]
+            if tail in self._CONN_CTORS or tail == "Request":
+                pass
+            elif tail == "urlopen":
+                # urlopen(req) on a PREBUILT request object defers to
+                # the construction site (where this rule already
+                # looks): a bare attribute read, or a name assigned
+                # from a call in the enclosing scope chain (closures
+                # read outer names — the retrying-inner-attempt shape).
+                # A URL STRING parked in a variable (`url = f"..."`)
+                # is NOT prebuilt — flagging it is the point.
+                arg0 = node.args[0] if node.args else None
+                if isinstance(arg0, ast.Attribute):
+                    continue
+                if isinstance(arg0, ast.Name):
+                    assigned = False
+                    cur: Optional[ast.AST] = node
+                    while cur is not None and not assigned:
+                        cur = self._enclosing_function(cur, parents)
+                        scope0 = cur if cur is not None else ctx.tree
+                        if scope0 not in assigned_cache:
+                            assigned_cache[scope0] = (
+                                self._call_assigned_names(scope0))
+                        assigned = arg0.id in assigned_cache[scope0]
+                        if cur is None:
+                            break
+                    if assigned:
+                        continue
+            else:
+                continue
+            scope = self._enclosing_function(node, parents) or ctx.tree
+            if scope not in marker_cache:
+                marker_cache[scope] = self._has_marker(scope)
+            if marker_cache[scope]:
+                continue
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                params = (scope.args.posonlyargs + scope.args.args
+                          + scope.args.kwonlyargs)
+                if any(a.arg == "headers" for a in params):
+                    # the caller hands the headers in: propagation is
+                    # the caller's duty (the router's pooled client)
+                    continue
+            yield Finding(
+                self.id, ctx.path, node.lineno, node.col_offset,
+                f"`{tail}` builds an intra-fleet request without the "
+                "trace headers — wrap the headers in "
+                "trace.traced_headers() (no-op without an active "
+                "trace) so obs/collect.py can stitch the hop, or "
+                "suppress with a justification naming why the peer is "
+                "not a fleet member",
+            )

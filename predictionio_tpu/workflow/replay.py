@@ -33,7 +33,7 @@ import urllib.error
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from predictionio_tpu.obs import metrics, quality
+from predictionio_tpu.obs import metrics, quality, trace
 
 log = logging.getLogger(__name__)
 
@@ -58,7 +58,8 @@ def http_target(base_url: str) -> Target:
         body = json.dumps(payload).encode()
         req = urllib.request.Request(
             url, data=body, method="POST",
-            headers={"Content-Type": "application/json"})
+            headers=trace.traced_headers(
+                {"Content-Type": "application/json"}))
         t0 = time.perf_counter()
         with urllib.request.urlopen(req, timeout=_replay_timeout()) as resp:
             answer = json.loads(resp.read() or b"null")
@@ -88,7 +89,7 @@ def fetch_payloads(flight_url: str, n: Optional[int] = None,
     import os
 
     url = flight_url.rstrip("/") + "/admin/flight"
-    req = urllib.request.Request(url)
+    req = urllib.request.Request(url, headers=trace.traced_headers())
     token = os.environ.get("PIO_ADMIN_TOKEN")
     if token:
         req.add_header("Authorization", f"Bearer {token}")
@@ -131,7 +132,18 @@ def replay(payloads: Sequence[Dict[str, Any]], candidate: Target,
     """Re-play every captured payload against both targets and diff the
     answers per query. Returns the machine-readable comparison report
     (and registers it in obs.quality.STATE unless ``register`` is
-    False, so ``GET /admin/quality`` of THIS process serves it)."""
+    False, so ``GET /admin/quality`` of THIS process serves it).
+
+    The whole run rides ONE minted trace: both lanes' HTTP targets
+    attach it (traced_headers), so a surprising diff can be followed
+    into both servers' span rings with ``pio trace``."""
+    with trace.new_trace():
+        return _replay_traced(payloads, candidate, baseline, k, register)
+
+
+def _replay_traced(payloads: Sequence[Dict[str, Any]], candidate: Target,
+                   baseline: Target, k: Optional[int],
+                   register: bool) -> Dict[str, Any]:
     overlaps: List[float] = []
     score_deltas: List[float] = []
     base_secs: List[float] = []
@@ -208,7 +220,8 @@ def push_report(report: Dict[str, Any], base_url: str,
     req = urllib.request.Request(
         base_url.rstrip("/") + "/admin/quality",
         data=json.dumps({"replay": report}).encode(), method="POST",
-        headers={"Content-Type": "application/json"})
+        headers=trace.traced_headers(
+            {"Content-Type": "application/json"}))
     token = os.environ.get("PIO_ADMIN_TOKEN")
     if token:
         req.add_header("Authorization", f"Bearer {token}")

@@ -100,7 +100,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.obs import metrics, perfacct
+from predictionio_tpu.obs import metrics, perfacct, trace
 
 log = logging.getLogger(__name__)
 
@@ -645,7 +645,16 @@ class StreamUpdater:
 
     # -- one cycle -----------------------------------------------------------
     def poll_once(self) -> Dict[str, Any]:
-        """One tail→fold→publish cycle; returns its stats dict."""
+        """One tail→fold→publish cycle; returns its stats dict.
+
+        Each cycle runs under its OWN trace: the fold's spans and the
+        patch/reload/drift fan-out to the fleet (traced_headers on
+        every lane) correlate under one id, so ``pio trace`` can follow
+        an append from the daemon into every replica it patched."""
+        with trace.new_trace():
+            return self._poll_once_traced()
+
+    def _poll_once_traced(self) -> Dict[str, Any]:
         t0 = time.perf_counter()
         # freshness horizon at read START, exactly like Engine.train: a
         # publish then credits only what this delta read could have seen
@@ -884,7 +893,7 @@ class StreamUpdater:
         import os as _os
 
         body = json.dumps({"drift": report}).encode()
-        headers = {"Content-Type": "application/json"}
+        headers = trace.traced_headers({"Content-Type": "application/json"})
         token = _os.environ.get("PIO_ADMIN_TOKEN")
         if token:
             headers["Authorization"] = f"Bearer {token}"
@@ -919,7 +928,7 @@ class StreamUpdater:
             return
         import os as _os
 
-        headers = {}
+        headers = trace.traced_headers()
         token = _os.environ.get("PIO_ADMIN_TOKEN")
         if token:
             headers["Authorization"] = f"Bearer {token}"
@@ -973,7 +982,7 @@ class StreamUpdater:
         import os as _os
 
         body = json.dumps(payload).encode()
-        headers = {"Content-Type": "application/json"}
+        headers = trace.traced_headers({"Content-Type": "application/json"})
         token = _os.environ.get("PIO_ADMIN_TOKEN")
         if token:
             headers["Authorization"] = f"Bearer {token}"

@@ -38,7 +38,7 @@ def rule_ids(findings):
 def test_all_rules_registered():
     assert {"JT01", "JT02", "JT03", "JT04", "JT05", "JT06",
             "JT07", "JT08", "JT09", "JT10", "JT11", "JT12",
-            "JT13", "JT14", "JT15", "JT16"} <= set(RULES)
+            "JT13", "JT14", "JT15", "JT16", "JT17"} <= set(RULES)
 
 
 def test_syntax_error_is_reported_not_raised(tmp_path):
@@ -1255,4 +1255,149 @@ def test_jt16_suppressible_with_justification(tmp_path):
             def load(self, table):
                 self._table = jax.device_put(table)  # graftlint: disable=JT16 — fixture: test-only toy table, bytes negligible
     """, relpath="models/m.py")
+    assert findings == []
+
+
+# -- JT17 untraced-intra-fleet-call --------------------------------------------
+
+def test_jt17_positive_request_without_trace_headers(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import urllib.request
+
+        def notify_peer(url, body):
+            req = urllib.request.Request(
+                url, data=body, method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return resp.status
+    """, relpath="serving/push_lane.py")
+    assert rule_ids(findings) == ["JT17"]
+
+
+def test_jt17_positive_direct_url_urlopen_and_connection_ctor(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import http.client
+        import urllib.request
+
+        def probe(host, port):
+            conn = http.client.HTTPConnection(host, port, timeout=2)
+            return conn
+
+        def reload_member(port):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/reload", timeout=5) as r:
+                return r.status
+    """, relpath="workflow/lanes.py")
+    assert rule_ids(findings) == ["JT17", "JT17"]
+
+
+def test_jt17_negative_traced_headers_helper(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import urllib.request
+
+        from predictionio_tpu.obs import trace
+
+        def notify_peer(url, body):
+            req = urllib.request.Request(
+                url, data=body, method="POST",
+                headers=trace.traced_headers(
+                    {"Content-Type": "application/json"}))
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return resp.status
+    """, relpath="serving/push_lane.py")
+    assert findings == []
+
+
+def test_jt17_negative_manual_header_attach(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import urllib.request
+
+        from predictionio_tpu.obs import trace
+
+        def notify_peer(url, body, trace_id):
+            req = urllib.request.Request(url, data=body, method="POST")
+            req.add_header(trace.TRACE_HEADER, trace_id)
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return resp.status
+    """, relpath="serving/push_lane.py")
+    assert findings == []
+
+
+def test_jt17_negative_caller_owned_headers_param(tmp_path):
+    # a pooled client whose caller hands the headers in: propagation
+    # is the caller's duty (the router's _ReplicaClient shape)
+    findings = lint_src(tmp_path, """\
+        import http.client
+
+        class PooledClient:
+            def request(self, method, path, body, headers, timeout):
+                conn = http.client.HTTPConnection("127.0.0.1", 1,
+                                                  timeout=timeout)
+                conn.request(method, path, body=body, headers=headers)
+                return conn.getresponse()
+    """, relpath="serving/pool.py")
+    assert findings == []
+
+
+def test_jt17_negative_out_of_scope_path_and_prebuilt_request(tmp_path):
+    src = """\
+        import urllib.request
+
+        def fetch(url):
+            req = urllib.request.Request(url)
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.read()
+    """
+    # interactive CLI tooling is out of the rule's layer scope
+    assert lint_src(tmp_path, src, relpath="tools/cli_like.py") == []
+    # in scope the Request ctor is the one finding; urlopen(req) on the
+    # prebuilt object is not double-flagged
+    findings = lint_src(tmp_path, src, relpath="serving/lane.py")
+    assert rule_ids(findings) == ["JT17"]
+
+
+def test_jt17_suppressible_with_justification(tmp_path):
+    findings = lint_src(tmp_path, """\
+        import urllib.request
+
+        def push_external(url, body):
+            req = urllib.request.Request(url, data=body, method="POST")  # graftlint: disable=JT17 — fixture: external sink, not a fleet member
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return resp.status
+    """, relpath="obs/sink.py")
+    assert findings == []
+
+
+def test_jt17_positive_url_string_in_a_variable(tmp_path):
+    # parking the URL in a variable must not defeat the audit: there is
+    # no Request construction site anywhere to carry the headers
+    findings = lint_src(tmp_path, """\
+        import urllib.request
+
+        def reload_member(replica):
+            url = f"{replica.base_url}/reload"
+            with urllib.request.urlopen(url, timeout=5) as r:
+                return r.status
+    """, relpath="serving/lane.py")
+    assert rule_ids(findings) == ["JT17"]
+
+
+def test_jt17_negative_closure_over_prebuilt_request(tmp_path):
+    # the retrying-inner-attempt shape: the Request is built (with the
+    # headers) in the outer scope, the nested attempt urlopens it
+    findings = lint_src(tmp_path, """\
+        import urllib.request
+
+        from predictionio_tpu.obs import trace
+
+        def push(url, body):
+            req = urllib.request.Request(
+                url, data=body, headers=trace.traced_headers())
+
+            def attempt():
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    return r.status
+
+            return attempt()
+    """, relpath="serving/lane.py")
     assert findings == []
