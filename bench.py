@@ -1827,6 +1827,34 @@ def stage_warm(base_dir, out_path):
         json.dump(detail, f)
 
 
+def stage_lint(base_dir, out_path):
+    """Project-mode graftlint over the installed package: every per-file
+    rule plus the whole-program concurrency pass (JT18-JT20), timed end
+    to end — parse, cross-module model build, rule evaluation. The wall
+    clock is the gated number (key.lint_project_ms, lower-better in
+    bench-compare): the same pass runs in tier-1 and bin/lint, so a
+    super-linear regression in the analysis taxes every commit. The
+    stage also FAILS on any unsuppressed finding — the bench must not
+    bless a tree the lint gate rejects."""
+    import predictionio_tpu
+    from predictionio_tpu.tools.lint import lint_project
+
+    pkg_dir = os.path.dirname(os.path.abspath(predictionio_tpu.__file__))
+    t0 = time.perf_counter()
+    findings, files = lint_project([pkg_dir])
+    elapsed_ms = (time.perf_counter() - t0) * 1e3
+    if findings:
+        raise RuntimeError(
+            f"graftlint --project: {len(findings)} unsuppressed "
+            f"finding(s) — the bench refuses a tree the lint gate rejects")
+    detail = {
+        "lint_project_ms": round(elapsed_ms, 1),
+        "lint_project_files": files,
+    }
+    with open(out_path, "w") as f:
+        json.dump(detail, f)
+
+
 #: hard ceiling for the final stdout line. The driver records only a
 #: ~2 KB tail of bench stdout; round 4's single fat line outgrew it and
 #: the whole round's headline landed as ``"parsed": null`` in
@@ -1916,6 +1944,11 @@ def emit_headline(detail, detail_path=None):
         # (benchcmp: _bytes suffix = lower-better — growth is the regression)
         "model_hbm_bytes": detail.get("model_hbm_bytes"),
         "train_peak_bytes": detail.get("train_peak_bytes"),
+        # correctness tooling (tools/lint): project-mode graftlint wall
+        # clock over the package (benchcmp: _ms suffix = lower-better —
+        # the pass runs in tier-1 + bin/lint, so analysis cost taxes
+        # every commit)
+        "lint_project_ms": detail.get("lint_project_ms"),
     }
     if "twotower" in detail:
         tt = detail["twotower"]
@@ -1962,12 +1995,14 @@ def orchestrate():
     env["PIO_BIN_CACHE_DIR"] = os.path.join(base_dir, "bin_cache")
     try:
         stages = {}
-        # stream stays LAST (it appends events — see stage_stream);
-        # retrieval only READS the cold stage's trained instance;
-        # quality appends a small fold batch, so it runs after warm
-        # (whose unchanged-data fast path the appends would evict)
-        for stage in ("cold", "warm", "twotower", "retrieval", "quality",
-                      "stream"):
+        # lint FIRST (pure AST, no store/JAX — fails fast on a dirty
+        # tree before the expensive stages spend chip time); stream
+        # stays LAST (it appends events — see stage_stream); retrieval
+        # only READS the cold stage's trained instance; quality appends
+        # a small fold batch, so it runs after warm (whose
+        # unchanged-data fast path the appends would evict)
+        for stage in ("lint", "cold", "warm", "twotower", "retrieval",
+                      "quality", "stream"):
             out = os.path.join(base_dir, f"{stage}.json")
             # child stdout -> our stderr: the stdout contract is ONE line
             proc = subprocess.run(
@@ -1989,6 +2024,7 @@ def orchestrate():
         # ["retrieval_qps_recall95"] / ["index_build_sec"] /
         # ["foldin_events_per_sec"] / ["quality_recall_vs_retrain"] /
         # ["canary_verdict_ms"]
+        detail.update(stages["lint"])
         detail.update(stages["retrieval"])
         detail.update(stages["quality"])
         detail.update(stages["stream"])
@@ -2000,13 +2036,15 @@ def orchestrate():
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--stage",
-                        choices=["cold", "warm", "twotower", "retrieval",
-                                 "quality", "stream", "parse_profile",
-                                 "loadgen"])
+                        choices=["lint", "cold", "warm", "twotower",
+                                 "retrieval", "quality", "stream",
+                                 "parse_profile", "loadgen"])
     parser.add_argument("--base")
     parser.add_argument("--out")
     args = parser.parse_args()
-    if args.stage == "cold":
+    if args.stage == "lint":
+        stage_lint(args.base, args.out)
+    elif args.stage == "cold":
         stage_cold(args.base, args.out)
     elif args.stage == "warm":
         stage_warm(args.base, args.out)

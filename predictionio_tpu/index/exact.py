@@ -173,7 +173,7 @@ class ExactIndex(AnnIndex):
         if padded is None:
             padded = tkd.pad_items(jnp.asarray(self._vectors),
                                    self.block_items)
-            self._device_padded = padded
+            self._device_padded = padded  # graftlint: disable=JT18 — lock-free lazy init by design: the store is atomic, racing fills compute identical tables and the last write wins; readers above took one local ref
             # a NEW long-lived device allocation: re-price the ledger
             # footprint with the padded copy included (JT16 contract)
             self._register_mem(self._mem_nbytes())
@@ -187,7 +187,7 @@ class ExactIndex(AnnIndex):
             scorer = TopKScorer(self._vectors,
                                 max_exclude=self.max_exclude,
                                 placement=self._placement)
-            self._scorer = scorer
+            self._scorer = scorer  # graftlint: disable=JT18 — lock-free lazy init by design: racing fills build equivalent scorers over the same read-only vectors; last write wins, readers hold their local ref
         return scorer
 
     # -- search ---------------------------------------------------------------

@@ -239,8 +239,8 @@ class ALSModel:
                 factors[ids[uid]] = vec
             # factors FIRST: a reader holding the new id map but the old
             # (shorter) table would index past its end on a fresh user
-            self.user_factors = factors
-            self.user_ids = ids
+            self.user_factors = factors  # graftlint: disable=JT18 — copy-on-write commit: store is atomic, readers take one local ref (old-or-new, never torn)
+            self.user_ids = ids  # graftlint: disable=JT18 — paired with the factors swap; ordering documented above
         if item_rows:
             ids, factors = self.item_ids, self.item_factors
             fresh = [iid for iid, _ in item_rows if iid not in ids]
@@ -259,8 +259,8 @@ class ALSModel:
                         f"item row {iid!r}: expected a length-{rank} "
                         f"vector, got shape {vec.shape}")
                 factors[ids[iid]] = vec
-            self.item_factors = factors
-            self.item_ids = ids
+            self.item_factors = factors  # graftlint: disable=JT18 — copy-on-write commit: store is atomic, readers take one local ref (old-or-new, never torn)
+            self.item_ids = ids  # graftlint: disable=JT18 — paired with the factors swap; same ordering rule
             # the scorer holds a DEVICE copy of the old item table
             self._scorer = None
             # the retrieval index takes the SAME rows as an in-place

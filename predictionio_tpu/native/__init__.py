@@ -77,8 +77,11 @@ def load_library(name: str, extra_flags: Optional[list] = None) -> ctypes.CDLL:
     path = build_library(name, extra_flags)
     lib = ctypes.CDLL(path)
     with _lock:
-        _cache[name] = lib
-    return lib
+        # re-validate under the lock: a concurrent first caller may have
+        # cached its own handle while this thread was building — converge
+        # on ONE canonical CDLL so per-handle state (restype/argtypes set
+        # once by callers) is never split across two live handles
+        return _cache.setdefault(name, lib)
 
 
 def native_available(name: str) -> bool:

@@ -532,7 +532,15 @@ class Watchdog:
             watchdog=self, deadline=now + deadline, armed_at=now,
             trace_id=trace.current_trace_id(), deadman=True))
         with self._lock:
-            self._deadman_key = key
+            # re-validate: a concurrent start_deadman may have armed
+            # between the check above and our arm — keeping both keys
+            # would leak a monitor entry that fires (and beats would
+            # re-arm only one of them), so the loser disarms itself
+            if self._deadman_key is None:
+                self._deadman_key = key
+                key = None
+        if key is not None:
+            _MONITOR.disarm(key)
 
     # -- firing -------------------------------------------------------------
     def _fire(self, watch: _Watch) -> None:

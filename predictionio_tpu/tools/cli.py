@@ -1386,11 +1386,14 @@ def cmd_bench_compare(args) -> int:
 
 def cmd_lint(args) -> int:
     """graftlint: the JAX/TPU-aware static analysis over the tree
-    (rules JT01-JT17; tier-1 CI runs the same pass via tests/test_lint_clean.py)."""
+    (rules JT01-JT17 per file; --project adds the whole-program
+    concurrency layer JT18-JT20; tier-1 CI runs the same passes via
+    tests/test_lint_clean.py)."""
     from predictionio_tpu.tools.lint import run_cli
 
     try:
-        return run_cli(args.paths, fmt=args.format, show_rules=args.list_rules)
+        return run_cli(args.paths, fmt=args.format,
+                       show_rules=args.list_rules, project=args.project)
     except FileNotFoundError as e:
         # exit 2, not 1: a bad path must stay distinguishable from
         # "lint ran and found something" for CI wrappers
@@ -1854,10 +1857,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(func=cmd_bench_compare)
 
     p = sub.add_parser("lint", help="run graftlint (JAX/TPU-aware static "
-                                    "analysis, rules JT01-JT17) over the tree")
+                                    "analysis, rules JT01-JT20) over the tree")
     p.add_argument("paths", nargs="*", default=[],
                    help="files/dirs (default: the installed package)")
+    p.add_argument("--project", action="store_true",
+                   help="add the whole-program concurrency pass "
+                        "(JT18-JT20: lock discipline, races, deadlocks)")
     p.add_argument("--format", choices=["human", "json"], default="human")
+    p.add_argument("--json", action="store_const", const="json",
+                   dest="format", help="shorthand for --format json")
     p.add_argument("--list-rules", action="store_true")
     p.set_defaults(func=cmd_lint)
 

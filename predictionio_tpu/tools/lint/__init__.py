@@ -4,10 +4,14 @@ Ordinary linters can't see this framework's hazard class: traced-value
 host syncs (JT01), Python branches on tracers (JT02), low-precision
 accumulation (JT03, the bf16-Gramian bug class), swallowed exceptions on
 serving hot paths (JT04), undeclared mesh axes (JT05) and per-request
-blocking transfers in HTTP handlers (JT06).
+blocking transfers in HTTP handlers (JT06). With ``--project`` the
+whole-program layer (project.py + concurrency.py) adds lock-discipline
+inference and race/deadlock detection across the fleet substrate:
+unguarded shared mutation (JT18), lock-order cycles (JT19) and
+check-then-act splits (JT20).
 
-    python -m predictionio_tpu.tools.lint [paths] [--format json]
-    pio lint [paths]
+    python -m predictionio_tpu.tools.lint [paths] [--project] [--json]
+    pio lint [--project] [paths]
     bin/lint
 
 Suppress a reviewed finding with a justified comment:
@@ -23,20 +27,27 @@ from predictionio_tpu.tools.lint.engine import (
     RULES,
     lint_file,
     lint_paths,
+    lint_project,
     main,
     register,
     run_cli,
 )
-from predictionio_tpu.tools.lint import rules  # noqa: F401 — registers JT01-JT06
+from predictionio_tpu.tools.lint import rules  # noqa: F401 — registers JT01-JT17
+from predictionio_tpu.tools.lint.project import PROJECT_RULES, register_project
+from predictionio_tpu.tools.lint import concurrency  # noqa: F401 — registers JT18-JT20
 
 __all__ = [
     "Finding",
     "Rule",
     "RULES",
+    "PROJECT_RULES",
     "lint_file",
     "lint_paths",
+    "lint_project",
     "main",
     "register",
+    "register_project",
     "run_cli",
     "rules",
+    "concurrency",
 ]

@@ -131,9 +131,15 @@ def _iter_comments(source: str, lines: Sequence[str]):
             yield i, line
 
 
-def parse_suppressions(source: str, lines: Sequence[str]) -> Suppressions:
+def parse_suppressions(source: str, lines: Sequence[str],
+                       tree: Optional[ast.AST] = None) -> Suppressions:
     """Directives are honored only in real comments — a suppression
-    example quoted in a docstring or string literal is inert."""
+    example quoted in a docstring or string literal is inert.
+
+    With ``tree``, a directive anywhere inside a multi-line statement
+    (e.g. on the closing line of a wrapped ``with`` header or call) is
+    extended over the whole statement span, so findings reported at the
+    statement's first line are still suppressed."""
     sup = Suppressions(file_rules=set(), line_rules={}, unjustified=[])
     for i, text in _iter_comments(source, lines):
         m = SUPPRESS_RE.search(text)
@@ -149,7 +155,42 @@ def parse_suppressions(source: str, lines: Sequence[str]) -> Suppressions:
             sup.file_rules.update(rules)
         else:
             sup.line_rules.setdefault(i, set()).update(rules)
+    if tree is not None and sup.line_rules:
+        _expand_multiline_spans(sup, tree)
     return sup
+
+
+def _stmt_spans(tree: ast.AST) -> List[Tuple[int, int]]:
+    """(start, end) line span per multi-line statement. For compound
+    statements (with/if/for/def...) the span is the HEADER only — a
+    comment inside the block body must not suppress at the header."""
+    spans: List[Tuple[int, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.stmt):
+            continue
+        end = getattr(node, "end_lineno", None)
+        body = getattr(node, "body", None)
+        if isinstance(body, list) and body and isinstance(body[0], ast.stmt):
+            end = body[0].lineno - 1
+        if end is not None and end > node.lineno:
+            spans.append((node.lineno, end))
+    return spans
+
+
+def _expand_multiline_spans(sup: Suppressions, tree: ast.AST) -> None:
+    """A line directive inside a wrapped statement covers the whole
+    statement: the finding is reported at the statement's first line,
+    the human writes the comment where the statement ends."""
+    spans = _stmt_spans(tree)
+    for line, rules in list(sup.line_rules.items()):
+        inner: Optional[Tuple[int, int]] = None
+        for start, end in spans:
+            if start <= line <= end and (inner is None or start > inner[0]):
+                inner = (start, end)
+        if inner is not None:
+            for ln in range(inner[0], inner[1] + 1):
+                if ln != line:
+                    sup.line_rules.setdefault(ln, set()).update(rules)
 
 
 # -- driver --------------------------------------------------------------------
@@ -172,26 +213,30 @@ def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
 
 
 def lint_file(path: str, rules: Optional[Iterable[Rule]] = None) -> List[Finding]:
-    with open(path, "r", encoding="utf-8") as f:
-        source = f.read()
-    abspath = os.path.abspath(path).replace(os.sep, "/")
-    lines = source.splitlines()
-    sup = parse_suppressions(source, lines)
+    # the project layer owns the one-parse AST cache; per-file and
+    # project passes share it so a file is parsed exactly once per run
+    from predictionio_tpu.tools.lint import project as _project
+
+    return _lint_module(_project.get_module(path), rules=rules)
+
+
+def _lint_module(mod, rules: Optional[Iterable[Rule]] = None) -> List[Finding]:
+    """Per-file pass over an already-parsed (cached) module."""
+    sup = mod.suppressions
+    if mod.tree is None:
+        e = mod.error
+        return [Finding("GL01", mod.path, e.lineno or 1, e.offset or 0,
+                        f"syntax error: {e.msg}")]
     findings: List[Finding] = [
-        Finding("GL00", path, line, 0,
+        Finding("GL00", mod.path, line, 0,
                 f"suppression without justification: {text!r} — say why "
                 "the hazard does not apply here")
         for line, text in sup.unjustified
     ]
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [Finding("GL01", path, e.lineno or 1, e.offset or 0,
-                        f"syntax error: {e.msg}")]
-    ctx = FileContext(path=path, abspath=abspath, tree=tree,
-                      source=source, lines=lines)
+    ctx = FileContext(path=mod.path, abspath=mod.abspath, tree=mod.tree,
+                      source=mod.source, lines=mod.lines)
     for rule in (rules if rules is not None else RULES.values()):
-        if rule.applies_to(abspath):
+        if rule.applies_to(mod.abspath):
             findings.extend(rule.check(ctx))
     # dedupe: overlapping walks (e.g. a jit fn nested in a jit fn) may
     # report one site twice; Finding is frozen/hashable
@@ -206,6 +251,35 @@ def lint_paths(paths: Sequence[str],
     for path in iter_python_files(paths):
         findings.extend(lint_file(path, rules=rules))
     return findings
+
+
+def lint_project(paths: Sequence[str]) -> Tuple[List[Finding], int]:
+    """Whole-program mode: the per-file rules over every module PLUS the
+    project rules (JT18-JT20) over the cross-module model. The given
+    paths define the project universe; modules are parsed once (shared
+    AST cache) and project findings honor each file's suppression
+    comments exactly like per-file findings. Returns (findings, files)."""
+    from predictionio_tpu.tools.lint import project as _project
+    from predictionio_tpu.tools.lint import concurrency as _concurrency  # noqa: F401
+
+    files = list(iter_python_files(paths))
+    modules = [_project.get_module(p) for p in files]
+    findings: List[Finding] = []
+    for mod in modules:
+        findings.extend(_lint_module(mod))
+    model = _project.build([m for m in modules if m.tree is not None])
+    sup_by_path = {m.path: m.suppressions for m in modules}
+    project_findings: List[Finding] = []
+    for rule in _project.PROJECT_RULES.values():
+        project_findings.extend(rule.check(model))
+    for f in project_findings:
+        sup = sup_by_path.get(f.path)
+        if sup is not None and sup.hides(f):
+            continue
+        findings.append(f)
+    kept = list(dict.fromkeys(findings))
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return kept, len(files)
 
 
 # -- output --------------------------------------------------------------------
@@ -228,10 +302,16 @@ def format_json(findings: Sequence[Finding], n_files: int) -> str:
 
 
 def list_rules() -> str:
+    from predictionio_tpu.tools.lint import project as _project
+    from predictionio_tpu.tools.lint import concurrency as _concurrency  # noqa: F401
+
     out = []
     for rule in RULES.values():
         out.append(f"{rule.id}  {rule.name}")
         out.append(f"      {rule.rationale}")
+    for prule in _project.PROJECT_RULES.values():
+        out.append(f"{prule.id}  {prule.name}  [--project]")
+        out.append(f"      {prule.rationale}")
     return "\n".join(out)
 
 
@@ -243,7 +323,8 @@ def default_paths() -> List[str]:
 
 
 def run_cli(paths: Sequence[str], fmt: str = "human",
-            show_rules: bool = False, out=None) -> int:
+            show_rules: bool = False, out=None,
+            project: bool = False) -> int:
     out = out if out is not None else sys.stdout
     # rule modules self-register on import; imported here (not at module
     # top) so `engine` stays import-cycle-free for the rules themselves
@@ -254,12 +335,16 @@ def run_cli(paths: Sequence[str], fmt: str = "human",
         return 0
     if not paths:
         paths = default_paths()
-    files = list(iter_python_files(paths))
-    findings: List[Finding] = []
-    for path in files:
-        findings.extend(lint_file(path))
+    if project:
+        findings, n_files = lint_project(paths)
+    else:
+        files = list(iter_python_files(paths))
+        n_files = len(files)
+        findings = []
+        for path in files:
+            findings.extend(lint_file(path))
     formatter = format_json if fmt == "json" else format_human
-    print(formatter(findings, len(files)), file=out)
+    print(formatter(findings, n_files), file=out)
     return 1 if findings else 0
 
 
@@ -267,12 +352,23 @@ def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="python -m predictionio_tpu.tools.lint",
         description="graftlint — JAX/TPU-aware static analysis "
-                    "(rules JT01-JT16; see --list-rules)",
+                    "(per-file rules JT01-JT17, whole-program rules "
+                    "JT18-JT20 with --project; see --list-rules)",
     )
     parser.add_argument("paths", nargs="*", default=[],
                         help="files or directories to lint (default: the "
                              "installed predictionio_tpu package)")
+    parser.add_argument("--project", action="store_true",
+                        help="whole-program mode: per-file rules plus the "
+                             "cross-module concurrency rules JT18-JT20 "
+                             "(lock-discipline inference, race/deadlock "
+                             "detection) over the given paths as one "
+                             "project")
     parser.add_argument("--format", choices=["human", "json"], default="human")
+    parser.add_argument("--json", action="store_const", const="json",
+                        dest="format",
+                        help="shorthand for --format json (stable "
+                             "rule/file/line keys for CI tooling)")
     parser.add_argument("--list-rules", action="store_true",
                         help="describe every registered rule and exit")
     return parser
@@ -281,7 +377,8 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     try:
-        return run_cli(args.paths, fmt=args.format, show_rules=args.list_rules)
+        return run_cli(args.paths, fmt=args.format,
+                       show_rules=args.list_rules, project=args.project)
     except FileNotFoundError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2

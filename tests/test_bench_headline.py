@@ -233,3 +233,20 @@ def test_transfer_timeout_surfaces_dead_side_error(monkeypatch):
                                         iterations=1, n_read=1)
     finally:
         release.set()
+
+
+def test_lint_stage_key_lands_and_gates_lower_better(tmp_path):
+    """The project-mode graftlint wall clock is a first-class gated
+    number: stage_lint's measurement lands in key.lint_project_ms and
+    bench-compare directions it lower-better (the _ms convention) — a
+    super-linear regression in the whole-program analysis fails the
+    compare gate instead of silently taxing every commit's tier-1."""
+    from predictionio_tpu.tools import benchcmp
+
+    detail = _representative_detail()
+    detail["lint_project_ms"] = 5252.6
+    line = bench.emit_headline(detail, detail_path=str(tmp_path / "d.json"))
+    assert line["key"]["lint_project_ms"] == 5252.6
+    assert len(json.dumps(line).encode()) <= bench.MAX_HEADLINE_BYTES
+    assert benchcmp.lower_is_better("key.lint_project_ms")
+    assert not benchcmp.is_config_key("key.lint_project_ms")

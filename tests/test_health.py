@@ -299,6 +299,41 @@ def test_deadman_beat_resets_deadline():
     assert stall_count("t-beat") == before
 
 
+def test_start_deadman_concurrent_arms_exactly_once(monkeypatch):
+    """Regression (graftlint JT20): two threads racing through
+    start_deadman() must converge on ONE armed monitor entry — the old
+    check-then-arm split let both arm, leaking a watch that fired
+    forever because beats re-armed only the recorded key."""
+    wd = health.Watchdog("t-arm-race", min_seconds=0.05, min_history=1,
+                         factor=2.0)
+    barrier = threading.Barrier(2)
+    real_arm = health._MONITOR.arm
+
+    def synced_arm(watch):
+        # both threads are past the armed-already check before either
+        # arms: the widest possible race window, deterministically
+        barrier.wait(timeout=5)
+        return real_arm(watch)
+
+    monkeypatch.setattr(health._MONITOR, "arm", synced_arm)
+    threads = [threading.Thread(target=wd.start_deadman) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5)
+    try:
+        with health._MONITOR._cond:
+            mine = [k for k, w in health._MONITOR._watches.items()
+                    if w.watchdog is wd]
+        assert len(mine) == 1, f"expected one armed watch, got {mine}"
+        assert wd._deadman_key == mine[0]
+    finally:
+        with wd._lock:
+            key, wd._deadman_key = wd._deadman_key, None
+        if key is not None:
+            health._MONITOR.disarm(key)
+
+
 def test_microbatcher_dispatch_stall_fires_watchdog(monkeypatch):
     tight = health.Watchdog("serving-dispatch-test", min_seconds=0.01,
                             min_history=1, factor=2.0)

@@ -1,20 +1,27 @@
 """Tier-1 gate: the committed tree carries zero unsuppressed graftlint
 findings.
 
-This is the CI wiring for graftlint (mirrors `bin/lint`): any JT01-JT06
+This is the CI wiring for graftlint (mirrors `bin/lint`): any JT01-JT20
 finding — or an unjustified suppression (GL00) — fails the tier-1 run
 with the exact file:line so the offending change is one click away.
 Uses the in-process API (no subprocess) to stay cheap; graftlint never
-imports jax, so this collects and runs in milliseconds.
+imports jax. The project pass (JT18-JT20) shares the per-file pass's
+AST cache, so the two gates together parse each module once.
 """
 
 from __future__ import annotations
 
+import time
 from pathlib import Path
 
-from predictionio_tpu.tools.lint import lint_paths
+from predictionio_tpu.tools.lint import lint_paths, lint_project
 
 PACKAGE = Path(__file__).resolve().parents[1] / "predictionio_tpu"
+
+#: generous multiple of the observed ~7 s dev-container wall clock —
+#: the ISSUE-16 budget: a super-linear regression in the cross-module
+#: analysis must fail loudly, not silently tax every commit
+PROJECT_PASS_BUDGET_SEC = 10.0
 
 
 def test_tree_has_no_unsuppressed_findings():
@@ -23,4 +30,26 @@ def test_tree_has_no_unsuppressed_findings():
         f"{len(findings)} graftlint finding(s) — fix them or suppress "
         "with a justified `# graftlint: disable=RULE — why` comment:\n"
         + "\n".join(str(f) for f in findings)
+    )
+
+
+def test_tree_is_clean_under_project_mode():
+    """The whole-program concurrency pass (JT18-JT20: unguarded shared
+    mutation, lock-order cycles, check-then-act splits) over the whole
+    package: any future unguarded mutation of a lock-disciplined
+    attribute fails tier-1 here, with the race's file:line."""
+    t0 = time.perf_counter()
+    findings, files = lint_project([str(PACKAGE)])
+    elapsed = time.perf_counter() - t0
+    assert not findings, (
+        f"{len(findings)} graftlint --project finding(s) — fix the "
+        "race/deadlock or justify the lock-free design with a "
+        "`# graftlint: disable=RULE — why` comment:\n"
+        + "\n".join(str(f) for f in findings)
+    )
+    assert files > 0
+    assert elapsed < PROJECT_PASS_BUDGET_SEC, (
+        f"project lint took {elapsed:.1f}s over {files} files — the "
+        f"< {PROJECT_PASS_BUDGET_SEC:.0f}s budget protects every "
+        "commit's tier-1 wall clock; profile the cross-module pass"
     )

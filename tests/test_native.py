@@ -1,0 +1,70 @@
+"""Native-library loader: process-wide cache semantics
+(predictionio_tpu/native/__init__.py)."""
+
+import threading
+
+from predictionio_tpu import native
+
+
+def test_load_library_concurrent_first_callers_share_one_handle(monkeypatch):
+    """Regression (graftlint JT20): two threads racing through
+    load_library()'s first miss must converge on ONE canonical handle.
+    The old second lock region blindly overwrote the cache, so the
+    early caller kept a handle the cache no longer knew — per-handle
+    state (restype/argtypes set once) split across two live CDLLs."""
+    barrier = threading.Barrier(2)
+    made = []
+
+    class FakeCDLL:
+        def __init__(self, path):
+            self.path = path
+            made.append(self)
+
+    def fake_build(name, extra_flags=None):
+        # both threads are past the cache check before either dlopens:
+        # the widest possible race window, deterministically
+        barrier.wait(timeout=5)
+        return f"/tmp/fake-{name}.so"
+
+    monkeypatch.setattr(native, "build_library", fake_build)
+    monkeypatch.setattr(native.ctypes, "CDLL", FakeCDLL)
+    name = "t_cache_race"
+    native._cache.pop(name, None)
+    out = []
+    threads = [
+        threading.Thread(target=lambda: out.append(native.load_library(name)))
+        for _ in range(2)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5)
+        assert len(out) == 2
+        assert len(made) == 2  # both threads really did dlopen
+        assert out[0] is out[1], "callers got different handles"
+        assert native._cache[name] is out[0]
+    finally:
+        native._cache.pop(name, None)
+
+
+def test_load_library_hits_cache_without_rebuild(monkeypatch):
+    calls = []
+
+    class FakeCDLL:
+        def __init__(self, path):
+            self.path = path
+
+    monkeypatch.setattr(
+        native, "build_library",
+        lambda name, extra_flags=None: calls.append(name) or "/tmp/x.so")
+    monkeypatch.setattr(native.ctypes, "CDLL", FakeCDLL)
+    name = "t_cache_hit"
+    native._cache.pop(name, None)
+    try:
+        first = native.load_library(name)
+        second = native.load_library(name)
+        assert first is second
+        assert calls == [name]  # second call never re-built
+    finally:
+        native._cache.pop(name, None)
