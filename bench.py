@@ -66,6 +66,13 @@ cache, so both halves of the compile story are measured:
     clearing recall >= 0.95 and ``key.index_build_sec`` its build
     cost (detail.retrieval carries the full sweep).
 
+  prof stage (host-only, runs early): the continuous profiler's cost
+    and the first serve-path interpreter breakdown — an in-process
+    event server under threaded HTTP load with the always-on sampler
+    retained; ``key.prof_overhead_pct`` is the gated number
+    (lower-better) and detail.prof_serve_breakdown the
+    parse/json/socket/dispatch shares.
+
   stream stage: see stage_stream (runs LAST — it appends events).
 
 Roofline: analytic FLOP/byte counts from the trainer's actual padded
@@ -1829,7 +1836,7 @@ def stage_warm(base_dir, out_path):
 
 def stage_lint(base_dir, out_path):
     """Project-mode graftlint over the installed package: every per-file
-    rule plus the whole-program concurrency pass (JT18-JT20), timed end
+    rule plus the whole-program concurrency pass (JT18-JT21), timed end
     to end — parse, cross-module model build, rule evaluation. The wall
     clock is the gated number (key.lint_project_ms, lower-better in
     bench-compare): the same pass runs in tier-1 and bin/lint, so a
@@ -1850,6 +1857,86 @@ def stage_lint(base_dir, out_path):
     detail = {
         "lint_project_ms": round(elapsed_ms, 1),
         "lint_project_files": files,
+    }
+    with open(out_path, "w") as f:
+        json.dump(detail, f)
+
+
+def stage_prof(base_dir, out_path):
+    """Continuous-profiler cost + the first measured serve-path
+    interpreter breakdown: an in-process EventServer (memory storage —
+    no chip, no JAX) under a few seconds of threaded HTTP load, with
+    the always-on sampler retained by ``start()``. Exports
+    ``key.prof_overhead_pct`` (lower-better in bench-compare: the
+    sampler rides EVERY serving process, so its cost taxes every
+    request) and the parse/json/socket/dispatch shares of
+    handler-thread samples — the host-side answer to "where does a
+    request's interpreter time actually go"."""
+    import threading
+    import urllib.request
+
+    from predictionio_tpu.data.metadata import AccessKey
+    from predictionio_tpu.data.storage import Storage
+    from predictionio_tpu.obs import contprof
+    from predictionio_tpu.serving.event_server import EventServer
+
+    storage = Storage.from_env({
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        **{f"PIO_STORAGE_REPOSITORIES_{r}_{k}": v
+           for r in ("METADATA", "EVENTDATA", "MODELDATA")
+           for k, v in (("NAME", r.lower()), ("SOURCE", "MEM"))},
+    })
+    app = storage.apps().insert("bench-prof")
+    storage.events().init(app.id)
+    access = AccessKey.generate(app.id)
+    storage.access_keys().insert(access)
+
+    contprof.PROFILER.reset()
+    server = EventServer(storage=storage, host="127.0.0.1", port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        post_url = f"{base}/events.json?accessKey={access.key}"
+        body = json.dumps({"event": "view", "entityType": "user",
+                           "entityId": "u1"}).encode()
+        errs = []
+        duration = float(os.environ.get("PIO_BENCH_PROF_SEC", "3.0"))
+        deadline = time.perf_counter() + duration
+
+        def worker():
+            try:
+                while time.perf_counter() < deadline:  # graftlint: disable=JT09 — except below hands the error to errs[]; the stage fails loudly on it
+                    req = urllib.request.Request(
+                        post_url, data=body,
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        r.read()
+                    with urllib.request.urlopen(f"{base}/healthz",
+                                                timeout=10) as r:
+                        r.read()
+            except Exception as e:  # pragma: no cover - fails the stage
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(duration + 30.0)
+        if errs:
+            raise RuntimeError(f"prof stage load failed: {errs[0]!r}")
+        snap = contprof.snapshot()
+    finally:
+        server.stop()
+    total = snap["total_samples"]
+    if not total:
+        raise RuntimeError("prof stage: sampler collected zero samples "
+                           "under load — the always-on profiler is dead")
+    detail = {
+        "prof_overhead_pct": round(
+            contprof.PROFILER.overhead_ratio() * 100.0, 3),
+        "prof_effective_hz": round(snap["effective_hz"], 2),
+        "prof_samples": total,
+        "prof_serve_breakdown": contprof.serve_path_breakdown(snap),
     }
     with open(out_path, "w") as f:
         json.dump(detail, f)
@@ -1949,6 +2036,10 @@ def emit_headline(detail, detail_path=None):
         # the pass runs in tier-1 + bin/lint, so analysis cost taxes
         # every commit)
         "lint_project_ms": detail.get("lint_project_ms"),
+        # continuous profiling plane (obs/contprof.py): sampler cost
+        # under serve load (benchcmp: "overhead" = lower-better — the
+        # sampler rides every serving process)
+        "prof_overhead_pct": detail.get("prof_overhead_pct"),
     }
     if "twotower" in detail:
         tt = detail["twotower"]
@@ -2001,8 +2092,11 @@ def orchestrate():
         # only READS the cold stage's trained instance; quality appends
         # a small fold batch, so it runs after warm (whose
         # unchanged-data fast path the appends would evict)
-        for stage in ("lint", "cold", "warm", "twotower", "retrieval",
-                      "quality", "stream"):
+        # prof rides second: pure host HTTP load (no chip), and its
+        # overhead number should reflect a quiet machine, before the
+        # heavy stages contend for cores
+        for stage in ("lint", "prof", "cold", "warm", "twotower",
+                      "retrieval", "quality", "stream"):
             out = os.path.join(base_dir, f"{stage}.json")
             # child stdout -> our stderr: the stdout contract is ONE line
             proc = subprocess.run(
@@ -2025,6 +2119,7 @@ def orchestrate():
         # ["foldin_events_per_sec"] / ["quality_recall_vs_retrain"] /
         # ["canary_verdict_ms"]
         detail.update(stages["lint"])
+        detail.update(stages["prof"])
         detail.update(stages["retrieval"])
         detail.update(stages["quality"])
         detail.update(stages["stream"])
@@ -2036,7 +2131,7 @@ def orchestrate():
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--stage",
-                        choices=["lint", "cold", "warm", "twotower",
+                        choices=["lint", "prof", "cold", "warm", "twotower",
                                  "retrieval", "quality", "stream",
                                  "parse_profile", "loadgen"])
     parser.add_argument("--base")
@@ -2044,6 +2139,8 @@ def main() -> None:
     args = parser.parse_args()
     if args.stage == "lint":
         stage_lint(args.base, args.out)
+    elif args.stage == "prof":
+        stage_prof(args.base, args.out)
     elif args.stage == "cold":
         stage_cold(args.base, args.out)
     elif args.stage == "warm":

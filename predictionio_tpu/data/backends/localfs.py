@@ -53,7 +53,7 @@ log = logging.getLogger(__name__)
 
 def _atomic_write(path: str, data: str) -> None:
     tmp = path + ".tmp"
-    with open(tmp, "w") as f:
+    with open(tmp, "w") as f:  # graftlint: disable=JT21 — the store lock exists to serialize this very file: concurrent writers would race the tmp+replace pair; localfs is the single-process dev backend, not a serving hot path
         f.write(data)
     os.replace(tmp, path)
 
@@ -81,7 +81,7 @@ class LocalFSEventStore(M.MemoryEventStore):
         if not os.path.exists(path):
             return
         tbl: Dict[str, Event] = {}
-        with open(path) as f:
+        with open(path) as f:  # graftlint: disable=JT21 — replay must be atomic with the table publish it guards: a writer appending mid-replay would be lost; one cold read per table lifetime
             lines = f.readlines()
         for lineno, line in enumerate(lines):
             line = line.strip()
@@ -106,7 +106,7 @@ class LocalFSEventStore(M.MemoryEventStore):
         self._loaded.add(key)
 
     def _append(self, app_id, channel_id, record: dict) -> None:
-        with open(self._path(app_id, channel_id), "a") as f:
+        with open(self._path(app_id, channel_id), "a") as f:  # graftlint: disable=JT21 — the event-store lock exists to serialize this log: the JSONL append must land in the same order as the in-memory table update it rides with
             f.write(json.dumps(record, sort_keys=True) + "\n")
 
     # -- overrides ----------------------------------------------------------
@@ -117,7 +117,7 @@ class LocalFSEventStore(M.MemoryEventStore):
             self._loaded.add(M._table_key(app_id, channel_id))
             path = self._path(app_id, channel_id)
             if not os.path.exists(path):
-                open(path, "a").close()
+                open(path, "a").close()  # graftlint: disable=JT21 — exists-check and create must be one transaction under the store lock; a one-time touch on the init path
 
     def remove(self, app_id, channel_id=None):
         with self._lock:

@@ -852,3 +852,77 @@ def federate_tail(members: List[Member], q: float = 0.95,
     report["members"] = member_reports
     report["member_tail"] = member_tail
     return report
+
+
+# -- fleet profile federation --------------------------------------------------
+
+def _fetch_prof(member: Member, endpoint: Optional[str], slow: bool,
+                timeout: float) -> Tuple[Optional[Dict[str, Any]],
+                                         Optional[str]]:
+    from predictionio_tpu.obs import contprof
+
+    if member.url is None:
+        return contprof.snapshot(endpoint=endpoint, slow=slow), None
+    url = f"{member.url}/admin/prof"
+    params = []
+    if slow:
+        params.append("slow=1")
+    if endpoint:
+        from urllib.parse import quote
+
+        params.append(f"endpoint={quote(endpoint, safe='')}")
+    if params:
+        url += "?" + "&".join(params)
+    body, error = _fetch(url, timeout)
+    if error is not None:
+        return None, error
+    try:
+        return json.loads(body or b"{}"), None
+    except ValueError as e:
+        return None, f"unparseable profile payload: {e}"
+
+
+def federate_prof(members: List[Member], endpoint: Optional[str] = None,
+                  slow: bool = False) -> Dict[str, Any]:
+    """Member-merged continuous profile (``GET /admin/fleet/prof``):
+    every member's folded stacks summed into one fleet flame
+    (obs/contprof.merge_folded), per-member sample counts / overhead /
+    effective rate annotated, dead members degrading the merge exactly
+    like the metric federation. The slow slice unions the members'
+    slow-cohort trace ids so the fleet flame still joins against each
+    flight recorder's slow ring."""
+    from predictionio_tpu.obs import contprof
+
+    timeout = collect_timeout()
+    member_reports: List[Dict[str, Any]] = []
+    payloads: List[Dict[str, Any]] = []
+    slow_traces: List[str] = []
+    for member, payload, error in _fan_out(
+            members,
+            lambda m: _fetch_prof(m, endpoint, slow, timeout)):
+        report = {"name": member.name, "url": member.url,
+                  "role": member.role, "ok": error is None}
+        if error is not None:
+            report["error"] = error
+        else:
+            samples = payload.get("samples") or {}
+            report["samples"] = (samples.get("cpu", 0)
+                                 + samples.get("wait", 0))
+            report["effective_hz"] = payload.get("effective_hz")
+            report["overhead_ratio"] = payload.get("overhead_ratio")
+            payloads.append(payload)
+            for tid in payload.get("slow_trace_ids") or []:
+                if tid not in slow_traces:
+                    slow_traces.append(tid)
+        member_reports.append(report)
+    merged = contprof.merge_folded(payloads)
+    out: Dict[str, Any] = {
+        "slice": ("slow" if slow
+                  else f"endpoint:{endpoint}" if endpoint else "all"),
+        "members": member_reports,
+        "merged_from": [r["name"] for r in member_reports if r["ok"]],
+        "merged": merged,
+    }
+    if slow:
+        out["slow_trace_ids"] = slow_traces
+    return out

@@ -444,7 +444,7 @@ def configure_from_env() -> Optional[Dict[str, Any]]:
     with _file_lock:
         if path == _file_config_path:
             return _file_config
-        with open(path) as f:
+        with open(path) as f:  # graftlint: disable=JT21 — once-per-path cold config load: the lock makes read+configure+cache one transaction so racing starters cannot half-apply; never on a request path
             config = _json.load(f)
         if not isinstance(config, dict):
             raise ValueError(f"PIO_SLO_FILE {path}: expected a JSON object")

@@ -163,11 +163,26 @@ def memacct_collector() -> Collector:
     return collect
 
 
+def contprof_collector() -> Collector:
+    """Sample the continuous profiler's self-cost by ASKING it
+    (obs/contprof.py): ``prof.overhead`` is the sampler's busy/interval
+    EMA — the series an operator watches to confirm the auto-downshift
+    is honoring PIO_PROF_MAX_OVERHEAD."""
+
+    def collect(now: float) -> Dict[str, float]:
+        from predictionio_tpu.obs import contprof
+
+        return {"prof.overhead": contprof.PROFILER.overhead_ratio()}
+
+    return collect
+
+
 def default_collectors() -> List[Collector]:
     return [
         gauge_collector("pio_train_mfu", "mfu"),
         staleness_collector(),
         memacct_collector(),
+        contprof_collector(),
         quantile_collector("pio_serving_request_seconds", 0.50,
                            "serve_p50_ms", scale=1e3),
         quantile_collector("pio_serving_request_seconds", 0.99,

@@ -261,7 +261,10 @@ class MicroBatcher:
         # orders submit()'s stop-check+enqueue against stop()'s flag+wake,
         # so nothing can be enqueued after the worker's shutdown drain
         self._stop_lock = threading.Lock()
-        self._worker = threading.Thread(target=self._loop, daemon=True)
+        # named so the continuous profiler (obs/contprof.py) labels the
+        # batch loop's samples with the "batcher" role
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="pio-batcher")
         self._worker.start()
 
     def submit(self, payload, timeout: float = 30.0):

@@ -777,7 +777,7 @@ class FleetSupervisor:
                 url, headers=trace.traced_headers())
             reload_timeout = metrics.env_float(
                 "PIO_FLEET_RELOAD_TIMEOUT", 300.0)
-            with urllib.request.urlopen(req, timeout=reload_timeout) as resp:
+            with urllib.request.urlopen(req, timeout=reload_timeout) as resp:  # graftlint: disable=JT21 — _swap_lock exists to serialize rolling swaps fleet-wide: one replica drains/reloads at a time BY DESIGN; a concurrent swap is the outage this wait prevents
                 return resp.status, json.loads(resp.read() or b"{}")
         except urllib.error.HTTPError as e:
             return e.code, e.read().decode(errors="replace")[:200]

@@ -48,6 +48,11 @@ Every server also inherits the shared operator surface from the
   GET  /admin/fleet/tail fleet-wide tail attribution }
                          over every member's flight  }
                          recorder (404 w/o a fleet)  }
+  GET  /admin/prof       continuous host profiler    }
+                         flame (?format=collapsed,   }
+                         ?endpoint=, ?slow=1 slices) }
+  GET  /admin/fleet/prof member-merged continuous    }
+                         profile (404 w/o a fleet)   }
 
 ``/healthz``, ``/readyz`` and ``/metrics`` stay unauthenticated — a
 liveness prober or scraper holds no operator secrets; the ``/admin/*``
@@ -69,8 +74,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 from urllib.parse import parse_qs, urlparse
 
-from predictionio_tpu.obs import (flight, health, metrics, perfacct,
-                                  profiler, push, slo, timeline, trace)
+from predictionio_tpu.obs import (contprof, flight, health, metrics,
+                                  perfacct, profiler, push, slo, timeline,
+                                  trace)
 from predictionio_tpu.resilience import alerts, chaos
 from predictionio_tpu.resilience import policy as respolicy
 
@@ -257,8 +263,17 @@ def _serve_admin_profile(handler, query: str) -> None:
     try:
         artifact = profiler.capture(seconds)
     except profiler.ProfilerUnavailable as e:
-        handler._send(501, {"message": str(e),
-                            "backend": profiler.backend()})
+        # actionable, not a bare status line: on CPU backends the
+        # continuous HOST profiler is the one that has the answer
+        handler._send(501, {
+            "message": str(e),
+            "backend": profiler.backend(),
+            "hint": "no device timeline on this backend; the "
+                    "continuous host profiler is always on — use "
+                    "GET /admin/prof (?format=collapsed, ?endpoint=, "
+                    "?slow=1) or `pio prof`",
+            "host_profiler": "/admin/prof",
+        })
         return
     except profiler.ProfilerBusy as e:
         handler._send(409, {"message": str(e)})
@@ -426,6 +441,57 @@ def _serve_fleet_tail(handler, query: str) -> None:
     handler._send(200, report)
 
 
+def _parse_prof_slices(query: str):
+    """Shared ?slow=1 / ?endpoint= / ?format= parsing for the local and
+    fleet profile routes."""
+    params = parse_qs(query)
+    slow = (params.get("slow") or ["0"])[0].lower() in ("1", "true")
+    endpoint = (params.get("endpoint") or [None])[0]
+    fmt = (params.get("format") or [""])[0]
+    return slow, endpoint, fmt
+
+
+def _serve_admin_prof(handler, query: str) -> None:
+    """``GET /admin/prof``: the continuous host profiler's aggregated
+    flame (obs/contprof.py) — the answer ``POST /admin/profile`` cannot
+    give on CPU backends. ``?format=collapsed`` emits folded
+    ``stack count`` lines for external flamegraph tools; ``?endpoint=``
+    slices one route's trie; ``?slow=1`` the above-``PIO_SLOW_MS`` tail
+    cohort, whose payload also names the slow requests' trace ids (they
+    join against the flight recorder's slow ring)."""
+    slow, endpoint, fmt = _parse_prof_slices(query)
+    payload = contprof.snapshot(endpoint=endpoint, slow=slow)
+    if fmt == "collapsed":
+        handler._send(200, contprof.collapsed_text(payload),
+                      content_type="text/plain; charset=UTF-8")
+        return
+    handler._send(200, payload)
+
+
+def _serve_fleet_prof(handler, query: str) -> None:
+    """``GET /admin/fleet/prof``: the members' continuous profiles
+    member-merged through the federation plane (obs/collect.py) —
+    folded stacks summed, per-member sample counts and errors
+    annotated; a dead member degrades the merge, never fails it. Same
+    ``?slow=1`` / ``?endpoint=`` / ``?format=collapsed`` slices as the
+    single-process route."""
+    from predictionio_tpu.obs import collect
+
+    members = _fleet_federation_members(handler)
+    if members is None:
+        handler._send(404, {"message": "no fleet supervised by this "
+                                       "server and no PIO_OBS_MEMBERS "
+                                       "configured"})
+        return
+    slow, endpoint, fmt = _parse_prof_slices(query)
+    report = collect.federate_prof(members, endpoint=endpoint, slow=slow)
+    if fmt == "collapsed":
+        handler._send(200, contprof.collapsed_text(report["merged"]),
+                      content_type="text/plain; charset=UTF-8")
+        return
+    handler._send(200, report)
+
+
 def _serve_admin_fleet(handler) -> None:
     """``GET /admin/fleet``: the replica fleet's snapshot (states,
     versions, restart counts, swap progress). ``POST /admin/fleet``:
@@ -525,6 +591,12 @@ def _instrument(fn):
             if self.command == "GET" and path == "/admin/fleet/tail":
                 _serve_fleet_tail(self, parsed.query)
                 return
+            if self.command == "GET" and path == "/admin/prof":
+                _serve_admin_prof(self, parsed.query)
+                return
+            if self.command == "GET" and path == "/admin/fleet/prof":
+                _serve_fleet_prof(self, parsed.query)
+                return
             if path == "/admin/fleet":
                 _serve_admin_fleet(self)
                 return
@@ -568,6 +640,10 @@ def _instrument(fn):
         token = trace.activate(trace_id, parent_span)
         route = metrics_route(path)
         fkey = flight.begin(trace_id, server, self.command, route)
+        # register this handler thread with the continuous profiler:
+        # samples taken during the request carry its trace id + route
+        # (per-endpoint and slow-cohort flame slices)
+        contprof.request_begin(trace_id, route)
         inflight = _IN_FLIGHT.labels(server)
         inflight.inc()
         t0 = time.perf_counter()
@@ -591,6 +667,12 @@ def _instrument(fn):
         finally:
             inflight.dec()
             status = getattr(self, "_metrics_status", None)
+            # the dominant host frame the sampler observed during this
+            # request's window stamps the record BEFORE it seals, so a
+            # slow record names code, not just stages
+            dominant = contprof.request_end()
+            if dominant is not None:
+                flight.note_field("dominant_frame", dominant)
             # seal the flight record while the trace is still active so
             # the slow-request log line carries the trace id
             flight.finish(fkey, status, error)
@@ -762,6 +844,11 @@ class HTTPServerBase:
                 time.sleep(1)
         self._thread: Optional[threading.Thread] = None
         self._serving = False
+        # this instance's hold on the process-global continuous
+        # profiler: retained on start, released exactly once on stop
+        # (drain_stop -> stop must not double-release the refcount)
+        self._prof_owner = f"{type(self).__name__}:{id(self):#x}"
+        self._prof_retained = False
 
     @property
     def port(self) -> int:
@@ -778,12 +865,27 @@ class HTTPServerBase:
         slo.configure_from_env()
         chaos.configure_from_env()
 
+    def _retain_profiler(self) -> None:
+        """Hold the continuous profiler while this server serves —
+        refcounted and idempotent in contprof, so multi-server
+        processes share ONE sampler and a /reload (stop + start of the
+        same instance) never leaves a second one behind."""
+        if not self._prof_retained:
+            self._prof_retained = True
+            contprof.retain(self._prof_owner)
+
+    def _release_profiler(self) -> None:
+        if self._prof_retained:
+            self._prof_retained = False
+            contprof.release(self._prof_owner)
+
     def start(self):
         # flag set BEFORE the thread is scheduled so a stop() racing
         # start() still runs shutdown() (which blocks until the serve
         # loop has run and exited) instead of closing the socket under it
         self._serving = True
         self._start_env_services()
+        self._retain_profiler()
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         self._thread.start()
         log.info("%s listening on %s", type(self).__name__, self.port)
@@ -792,6 +894,7 @@ class HTTPServerBase:
     def serve_forever(self) -> None:
         self._serving = True
         self._start_env_services()
+        self._retain_profiler()
         self.httpd.serve_forever()
 
     def stop(self) -> None:
@@ -804,6 +907,7 @@ class HTTPServerBase:
             self.httpd.shutdown()
             self._serving = False
         self.httpd.server_close()
+        self._release_profiler()
 
     def inflight_count(self) -> float:
         """Requests currently inside handlers of THIS server class

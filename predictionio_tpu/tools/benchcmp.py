@@ -40,9 +40,12 @@ _HIGHER_BETTER = re.compile(r"(per_sec|_qps|qps$|throughput|mfu|"
 #: ``model_hbm_bytes`` / ``train_peak_bytes`` the anchored ``_bytes$``
 #: tail (resident bytes growing IS the regression the memacct keys
 #: gate; the anchor stays — a bare ``bytes`` fragment would flip
-#: direction on any future metric merely containing the word)
+#: direction on any future metric merely containing the word). The
+#: ``overhead`` fragment gates the continuous profiler's cost
+#: (``prof_overhead_pct``): the sampler rides every serving process,
+#: so its growth taxes every request
 _LOWER_BETTER = re.compile(r"(_ms$|_ms_|_sec$|_sec_|_seconds|latency|"
-                           r"_bytes$|p50|p99|debt|rmse|drift)")
+                           r"_bytes$|p50|p99|debt|rmse|drift|overhead)")
 
 #: detail keys that are run configuration, not performance — a change
 #: is reported as CONFIG-CHANGED (never a regression verdict: comparing

@@ -783,6 +783,12 @@ def cmd_profile(args) -> int:
             message = body
         if e.code == 501:
             _p(f"profiler unavailable on the server: {message}")
+            try:
+                hint = json.loads(body).get("hint")
+            except json.JSONDecodeError:
+                hint = None
+            if hint:
+                _p(f"hint: {hint}")
             return 1
         raise CommandError(f"profile request failed ({e.code}): {message}")
     except urllib.error.URLError as e:
@@ -794,6 +800,70 @@ def cmd_profile(args) -> int:
     _p(f"artifact: {payload['artifact']}")
     _p("open with TensorBoard/xprof, or parse device time via "
        f"`python -m predictionio_tpu.obs.profiler {payload['artifact']}`")
+    return 0
+
+
+def cmd_prof(args) -> int:
+    """Continuous host profiler (obs/contprof.py): fetch a server's
+    aggregated wall-clock flame (``GET /admin/prof``; --fleet asks the
+    router for the member-merged ``GET /admin/fleet/prof``) and render
+    the flame tree + top-N hot frames through the SAME renderer the
+    dashboard ``/prof`` view uses. --collapsed emits folded ``stack
+    count`` lines for external flamegraph tooling."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    from predictionio_tpu.obs import contprof
+
+    path = "/admin/fleet/prof" if args.fleet else "/admin/prof"
+    query = {}
+    if args.slow:
+        query["slow"] = "1"
+    if args.endpoint:
+        query["endpoint"] = args.endpoint
+    url = args.url.rstrip("/") + path
+    if query:
+        url += "?" + urllib.parse.urlencode(query)
+    req = urllib.request.Request(url)
+    _add_admin_auth(req)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            payload = json.load(resp)
+    except urllib.error.HTTPError as e:
+        body = e.read().decode(errors="replace")
+        try:
+            message = json.loads(body).get("message", body)
+        except json.JSONDecodeError:
+            message = body[:200]
+        raise CommandError(f"profile fetch failed ({e.code}): {message}")
+    except urllib.error.URLError as e:
+        raise CommandError(f"cannot reach {args.url}: {e.reason}")
+    if args.json:
+        json.dump(payload, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+        return 0
+    flame = payload.get("merged", payload) if args.fleet else payload
+    if args.collapsed:
+        sys.stdout.write(contprof.collapsed_text(flame))
+        return 0
+    if args.fleet:
+        for member in payload.get("members") or []:
+            state = ("ok" if member.get("ok")
+                     else f"ERROR: {member.get('error')}")
+            detail = ""
+            if member.get("ok"):
+                detail = " ({} sample(s), {:.3g} Hz, overhead {})".format(
+                    member.get("samples", 0),
+                    member.get("effective_hz") or 0.0,
+                    member.get("overhead_ratio"))
+            _p(f"member {member.get('name', '?'):<12} {state}{detail}")
+        _p("")
+    sys.stdout.write(contprof.format_flame(flame, top=args.top))
+    if args.slow and payload.get("slow_trace_ids"):
+        _p("slow-cohort trace ids (join with `pio flight --slow`):")
+        for tid in payload["slow_trace_ids"][-20:]:
+            _p(f"  {tid}")
     return 0
 
 
@@ -1690,6 +1760,32 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seconds", type=float, default=3.0,
                    help="capture window length (default 3)")
     p.set_defaults(func=cmd_profile)
+
+    p = sub.add_parser(
+        "prof",
+        help="continuous host profiler (GET /admin/prof): the always-on "
+             "wall-clock flame of a live server — flame tree + hot "
+             "frames; --fleet for the member-merged view",
+    )
+    p.add_argument("--url", default="http://127.0.0.1:8000",
+                   help="base URL of any PIO server (sends the "
+                        "PIO_ADMIN_TOKEN bearer header when set)")
+    p.add_argument("--fleet", action="store_true",
+                   help="member-merged profile through the federation "
+                        "plane (GET /admin/fleet/prof on the router)")
+    p.add_argument("--collapsed", action="store_true",
+                   help="emit folded 'stack count' lines for external "
+                        "flamegraph tooling")
+    p.add_argument("--slow", action="store_true",
+                   help="only the above-PIO_SLOW_MS tail cohort's "
+                        "samples (also lists their trace ids)")
+    p.add_argument("--endpoint", default=None,
+                   help="one route's slice, e.g. /queries.json")
+    p.add_argument("--top", type=int, default=10,
+                   help="hot frames listed under the flame (default 10)")
+    p.add_argument("--json", action="store_true",
+                   help="dump the raw profile payload")
+    p.set_defaults(func=cmd_prof)
 
     p = sub.add_parser(
         "slo",

@@ -47,6 +47,11 @@ reference — operator views of this process's diagnostics:
                            given an id — the stitched tree assembled
                            from the federation members, rendered by
                            the same ASCII renderer ``pio trace`` uses.
+  GET /prof             -> HTML view of the continuous host profiler
+                           (obs/contprof.py): the process flame tree
+                           + hot frames via the same renderer
+                           ``pio prof`` uses; ?slow=1 and ?endpoint=
+                           slices. JSON at /admin/prof.
   GET /fleet            -> HTML panel of the serving fleet(s)
                            supervised IN THIS PROCESS
                            (serving/fleet.py ACTIVE registry —
@@ -126,6 +131,15 @@ class _DashboardRequestHandler(JSONRequestHandler):
             self._send_cors(200, self.server_ref.memory_html(),
                             "text/html; charset=UTF-8")
             return
+        if path == "/prof":
+            params = parse_qs(url.query)
+            slow = (params.get("slow") or ["0"])[0].lower() in ("1",
+                                                                "true")
+            endpoint = (params.get("endpoint") or [None])[0]
+            self._send_cors(200,
+                            self.server_ref.prof_html(endpoint, slow),
+                            "text/html; charset=UTF-8")
+            return
         parts = [p for p in path.split("/") if p]
         # path form: /engine_instances/<id>/evaluator_results.<fmt>
         if len(parts) == 3 and parts[0] == "engine_instances":
@@ -197,6 +211,8 @@ class DashboardServer(HTTPServerBase):
             '<a href="/quality">model quality</a> · '
             '<a href="/memory">device memory</a> · '
             '<a href="/trace">trace stitcher</a> · '
+            '<a href="/prof">profiler flame</a> · '
+            '<a href="/prof?slow=1">slow-cohort flame</a> · '
             '<a href="/fleet">fleet</a> · '
             '<a href="/metrics">metrics</a> · '
             '<a href="/readyz">readiness</a></p>'
@@ -565,6 +581,37 @@ class DashboardServer(HTTPServerBase):
             f"(estimate scale x{pre.get('estimate_scale')}); "
             f"{last_line}</p>"
             '<p><a href="/admin/memory">JSON</a> · '
+            '<a href="/">index</a></p></body></html>'
+        )
+
+    def prof_html(self, endpoint: Optional[str] = None,
+                  slow: bool = False) -> str:
+        """The continuous profiler's flame (obs/contprof.py) rendered
+        through the SAME ASCII renderer ``pio prof`` uses — one
+        renderer, every surface. ``?slow=1`` shows the above-PIO_SLOW_MS
+        tail cohort, ``?endpoint=`` one route's slice."""
+        from urllib.parse import quote
+
+        from predictionio_tpu.obs import contprof
+
+        payload = contprof.snapshot(endpoint=endpoint, slow=slow)
+        flame = contprof.format_flame(payload)
+        slices = [
+            '<a href="/prof">all</a>',
+            '<a href="/prof?slow=1">slow cohort</a>',
+        ]
+        for ep in payload.get("endpoints") or []:
+            slices.append(
+                '<a href="/prof?endpoint={}"><code>{}</code></a>'.format(
+                    quote(ep, safe=""), html.escape(ep)))
+        return (
+            "<!DOCTYPE html><html><head><title>Continuous profile"
+            "</title></head><body><h1>Continuous profile"
+            f" [{html.escape(str(payload.get('slice')))}]</h1>"
+            f"<p>slices: {' · '.join(slices)}</p>"
+            f"<pre>{html.escape(flame)}</pre>"
+            '<p><a href="/admin/prof">JSON</a> · '
+            '<a href="/admin/prof?format=collapsed">collapsed</a> · '
             '<a href="/">index</a></p></body></html>'
         )
 

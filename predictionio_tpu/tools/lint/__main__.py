@@ -1,7 +1,7 @@
 """``python -m predictionio_tpu.tools.lint`` entry point.
 
 Per-file rules (JT01-JT17) by default; ``--project`` adds the
-whole-program concurrency pass (JT18-JT20) over the same parse.
+whole-program concurrency pass (JT18-JT21) over the same parse.
 ``bin/lint`` wraps this with ``--project`` preset — the CI gate."""
 
 import sys

@@ -1,4 +1,4 @@
-"""graftlint whole-program concurrency rules JT18-JT20.
+"""graftlint whole-program concurrency rules JT18-JT21.
 
 These rules consume the :mod:`project` model (class/attribute accesses,
 inferred guard discipline, thread-entry reachability, the project-wide
@@ -18,6 +18,12 @@ analysis structurally cannot see:
   and written under the lock in a later, separate region of the same
   function — the gap between the two regions is where another thread
   rewrites the premise.
+* **JT21 blocking-call-under-lock** — the convoy class: a
+  ``time.sleep``/socket/file-I/O/``urlopen`` call inside a ``with
+  <lock>`` region (directly, or in a helper only ever invoked with the
+  lock held) serializes every contending thread behind a kernel wait —
+  one slow peer turns a microsecond critical section into the whole
+  fleet's latency floor.
 
 Deliberate lock-free designs (copy-on-write row swaps, ring buffers
 that tolerate torn reads) are justified with the standard suppression
@@ -32,6 +38,7 @@ from typing import Dict, Iterator, List, Set, Tuple
 from predictionio_tpu.tools.lint.engine import Finding
 from predictionio_tpu.tools.lint.project import (
     Access,
+    BlockingCall,
     LockEdge,
     Project,
     ProjectRule,
@@ -259,3 +266,40 @@ class CheckThenActSplit(ProjectRule):
                             f"rewrite the premise; merge the regions or "
                             f"re-validate before acting",
                         )
+
+# -- JT21 ----------------------------------------------------------------------
+
+@register_project
+class BlockingCallUnderLock(ProjectRule):
+    id = "JT21"
+    name = "blocking-call-under-lock"
+    rationale = (
+        "A sleep, socket, file or subprocess call inside a `with lock:` "
+        "region parks the thread in the kernel WHILE every contending "
+        "thread queues behind the lock — the convoy class: one slow "
+        "peer or disk turns a microsecond critical section into the "
+        "process's latency floor (and the GIL is released during the "
+        "wait, so the serialization buys no safety the lock did not "
+        "already have). Copy what the region needs under the lock, do "
+        "the I/O outside it; suppress only with a reason the wait MUST "
+        "be serialized (e.g. the sleep IS the guarded capture window, "
+        "or the lock exists to serialize that very file handle)."
+    )
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for bc in project.blocking_calls:
+            held = bc.locks | project.inferred_held.get(
+                bc.func, frozenset())
+            if not held:
+                continue
+            locks = ", ".join(
+                f"`{_pretty(lock)}`" for lock in sorted(held))
+            via = ("" if bc.locks
+                   else " (every resolvable caller holds it)")
+            yield Finding(
+                self.id, bc.path, bc.line, bc.col,
+                f"blocking {bc.category} call `{bc.name}` while "
+                f"holding {locks}{via} — contending threads convoy "
+                f"behind the kernel wait; move the call outside the "
+                f"critical section or justify the serialized wait",
+            )

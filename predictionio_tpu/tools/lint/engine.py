@@ -255,7 +255,7 @@ def lint_paths(paths: Sequence[str],
 
 def lint_project(paths: Sequence[str]) -> Tuple[List[Finding], int]:
     """Whole-program mode: the per-file rules over every module PLUS the
-    project rules (JT18-JT20) over the cross-module model. The given
+    project rules (JT18-JT21) over the cross-module model. The given
     paths define the project universe; modules are parsed once (shared
     AST cache) and project findings honor each file's suppression
     comments exactly like per-file findings. Returns (findings, files)."""
@@ -353,14 +353,14 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m predictionio_tpu.tools.lint",
         description="graftlint — JAX/TPU-aware static analysis "
                     "(per-file rules JT01-JT17, whole-program rules "
-                    "JT18-JT20 with --project; see --list-rules)",
+                    "JT18-JT21 with --project; see --list-rules)",
     )
     parser.add_argument("paths", nargs="*", default=[],
                         help="files or directories to lint (default: the "
                              "installed predictionio_tpu package)")
     parser.add_argument("--project", action="store_true",
                         help="whole-program mode: per-file rules plus the "
-                             "cross-module concurrency rules JT18-JT20 "
+                             "cross-module concurrency rules JT18-JT21 "
                              "(lock-discipline inference, race/deadlock "
                              "detection) over the given paths as one "
                              "project")

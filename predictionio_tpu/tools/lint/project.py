@@ -105,6 +105,31 @@ _INIT_METHODS = {"__init__", "__new__", "__init_subclass__", "__set_name__"}
 _THREAD_TAILS = {"Thread"}
 _CALLBACK_TAILS = {"register", "watch", "submit"}
 
+#: calls that park the calling thread in the kernel (sleep, network,
+#: file, socket I/O) — JT21's vocabulary, matched only when the call
+#: does NOT resolve to a project function (a local helper named
+#: ``sleep`` is not ``time.sleep``). Deliberately curated: ``wait`` is
+#: absent (Condition.wait under its own lock is the correct idiom),
+#: and so is generic ``read``/``write`` (too many in-memory buffers).
+_BLOCKING_EXACT = {
+    "time.sleep": "sleep",
+    "sleep": "sleep",            # from time import sleep
+    "open": "file I/O",          # the builtin
+    "select.select": "socket I/O",
+}
+_BLOCKING_TAILS = {
+    "urlopen": "network I/O",
+    "create_connection": "socket I/O",
+    "getaddrinfo": "network I/O",
+    "accept": "socket I/O",
+    "recv": "socket I/O",
+    "recvfrom": "socket I/O",
+    "sendall": "socket I/O",
+    "check_output": "subprocess",
+    "check_call": "subprocess",
+    "communicate": "subprocess",
+}
+
 
 def _dotted(node: ast.AST) -> str:
     parts: List[str] = []
@@ -176,6 +201,22 @@ class LockEdge:
 
 
 @dataclasses.dataclass
+class BlockingCall:
+    """One sleep/network/file/socket call site (JT21's subjects); the
+    syntactic ``locks`` here combine with the called-with-lock-held
+    inference at rule time, so a blocking helper only ever invoked
+    under a lock is still caught."""
+
+    name: str                      # the dotted call as written
+    category: str                  # sleep | network I/O | file I/O | ...
+    func: str                      # FuncInfo key of the enclosing function
+    path: str
+    line: int
+    col: int
+    locks: FrozenSet[str]          # lock ids held syntactically
+
+
+@dataclasses.dataclass
 class GuardInfo:
     lock: str
     locked_writes: int
@@ -202,6 +243,8 @@ class Project:
     lock_edges: List[LockEdge]
     lock_kinds: Dict[str, str]     # lock id -> Lock|RLock|Condition|Semaphore
     inferred_held: Dict[str, FrozenSet[str]]
+    blocking_calls: List[BlockingCall] = dataclasses.field(
+        default_factory=list)
 
     def effective_locks(self, access: Access) -> FrozenSet[str]:
         """Locks held at an access site: syntactic plus the
@@ -480,6 +523,19 @@ class _ModuleVisitor:
             callee = resolve_call(node.func)
             if callee is not None:
                 info.calls.append((callee, held, node.lineno))
+            else:
+                # unresolved = not a project function: check the
+                # blocking-call vocabulary (JT21); every candidate is
+                # recorded — the rule adds the called-with-lock-held
+                # inference before deciding
+                category = _BLOCKING_EXACT.get(d)
+                if category is None and "." in d:
+                    category = _BLOCKING_TAILS.get(tail)
+                if category is not None:
+                    self.b.blocking_calls.append(BlockingCall(
+                        name=d, category=category, func=info.key,
+                        path=self.mod.path, line=node.lineno,
+                        col=node.col_offset, locks=held))
             # mutating method call on a shared subject: self.X.append(...)
             if isinstance(node.func, ast.Attribute) \
                     and node.func.attr in _MUTATORS:
@@ -620,6 +676,7 @@ class _Builder:
         self.accesses: List[Access] = []
         self.lock_edges: List[LockEdge] = []
         self.lock_kinds: Dict[str, str] = {}
+        self.blocking_calls: List[BlockingCall] = []
 
     def resolve_method(self, cls: ClassInfo, name: str,
                        _depth: int = 0) -> Optional[str]:
@@ -755,7 +812,8 @@ def build(modules: Sequence[ModuleInfo]) -> Project:
     return Project(modules=list(modules), funcs=b.funcs, classes=b.classes,
                    accesses=b.accesses, guards=guards,
                    lock_edges=b.lock_edges, lock_kinds=b.lock_kinds,
-                   inferred_held=inferred)
+                   inferred_held=inferred,
+                   blocking_calls=b.blocking_calls)
 
 
 # -- project rules -------------------------------------------------------------

@@ -1025,11 +1025,22 @@ class StreamUpdater:
         interval = (interval if interval is not None
                     else metrics.env_float("PIO_STREAM_INTERVAL_SEC", 1.0))
         stop = stop or threading.Event()
-        while not stop.is_set():
-            try:
-                self.poll_once()
-            except Exception:  # noqa: BLE001 — the daemon must survive a
-                # transient storage/serving failure; the error is logged
-                # and the next tick retries from the same cursor
-                log.exception("stream fold cycle failed")
-            stop.wait(interval)
+        # the stream daemon is a PIO process like any server: it holds
+        # the continuous profiler for its lifetime (refcounted — a
+        # daemon embedded beside a server shares the one sampler)
+        from predictionio_tpu.obs import contprof
+
+        owner = f"StreamUpdater:{id(self):#x}"
+        contprof.retain(owner)
+        try:
+            while not stop.is_set():
+                try:
+                    self.poll_once()
+                except Exception:  # noqa: BLE001 — the daemon must
+                    # survive a transient storage/serving failure; the
+                    # error is logged and the next tick retries from the
+                    # same cursor
+                    log.exception("stream fold cycle failed")
+                stop.wait(interval)
+        finally:
+            contprof.release(owner)

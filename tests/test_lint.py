@@ -38,7 +38,7 @@ def lint_src(tmp_path: Path, src: str, relpath: str = "mod.py"):
 
 def lint_project_src(tmp_path: Path, src: str, relpath: str = "mod.py"):
     """Write ``src`` under tmp_path and run WHOLE-PROGRAM mode over the
-    directory (per-file rules plus JT18-JT20) — the fixture project is
+    directory (per-file rules plus JT18-JT21) — the fixture project is
     exactly the files written so far."""
     path = tmp_path / relpath
     path.parent.mkdir(parents=True, exist_ok=True)
@@ -59,8 +59,8 @@ def test_all_rules_registered():
             "JT13", "JT14", "JT15", "JT16", "JT17"} <= set(RULES)
     # the whole-program concurrency layer registers separately: project
     # rules never run in per-file mode
-    assert {"JT18", "JT19", "JT20"} == set(PROJECT_RULES)
-    assert not {"JT18", "JT19", "JT20"} & set(RULES)
+    assert {"JT18", "JT19", "JT20", "JT21"} == set(PROJECT_RULES)
+    assert not {"JT18", "JT19", "JT20", "JT21"} & set(RULES)
 
 
 def test_syntax_error_is_reported_not_raised(tmp_path):
@@ -1871,6 +1871,101 @@ def test_jt20_negative_single_region_is_clean(tmp_path):
                 with self._lock:
                     if self._key is None:
                         self._key = object()
+    """)
+    assert findings == []
+
+
+# -- JT21 blocking-call-under-lock ---------------------------------------------
+
+def test_jt21_positive_sleep_under_lock(tmp_path):
+    findings = lint_project_src(tmp_path, """\
+        import threading
+        import time
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poke(self):
+                with self._lock:
+                    time.sleep(0.1)
+    """)
+    assert rule_ids(findings) == ["JT21"]
+    assert "time.sleep" in findings[0].message
+    assert "Box._lock" in findings[0].message
+
+
+def test_jt21_positive_helper_only_called_with_lock_held(tmp_path):
+    # the call sits in a helper with no `with` of its own — only the
+    # project-wide inferred-held fixpoint can see the lock
+    findings = lint_project_src(tmp_path, """\
+        import threading
+        import urllib.request
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poke(self):
+                with self._lock:
+                    self._fetch()
+
+            def _fetch(self):
+                urllib.request.urlopen("http://example.invalid", timeout=5)
+    """)
+    assert rule_ids(findings) == ["JT21"]
+    assert "every resolvable caller holds it" in findings[0].message
+
+
+def test_jt21_suppressible_with_justification(tmp_path):
+    findings = lint_project_src(tmp_path, """\
+        import threading
+        import time
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poke(self):
+                with self._lock:
+                    time.sleep(0.1)  # graftlint: disable=JT21 — fixture: the sleep IS the guarded capture window
+    """)
+    assert findings == []
+
+
+def test_jt21_negative_blocking_call_outside_lock(tmp_path):
+    # the sanctioned fix: copy under the lock, do the I/O outside it
+    findings = lint_project_src(tmp_path, """\
+        import threading
+        import time
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._delay = 0.1
+
+            def poke(self):
+                with self._lock:
+                    delay = self._delay
+                time.sleep(delay)
+    """)
+    assert findings == []
+
+
+def test_jt21_negative_condition_wait_is_not_flagged(tmp_path):
+    # Condition.wait under its own lock is the CORRECT idiom (it
+    # releases the lock while parked) — deliberately outside the
+    # blocking vocabulary
+    findings = lint_project_src(tmp_path, """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._cond = threading.Condition()
+
+            def park(self):
+                with self._cond:
+                    self._cond.wait(timeout=1.0)
     """)
     assert findings == []
 
