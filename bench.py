@@ -1942,6 +1942,97 @@ def stage_prof(base_dir, out_path):
         json.dump(detail, f)
 
 
+def stage_sentinel(base_dir, out_path):
+    """Ops-journal + regression-sentinel cost: pure host, no chip, no
+    storage. Times (a) the journal's fire-and-forget emit path — the
+    cost a breaker flip or canary verdict adds to SERVING code
+    (``key.journal_append_us``, lower-better; the acceptance bar is
+    single-digit microseconds) and (b) one full sentinel change-point
+    scan over a saturated timeline set — 360 samples in every series
+    slot, the worst case the snapshot cadence ever pays
+    (``key.anomaly_scan_ms``, lower-better)."""
+    import collections
+
+    from predictionio_tpu.obs import anomaly, journal, timeline
+
+    # -- journal emit cost (ring only: the serving-path configuration;
+    # PIO_JOURNAL_PATH adds one queue append, measured separately in
+    # the detail)
+    journal.JOURNAL.reset()
+    os.environ.pop("PIO_JOURNAL_PATH", None)
+    n = int(os.environ.get("PIO_BENCH_JOURNAL_EMITS", "20000"))
+    for _ in range(200):  # warm the emit path (metrics labels, ring)
+        journal.emit("breaker", target="warm", state="closed")
+    t0 = time.perf_counter()
+    for i in range(n):
+        journal.emit("breaker", target="bench", state="open",
+                     failures=i)
+    ring_us = (time.perf_counter() - t0) / n * 1e6
+
+    sink = os.path.join(base_dir, "journal_bench.jsonl")
+    os.environ["PIO_JOURNAL_PATH"] = sink
+    try:
+        t0 = time.perf_counter()
+        for i in range(n):
+            journal.emit("breaker", target="bench", state="open",
+                         failures=i)
+        queued_us = (time.perf_counter() - t0) / n * 1e6
+        if not journal.JOURNAL.flush(timeout=30.0):
+            raise RuntimeError("journal writer never drained the "
+                               "bench batch")
+    finally:
+        os.environ.pop("PIO_JOURNAL_PATH", None)
+    events, corrupt = journal.read_back(sink)
+    if corrupt or len(events) < n:
+        raise RuntimeError(
+            f"journal durability hole: {len(events)}/{n} lines back, "
+            f"{corrupt} corrupt")
+    journal.JOURNAL.reset()
+
+    # -- sentinel scan cost over a SATURATED timeline: every series
+    # slot full (obs/timeline MAX_SERIES x 360 samples)
+    saved = timeline.TIMELINE
+    bench_tl = timeline.Timeline()
+    cap = 360
+    series_n = timeline.MAX_SERIES
+    base_ts = 1_000_000.0
+    interval = 15.0
+    try:
+        timeline.TIMELINE = bench_tl
+        anomaly.SENTINEL.reset()
+        for si in range(series_n):
+            name = f"serve_p99_ms.bench{si}"
+            pts = bench_tl._series.setdefault(
+                name, collections.deque(maxlen=cap))
+            for k in range(cap):
+                # flat series + one step halfway on even series: the
+                # scan pays detection AND attribution work
+                v = 10.0 + (5.0 if (si % 2 == 0 and k > cap // 2)
+                            else 0.0)
+                pts.append((base_ts + k * interval, v))
+        journal.emit("reload", instance="bench-instance")
+        scans = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            anomaly.SENTINEL.scan(now=base_ts + cap * interval)
+            scans.append((time.perf_counter() - t0) * 1e3)
+        scan_ms = min(scans)  # best-of: the cost, not the scheduler
+    finally:
+        timeline.TIMELINE = saved
+        anomaly.SENTINEL.reset()
+        journal.JOURNAL.reset()
+
+    detail = {
+        "journal_append_us": round(ring_us, 3),
+        "journal_append_queued_us": round(queued_us, 3),
+        "anomaly_scan_ms": round(scan_ms, 3),
+        "anomaly_scan_series": series_n,
+        "anomaly_scan_samples": series_n * cap,
+    }
+    with open(out_path, "w") as f:
+        json.dump(detail, f)
+
+
 #: hard ceiling for the final stdout line. The driver records only a
 #: ~2 KB tail of bench stdout; round 4's single fat line outgrew it and
 #: the whole round's headline landed as ``"parsed": null`` in
@@ -2040,6 +2131,13 @@ def emit_headline(detail, detail_path=None):
         # under serve load (benchcmp: "overhead" = lower-better — the
         # sampler rides every serving process)
         "prof_overhead_pct": detail.get("prof_overhead_pct"),
+        # ops journal + regression sentinel (obs/journal.py,
+        # obs/anomaly.py): the emit cost a breaker flip adds to serving
+        # code (benchcmp: _us suffix = lower-better) and one full
+        # change-point scan over a saturated 360-sample timeline set
+        # (_ms = lower-better)
+        "journal_append_us": detail.get("journal_append_us"),
+        "anomaly_scan_ms": detail.get("anomaly_scan_ms"),
     }
     if "twotower" in detail:
         tt = detail["twotower"]
@@ -2095,8 +2193,10 @@ def orchestrate():
         # prof rides second: pure host HTTP load (no chip), and its
         # overhead number should reflect a quiet machine, before the
         # heavy stages contend for cores
-        for stage in ("lint", "prof", "cold", "warm", "twotower",
-                      "retrieval", "quality", "stream"):
+        # sentinel rides beside prof: pure host math (journal ring +
+        # change-point scan), cheapest on a quiet machine
+        for stage in ("lint", "prof", "sentinel", "cold", "warm",
+                      "twotower", "retrieval", "quality", "stream"):
             out = os.path.join(base_dir, f"{stage}.json")
             # child stdout -> our stderr: the stdout contract is ONE line
             proc = subprocess.run(
@@ -2120,6 +2220,7 @@ def orchestrate():
         # ["canary_verdict_ms"]
         detail.update(stages["lint"])
         detail.update(stages["prof"])
+        detail.update(stages["sentinel"])
         detail.update(stages["retrieval"])
         detail.update(stages["quality"])
         detail.update(stages["stream"])
@@ -2131,9 +2232,10 @@ def orchestrate():
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--stage",
-                        choices=["lint", "prof", "cold", "warm", "twotower",
-                                 "retrieval", "quality", "stream",
-                                 "parse_profile", "loadgen"])
+                        choices=["lint", "prof", "sentinel", "cold",
+                                 "warm", "twotower", "retrieval",
+                                 "quality", "stream", "parse_profile",
+                                 "loadgen"])
     parser.add_argument("--base")
     parser.add_argument("--out")
     args = parser.parse_args()
@@ -2141,6 +2243,8 @@ def main() -> None:
         stage_lint(args.base, args.out)
     elif args.stage == "prof":
         stage_prof(args.base, args.out)
+    elif args.stage == "sentinel":
+        stage_sentinel(args.base, args.out)
     elif args.stage == "cold":
         stage_cold(args.base, args.out)
     elif args.stage == "warm":

@@ -50,6 +50,15 @@ Three federations, one member list:
     one replica's slice — plus a per-member split of the tail cohort
     (which replica the p99 lives on).
 
+  journal + anomaly federation
+    ``GET /admin/fleet/journal`` merges the members' ops-journal pages
+    (obs/journal.py) into one member-annotated, wall-clock-ordered
+    stream; ``GET /admin/fleet/anomaly`` lays the members' regression-
+    sentinel reports (obs/anomaly.py) side by side and unions the
+    active anomalies — "what changed, where, and what did it" across
+    the whole fleet. Rendered by ``pio journal --fleet`` /
+    ``pio anomalies --fleet``.
+
 Members come from the fleet snapshot (every live replica's address)
 plus ``PIO_OBS_MEMBERS`` — a comma-separated list of ``name=url`` (or
 bare ``url``) entries naming the event server, storage server, stream
@@ -926,3 +935,130 @@ def federate_prof(members: List[Member], endpoint: Optional[str] = None,
     if slow:
         out["slow_trace_ids"] = slow_traces
     return out
+
+
+# -- fleet journal / anomaly federation ----------------------------------------
+
+def _fetch_journal(member: Member, n: int, kind: Optional[str],
+                   since: Optional[float], timeout: float
+                   ) -> Tuple[Optional[Dict[str, Any]], Optional[str]]:
+    from predictionio_tpu.obs import journal as journal_mod
+
+    if member.url is None:
+        return journal_mod.JOURNAL.page(n=n, kind=kind, since=since), None
+    params = [f"n={int(n)}"]
+    if kind:
+        from urllib.parse import quote
+
+        params.append(f"kind={quote(kind, safe='')}")
+    if since is not None:
+        params.append(f"since={since}")
+    url = f"{member.url}/admin/journal?" + "&".join(params)
+    body, error = _fetch(url, timeout)
+    if error is not None:
+        return None, error
+    try:
+        return json.loads(body or b"{}"), None
+    except ValueError as e:
+        return None, f"unparseable journal payload: {e}"
+
+
+def federate_journal(members: List[Member], n: int = 200,
+                     kind: Optional[str] = None,
+                     since: Optional[float] = None) -> Dict[str, Any]:
+    """Member-merged ops journal (``GET /admin/fleet/journal``): every
+    member's ring page annotated with its member name and merged into
+    ONE wall-clock-ordered stream — "what changed across the fleet,
+    in order" — with the newest ``n`` kept after the merge. Threaded
+    replicas share one process journal, so identical events (same
+    ts/mono/kind) dedupe to the first member that reported them. A
+    dead member degrades the merge, never fails it."""
+    timeout = collect_timeout()
+    member_reports: List[Dict[str, Any]] = []
+    merged: List[Dict[str, Any]] = []
+    seen: set = set()
+    for member, payload, error in _fan_out(
+            members,
+            lambda m: _fetch_journal(m, n, kind, since, timeout)):
+        report = {"name": member.name, "url": member.url,
+                  "role": member.role, "ok": error is None}
+        if error is not None:
+            report["error"] = error
+        else:
+            events = payload.get("events") or []
+            kept = 0
+            for event in events:
+                key = (event.get("ts"), event.get("mono"),
+                       event.get("kind"), event.get("trace"))
+                if key in seen:
+                    continue
+                seen.add(key)
+                event = dict(event)
+                event["fleet_member"] = member.name
+                merged.append(event)
+                kept += 1
+            report["events"] = kept
+            report["dropped_total"] = payload.get("dropped_total")
+        member_reports.append(report)
+    merged.sort(key=lambda e: (e.get("ts") or 0.0))
+    if n > 0:
+        merged = merged[-n:]
+    return {"members": member_reports,
+            "merged_from": [r["name"] for r in member_reports if r["ok"]],
+            "events": merged}
+
+
+def _fetch_anomaly(member: Member, timeout: float
+                   ) -> Tuple[Optional[Dict[str, Any]], Optional[str]]:
+    from predictionio_tpu.obs import anomaly as anomaly_mod
+
+    if member.url is None:
+        return anomaly_mod.SENTINEL.report(), None
+    body, error = _fetch(f"{member.url}/admin/anomaly", timeout)
+    if error is not None:
+        return None, error
+    try:
+        return json.loads(body or b"{}"), None
+    except ValueError as e:
+        return None, f"unparseable anomaly payload: {e}"
+
+
+def federate_anomaly(members: List[Member]) -> Dict[str, Any]:
+    """Per-member regression-sentinel reports (``GET
+    /admin/fleet/anomaly``) plus the union of active anomalies, each
+    stamped with the member it fired on — a latency shift on ONE
+    replica is a fleet regression, and the member stamp names the
+    replica without grepping N sentinel reports. Dead members degrade
+    the merge (their ``ok: false`` row still shows) so a sentinel
+    check during a rolling restart stays answerable."""
+    timeout = collect_timeout()
+    member_reports: List[Dict[str, Any]] = []
+    active: List[Dict[str, Any]] = []
+    seen: set = set()
+    for member, payload, error in _fan_out(
+            members, lambda m: _fetch_anomaly(m, timeout)):
+        report = {"name": member.name, "url": member.url,
+                  "role": member.role, "ok": error is None}
+        if error is not None:
+            report["error"] = error
+        else:
+            report["report"] = payload
+            # the sentinel's page keys active verdicts by series name;
+            # the fleet union flattens that into rows so one list names
+            # every (member, series) pair
+            block = payload.get("active") or {}
+            for series, entry in sorted(block.items()):
+                key = (member.name, series, entry.get("onset_ts"))
+                if key in seen:
+                    continue
+                seen.add(key)
+                entry = dict(entry)
+                entry["series"] = series
+                entry["fleet_member"] = member.name
+                active.append(entry)
+            report["active"] = len(block)
+        member_reports.append(report)
+    return {"members": member_reports,
+            "merged_from": [r["name"] for r in member_reports if r["ok"]],
+            "active": active,
+            "any_active": bool(active)}

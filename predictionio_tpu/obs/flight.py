@@ -115,6 +115,14 @@ _PAYLOADS_SKIPPED = metrics.counter(
     "PIO_FLIGHT_PAYLOAD_BYTES",
 )
 
+_LISTENER_ERRORS_TOTAL = metrics.counter(
+    "pio_snapshot_listener_errors_total",
+    "Snapshot-cadence listener failures, by listener name — a nonzero "
+    "rate means one periodic consumer (SLO sampler, timeline, anomaly "
+    "sentinel) is broken while the others keep riding the cadence",
+    ("listener",),
+)
+
 
 def payload_capacity() -> int:
     """The PIO_FLIGHT_PAYLOADS capture size (0 = off; read per call so
@@ -362,13 +370,19 @@ class FlightRecorder:
             snap["metrics"] = _metrics_snapshot()
             with self._lock:
                 self._snapshots.append(snap)
-            # periodic consumers (the SLO monitor's sampler) ride the
-            # same cadence instead of running threads of their own
-            for fn in list(_snapshot_listeners):
+            # periodic consumers (the SLO monitor's sampler, the
+            # timeline, the anomaly sentinel) ride the same cadence
+            # instead of running threads of their own; each is isolated
+            # AND counted — one broken listener must neither starve the
+            # others nor fail silently forever (the JT09 stance: a
+            # periodic consumer that stops producing needs a symptom)
+            for name, fn in list(_snapshot_listeners):
                 try:
                     fn()
                 except Exception:  # noqa: BLE001 — cadence must survive
-                    log.exception("flight snapshot listener %r failed", fn)
+                    _LISTENER_ERRORS_TOTAL.labels(name).inc()
+                    log.exception("flight snapshot listener %r (%s) "
+                                  "failed", fn, name)
         if slow:
             slow_log.warning(
                 "slow request: %s %s %.1f ms (threshold %.1f ms)",
@@ -486,15 +500,22 @@ class FlightRecorder:
 
 
 #: periodic-cadence listeners invoked whenever a metric snapshot is
-#: taken (every SNAPSHOT_INTERVAL_SEC while requests flow)
+#: taken (every SNAPSHOT_INTERVAL_SEC while requests flow), as
+#: (name, fn) pairs — the name labels the per-listener error counter
 _snapshot_listeners: List[Any] = []
 
 
-def add_snapshot_listener(fn) -> None:
+def add_snapshot_listener(fn, name: Optional[str] = None) -> None:
     """Register ``fn()`` to run on the recorder's snapshot cadence
-    (idempotent per function object)."""
-    if fn not in _snapshot_listeners:
-        _snapshot_listeners.append(fn)
+    (idempotent per function object). ``name`` labels the listener's
+    failures in ``pio_snapshot_listener_errors_total`` — pass the
+    subsystem name (``slo``, ``timeline``, ``anomaly``); anonymous
+    registrations fall back to the function's module."""
+    if name is None:
+        name = getattr(fn, "__module__", "") or "anonymous"
+        name = name.rsplit(".", 1)[-1]
+    if all(existing is not fn for _, existing in _snapshot_listeners):
+        _snapshot_listeners.append((name, fn))
 
 
 #: the process-global recorder every server records into

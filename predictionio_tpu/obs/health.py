@@ -61,7 +61,7 @@ import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from predictionio_tpu.obs import flight, metrics, trace
+from predictionio_tpu.obs import flight, journal, metrics, trace
 
 log = logging.getLogger(__name__)
 
@@ -564,9 +564,13 @@ class Watchdog:
             f"; stacks dumped to {dump_path}" if dump_path else "",
             extra={"pio": payload},
         )
+        journal.emit("watchdog_stall", watchdog=self.name,
+                     waited_sec=payload["waited_sec"],
+                     stall_trace=watch.trace_id,
+                     stack_dump=dump_path)
         # the counter is the LAST effect: anything observing it (tests,
-        # alert rules sampling right after a stall) sees the log line
-        # and stack dump already landed
+        # alert rules sampling right after a stall) sees the log line,
+        # stack dump and journal entry already landed
         _STALL_TOTAL.labels(self.name).inc()
 
     def _dump_stacks(self, payload: Dict[str, Any]) -> Optional[str]:

@@ -367,7 +367,7 @@ def refresh() -> int:
 
 # continuous reporting: the same cadence the SLO sampler and timeline
 # ride (obs/flight.py) — no thread of our own
-flight.add_snapshot_listener(refresh)
+flight.add_snapshot_listener(refresh, name="memacct")
 
 
 def device_memory_probe() -> health.ProbeResult:

@@ -458,4 +458,20 @@ def configure_from_env() -> Optional[Dict[str, Any]]:
 # (the admission controller's shed signal) and fires alert transitions
 # (the webhook sink); sampling alone would leave both dead on an
 # unattended server until someone happened to poll /admin/slo.
-flight.add_snapshot_listener(lambda: (MONITOR.tick(), MONITOR.evaluate()))
+flight.add_snapshot_listener(
+    lambda: (MONITOR.tick(), MONITOR.evaluate()), name="slo")
+
+
+def _journal_alert(name: str, firing: bool, entry: Dict[str, Any]) -> None:
+    """Alert fire/resolve edges land in the ops journal: a burn-rate
+    page is an operational state change the anomaly sentinel and
+    ``pio journal`` should be able to line up against reloads and
+    breaker flips."""
+    from predictionio_tpu.obs import journal
+
+    journal.emit("slo_alert", slo=name, firing=firing,
+                 state=entry.get("state"),
+                 burn_rates=entry.get("burn_rates"))
+
+
+add_alert_listener(_journal_alert)

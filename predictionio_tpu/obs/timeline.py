@@ -302,4 +302,4 @@ TIMELINE = Timeline()
 # ride the flight recorder's snapshot cadence (no thread of our own);
 # /admin/timeline reads also tick, so idle servers build history while
 # someone is watching
-flight.add_snapshot_listener(lambda: TIMELINE.sample())
+flight.add_snapshot_listener(lambda: TIMELINE.sample(), name="timeline")

@@ -42,7 +42,7 @@ import math
 import threading
 from typing import Any, Callable, Dict, Optional
 
-from predictionio_tpu.obs import metrics
+from predictionio_tpu.obs import journal, metrics
 
 log = logging.getLogger(__name__)
 
@@ -163,6 +163,9 @@ class AdmissionController:
         _SHED_TOTAL.labels(self.server, reason).inc()
         with self._lock:
             self._shed_count += 1
+        # episode tracking, not per-429 spam: the first shed opens a
+        # journal episode; the snapshot-cadence close stamps the count
+        journal.SHED_EPISODES.note_shed(reason, server=self.server)
         return ShedDecision(reason, retry_after, detail)
 
     def snapshot(self) -> Dict[str, Any]:

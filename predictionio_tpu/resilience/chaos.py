@@ -54,7 +54,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from predictionio_tpu.obs import metrics
+from predictionio_tpu.obs import journal, metrics
 
 log = logging.getLogger(__name__)
 
@@ -164,6 +164,8 @@ def _install(rules: Tuple[ChaosRule, ...], explicit: bool) -> None:
         _env_loaded = True
         if explicit:
             _explicit = True
+    journal.emit("chaos", spec=",".join(r.spec() for r in rules) or None,
+                 rules=len(rules), explicit=explicit or None)
     if rules:
         log.warning("CHAOS ACTIVE: %s", ",".join(r.spec() for r in rules))
     else:

@@ -35,7 +35,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple, Type
 
-from predictionio_tpu.obs import health, metrics
+from predictionio_tpu.obs import health, journal, metrics
 
 log = logging.getLogger(__name__)
 
@@ -139,6 +139,11 @@ class CircuitBreaker:
         self._last_change_unix = time.time()
         _CIRCUIT_STATE.labels(self.target).set(float(_STATE_RANK[state]))
         _CIRCUIT_TRANSITIONS.labels(self.target, state).inc()
+        # the ops journal gets every flip (fire-and-forget ring/queue
+        # append — safe under this lock): a breaker opening is exactly
+        # the causal event the anomaly sentinel joins a latency shift to
+        journal.emit("breaker", target=self.target, state=state,
+                     failures=self._failures)
         log.log(logging.WARNING if state == OPEN else logging.INFO,
                 "circuit %s: %s (failures=%d)", self.target, state,
                 self._failures)

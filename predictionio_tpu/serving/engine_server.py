@@ -40,7 +40,7 @@ from urllib.parse import urlparse
 
 from predictionio_tpu.core.engine import Engine
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.obs import flight, health, metrics, slo as slo_mod, trace
+from predictionio_tpu.obs import flight, health, journal, metrics, slo as slo_mod, trace
 from predictionio_tpu.parallel.mesh import MeshContext
 from predictionio_tpu.resilience import chaos
 from predictionio_tpu.resilience.admission import AdmissionController
@@ -620,7 +620,13 @@ class EngineServer(HTTPServerBase):
         # may raise PreflightRefused — deliberately OUTSIDE the breaker
         # accounting: a refused deploy is a capacity verdict, not a
         # storage failure, and must not push the server degraded
-        memacct.preflight_check(instance.id, self.storage, force=force)
+        try:
+            memacct.preflight_check(instance.id, self.storage,
+                                    force=force)
+        except memacct.PreflightRefused as e:
+            journal.emit("preflight_refused", instance=instance.id,
+                         detail=str(e)[:200])
+            raise
         try:
             deployment = prepare_deploy(self.engine, instance, self.ctx,
                                         self.storage)
@@ -631,6 +637,9 @@ class EngineServer(HTTPServerBase):
         self._storage_breaker.record_success()
         with self._deployment_lock:
             old, self.deployment = self.deployment, deployment
+        journal.emit("reload", instance=deployment.instance.id,
+                     prev=old.instance.id, requested=instance_id,
+                     forced=force or None)
         # retire the swapped-out instance's residency (weakref sweep is
         # the backstop; the deliberate seam keeps gauges honest NOW)
         for model in old.models:
@@ -660,6 +669,9 @@ class EngineServer(HTTPServerBase):
             deployment = self.deployment
             if instance_id and instance_id != deployment.instance.id:
                 _MODEL_PATCHES.labels("stale").inc()
+                journal.emit("patch", outcome="stale",
+                             instance=instance_id,
+                             deployed=deployment.instance.id)
                 raise self.StalePatch(
                     f"patch targets instance {instance_id} but "
                     f"{deployment.instance.id} is deployed")
@@ -687,6 +699,8 @@ class EngineServer(HTTPServerBase):
                         "support model patches — use /reload")
                 applied += 1
         _MODEL_PATCHES.labels("applied").inc()
+        journal.emit("patch", outcome="ok", applied=applied,
+                     instance=instance_id)
         return {"applied": applied}
 
     # -- degraded mode ------------------------------------------------------

@@ -72,7 +72,7 @@ import urllib.parse
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional
 
-from predictionio_tpu.obs import health, metrics, timeline, trace
+from predictionio_tpu.obs import health, journal, metrics, timeline, trace
 from predictionio_tpu.resilience.policy import Policy
 from predictionio_tpu.serving.http import drain_timeout
 
@@ -605,6 +605,8 @@ class FleetSupervisor:
             replica.state = state
             _REPLICA_UP.labels(replica.name).set(
                 1.0 if state == READY else 0.0)
+        journal.emit("replica_state", replica=replica.name, prev=old,
+                     state=state, deliberate=deliberate)
         log.info("replica %s: %s -> %s", replica.name, old, state)
 
     def _mark_dead(self, replica: Replica, reason: str) -> None:
@@ -659,10 +661,16 @@ class FleetSupervisor:
             with self._state_lock:
                 self._swap = {"active": True, "started_unix": time.time(),
                               "last": self._swap.get("last")}
+            journal.emit("swap", phase="start", forced=force)
             result = self._rolling_reload_locked(force=force)
             with self._state_lock:
                 self._swap = {"active": False, "last": result}
             _SWAPS.labels(result["outcome"]).inc()
+            journal.emit("swap", phase="end",
+                         outcome=result["outcome"],
+                         swapped=result["swapped"],
+                         errors=len(result["errors"]) or None,
+                         version=result["version"])
             return result
 
     def _rolling_reload_locked(self, force: bool = False) -> Dict[str, Any]:
@@ -738,6 +746,8 @@ class FleetSupervisor:
             errors.append(f"{replica.name}: preflight refused the "
                           f"deploy (507 insufficient device memory): "
                           f"{body}")
+            journal.emit("preflight_refused", replica=replica.name,
+                         instance=instance_id, detail=str(body)[:200])
         elif status != 200:
             errors.append(f"{replica.name}: reload answered "
                           f"{status}: {body}")
@@ -987,6 +997,10 @@ class FleetSupervisor:
                     "forced": bool(force),
                 }
             self._canary_name = replica.name
+            journal.emit("canary_start", replica=replica.name,
+                         baseline=baseline,
+                         candidate=replica.version or candidate,
+                         forced=bool(force) or None)
             quality.STATE.canary_begin(replica.name, baseline,
                                        replica.version or candidate)
             log.info("canary ACTIVE: %s serves candidate %s against "
@@ -1002,6 +1016,11 @@ class FleetSupervisor:
                     "outcome": outcome, **(extra or {})}
             self._canary = {"active": False, "last": last}
         self._canary_name = None
+        journal.emit("canary_verdict", outcome=outcome,
+                     replica=last.get("replica"),
+                     baseline=last.get("baseline_version"),
+                     candidate=last.get("candidate_version"),
+                     rejected=last.get("rejected_version"))
         quality.STATE.canary_end(
             outcome, {"verdict": verdict} if verdict else None)
 
@@ -1017,6 +1036,8 @@ class FleetSupervisor:
             raise ValueError("no active canary to promote")
         log.info("canary verdict PROMOTE for %s: rolling the fleet onto "
                  "%s", info.get("replica"), info.get("candidate_version"))
+        journal.emit("canary_promote", replica=info.get("replica"),
+                     candidate=info.get("candidate_version"))
         self._end_canary("promoted", verdict)
         # a force-started canary promotes with the same force — the
         # operator already owned the OOM risk at start
@@ -1041,6 +1062,9 @@ class FleetSupervisor:
         # stop shadow traffic first, then restore — the rejected
         # candidate version is remembered so the canary-mode watch does
         # not immediately re-canary it (see _maybe_auto_swap)
+        journal.emit("canary_rollback", replica=info.get("replica"),
+                     baseline=baseline,
+                     rejected=info.get("candidate_version"))
         self._end_canary("rolled_back", verdict,
                          extra={"rejected_version":
                                 info.get("candidate_version")})

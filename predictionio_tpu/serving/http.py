@@ -53,6 +53,19 @@ Every server also inherits the shared operator surface from the
                          ?endpoint=, ?slow=1 slices) }
   GET  /admin/fleet/prof member-merged continuous    }
                          profile (404 w/o a fleet)   }
+  GET  /admin/journal    ops journal ring (?n=&kind= }
+                         &since=): reloads, canary   }
+                         verdicts, breaker flips,    }
+                         shed episodes, anomalies    }
+  GET  /admin/anomaly    regression sentinel report: }
+                         active change-points with   }
+                         causal attribution to the   }
+                         journal + recent resolves   }
+  GET  /admin/fleet/journal member-merged journal    }
+                         stream (404 w/o a fleet)    }
+  GET  /admin/fleet/anomaly per-member sentinel      }
+                         reports + active union      }
+                         (404 w/o a fleet)           }
 
 ``/healthz``, ``/readyz`` and ``/metrics`` stay unauthenticated — a
 liveness prober or scraper holds no operator secrets; the ``/admin/*``
@@ -74,9 +87,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 from urllib.parse import parse_qs, urlparse
 
-from predictionio_tpu.obs import (contprof, flight, health, metrics,
-                                  perfacct, profiler, push, slo, timeline,
-                                  trace)
+from predictionio_tpu.obs import (anomaly, contprof, flight, health,
+                                  journal, metrics, perfacct, profiler,
+                                  push, slo, timeline, trace)
 from predictionio_tpu.resilience import alerts, chaos
 from predictionio_tpu.resilience import policy as respolicy
 
@@ -492,6 +505,71 @@ def _serve_fleet_prof(handler, query: str) -> None:
     handler._send(200, report)
 
 
+def _serve_admin_journal(handler, query: str) -> None:
+    """``GET /admin/journal?n=&kind=&since=``: this process's ops
+    journal ring, newest last — reloads, canary verdicts, breaker
+    flips, shed episodes, anomaly onsets (obs/journal.py). ``kind``
+    filters one event kind exactly; ``since`` is a unix-seconds floor;
+    ``n`` caps the page (default 200)."""
+    params = parse_qs(query)
+    try:
+        n = int((params.get("n") or ["200"])[0])
+        since = float(params["since"][0]) if "since" in params else None
+    except ValueError as e:
+        handler._send(400, {"message": f"bad n/since: {e}"})
+        return
+    kind = (params.get("kind") or [None])[0]
+    handler._send(200, journal.JOURNAL.page(n=n, kind=kind, since=since))
+
+
+def _serve_fleet_journal(handler, query: str) -> None:
+    """``GET /admin/fleet/journal``: the members' journals merged into
+    one member-annotated, time-ordered stream (same ?n=&kind=&since=
+    slices); a dead member degrades the merge, never fails it."""
+    from predictionio_tpu.obs import collect
+
+    members = _fleet_federation_members(handler)
+    if members is None:
+        handler._send(404, {"message": "no fleet supervised by this "
+                                       "server and no PIO_OBS_MEMBERS "
+                                       "configured"})
+        return
+    params = parse_qs(query)
+    try:
+        n = int((params.get("n") or ["200"])[0])
+        since = float(params["since"][0]) if "since" in params else None
+    except ValueError as e:
+        handler._send(400, {"message": f"bad n/since: {e}"})
+        return
+    kind = (params.get("kind") or [None])[0]
+    handler._send(200, collect.federate_journal(members, n=n, kind=kind,
+                                                since=since))
+
+
+def _serve_admin_anomaly(handler) -> None:
+    """``GET /admin/anomaly``: the regression sentinel's report —
+    active change-points per timeline series (direction, z, CUSUM,
+    onset, the journal event each is attributed to) plus recently
+    resolved episodes (obs/anomaly.py). The read itself scans, so an
+    idle server still verdicts while someone is watching."""
+    handler._send(200, anomaly.SENTINEL.scan())
+
+
+def _serve_fleet_anomaly(handler) -> None:
+    """``GET /admin/fleet/anomaly``: every member's sentinel report
+    side by side + the union of active anomalies (a regression on ANY
+    replica is a fleet regression)."""
+    from predictionio_tpu.obs import collect
+
+    members = _fleet_federation_members(handler)
+    if members is None:
+        handler._send(404, {"message": "no fleet supervised by this "
+                                       "server and no PIO_OBS_MEMBERS "
+                                       "configured"})
+        return
+    handler._send(200, collect.federate_anomaly(members))
+
+
 def _serve_admin_fleet(handler) -> None:
     """``GET /admin/fleet``: the replica fleet's snapshot (states,
     versions, restart counts, swap progress). ``POST /admin/fleet``:
@@ -596,6 +674,18 @@ def _instrument(fn):
                 return
             if self.command == "GET" and path == "/admin/fleet/prof":
                 _serve_fleet_prof(self, parsed.query)
+                return
+            if self.command == "GET" and path == "/admin/journal":
+                _serve_admin_journal(self, parsed.query)
+                return
+            if self.command == "GET" and path == "/admin/anomaly":
+                _serve_admin_anomaly(self)
+                return
+            if self.command == "GET" and path == "/admin/fleet/journal":
+                _serve_fleet_journal(self, parsed.query)
+                return
+            if self.command == "GET" and path == "/admin/fleet/anomaly":
+                _serve_fleet_anomaly(self)
                 return
             if path == "/admin/fleet":
                 _serve_admin_fleet(self)

@@ -43,9 +43,13 @@ _HIGHER_BETTER = re.compile(r"(per_sec|_qps|qps$|throughput|mfu|"
 #: direction on any future metric merely containing the word). The
 #: ``overhead`` fragment gates the continuous profiler's cost
 #: (``prof_overhead_pct``): the sampler rides every serving process,
-#: so its growth taxes every request
-_LOWER_BETTER = re.compile(r"(_ms$|_ms_|_sec$|_sec_|_seconds|latency|"
-                           r"_bytes$|p50|p99|debt|rmse|drift|overhead)")
+#: so its growth taxes every request. The ``_us`` tails gate the
+#: sentinel stage's ``journal_append_us`` — the journal emit rides
+#: every breaker flip and canary verdict on the serving path, so
+#: microsecond creep there is a real regression
+_LOWER_BETTER = re.compile(r"(_ms$|_ms_|_us$|_us_|_sec$|_sec_|_seconds|"
+                           r"latency|_bytes$|p50|p99|debt|rmse|drift|"
+                           r"overhead)")
 
 #: detail keys that are run configuration, not performance — a change
 #: is reported as CONFIG-CHANGED (never a regression verdict: comparing

@@ -43,6 +43,14 @@ Console.scala:128-735 command surface; bin/pio:17-42 wrapper):
   canary                (the fleet's canary lane: paired answer diffs,
                          per-lane latency burn, promote/rollback —
                          obs/quality.py's verdict via /admin/quality)
+  journal               (the ops journal, obs/journal.py: reloads,
+                         canary verdicts, breaker flips, shed
+                         episodes, anomalies — /admin/journal, or the
+                         member-merged stream with --fleet; --follow
+                         tails it)
+  anomalies             (the regression sentinel, obs/anomaly.py:
+                         active change-points with causal attribution
+                         to the journal — exit 1 while any is active)
 
 Run as ``python -m predictionio_tpu.tools.cli <command> ...``.
 """
@@ -919,6 +927,187 @@ def cmd_slo(args) -> int:
     return 1 if firing else 0
 
 
+def _fetch_admin_json(url: str, timeout: float = 30.0):
+    """GET an /admin/* JSON payload with the bearer header; raises
+    CommandError with the server's message on failure."""
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(url)
+    _add_admin_auth(req)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.load(resp)
+    except urllib.error.HTTPError as e:
+        body = e.read().decode(errors="replace")
+        try:
+            message = json.loads(body).get("message", body)
+        except json.JSONDecodeError:
+            message = body[:200]
+        raise CommandError(f"request failed ({e.code}): {message}")
+    except urllib.error.URLError as e:
+        raise CommandError(f"cannot reach {url}: {e.reason}")
+
+
+def format_journal_event(event) -> str:
+    """One journal event as one human line: local wall clock, kind,
+    member when federated, then the event's own fields."""
+    import datetime
+
+    ts = event.get("ts")
+    when = (datetime.datetime.fromtimestamp(ts).strftime("%H:%M:%S")
+            if isinstance(ts, (int, float)) else "--:--:--")
+    parts = [f"{when}  {event.get('kind', '?'):<18}"]
+    member = event.get("fleet_member")
+    if member:
+        parts.append(f"[{member}]")
+    for key, value in event.items():
+        if key in ("ts", "mono", "kind", "fleet_member"):
+            continue
+        if key == "trace":
+            value = str(value)[:8]
+        parts.append(f"{key}={value}")
+    return " ".join(parts)
+
+
+def cmd_journal(args) -> int:
+    """The ops journal (obs/journal.py): what DID the system do and
+    when — reloads, patches, canary verdicts, breaker flips, SLO
+    alerts, shed episodes, watchdog stalls, anomaly onsets. Reads
+    ``GET /admin/journal`` (or the member-merged
+    ``GET /admin/fleet/journal`` with --fleet) when --url is given,
+    else this process's ring. ``--follow`` polls for new events until
+    interrupted; ``--kind``/``--since``/``-n`` slice the page."""
+    import time as _time
+    import urllib.parse
+
+    def fetch(since):
+        if args.url:
+            path = ("/admin/fleet/journal" if args.fleet
+                    else "/admin/journal")
+            query = {"n": str(args.n)}
+            if args.kind:
+                query["kind"] = args.kind
+            if since is not None:
+                query["since"] = repr(since)
+            url = (args.url.rstrip("/") + path + "?"
+                   + urllib.parse.urlencode(query))
+            return _fetch_admin_json(url)
+        if args.fleet:
+            raise CommandError("--fleet needs --url (the router "
+                               "assembles the member merge)")
+        from predictionio_tpu.obs import journal as _journal
+
+        return _journal.JOURNAL.page(n=args.n, kind=args.kind,
+                                     since=since)
+
+    since = args.since
+    payload = fetch(since)
+    if args.json and not args.follow:
+        json.dump(payload, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+        return 0
+    events = payload.get("events") or []
+    for event in events:
+        _p(json.dumps(event, sort_keys=True) if args.json
+           else format_journal_event(event))
+    if not events and not args.follow:
+        _p("(journal is empty)")
+    if not args.follow:
+        return 0
+    # follow mode: poll with ?since= just past the newest event we
+    # printed — ts is the join key across members, so a merged fleet
+    # stream tails the same way a single process does
+    last_ts = max((e.get("ts") or 0.0 for e in events), default=0.0)
+    try:
+        while True:
+            _time.sleep(args.interval)
+            payload = fetch(last_ts + 1e-3 if last_ts else None)
+            for event in payload.get("events") or []:
+                ts = event.get("ts") or 0.0
+                if ts > last_ts:
+                    last_ts = ts
+                sys.stdout.write(
+                    (json.dumps(event, sort_keys=True) if args.json
+                     else format_journal_event(event)) + "\n")
+                sys.stdout.flush()
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_anomalies(args) -> int:
+    """The regression sentinel (obs/anomaly.py): active change-points
+    over the metric timelines, each attributed to the nearest ops-
+    journal event inside the causal window, plus recently resolved
+    episodes. Reads ``GET /admin/anomaly`` (or the per-member
+    ``GET /admin/fleet/anomaly`` with --fleet) when --url is given,
+    else this process's sentinel. Exits 1 while ANY anomaly is active
+    — the CI/cron-able "did that deploy regress anything" check."""
+    if args.url:
+        path = "/admin/fleet/anomaly" if args.fleet else "/admin/anomaly"
+        report = _fetch_admin_json(args.url.rstrip("/") + path)
+    elif args.fleet:
+        raise CommandError("--fleet needs --url (the router assembles "
+                           "the member merge)")
+    else:
+        from predictionio_tpu.obs import anomaly as _anomaly
+
+        report = _anomaly.SENTINEL.report()
+    active = report.get("active") or []
+    if isinstance(active, dict):
+        # the single-process page keys verdicts by series name; the
+        # fleet merge already flattens to rows with a member stamp
+        active = [dict(entry, series=series)
+                  for series, entry in sorted(active.items())]
+    if args.json:
+        json.dump(report, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+        return 1 if active else 0
+
+    def describe(entry) -> str:
+        line = (f"{entry.get('series', '?'):<28} "
+                f"{entry.get('mode', '?')}/{entry.get('direction', '?')} "
+                f"z={entry.get('z', 0):.1f} "
+                f"baseline={entry.get('baseline')} "
+                f"now={entry.get('recent')}")
+        member = entry.get("fleet_member")
+        if member:
+            line = f"[{member}] " + line
+        cause = entry.get("cause")
+        if cause:
+            line += (f"\n{'':<30}<- {cause.get('kind', '?')} "
+                     f"{cause.get('gap_sec', 0):+.1f}s "
+                     + " ".join(f"{k}={v}" for k, v in cause.items()
+                                if k not in ("kind", "gap_sec", "ts",
+                                             "trace")))
+        return line
+
+    if args.fleet:
+        for member in report.get("members") or []:
+            state = ("ok" if member.get("ok")
+                     else f"ERROR: {member.get('error')}")
+            _p(f"member {member.get('name', '?'):<12} {state}  "
+               f"active={member.get('active', '?')}")
+        _p("")
+    if not active:
+        _p("no active anomalies")
+    else:
+        _p(f"{len(active)} ACTIVE anomal"
+           + ("y" if len(active) == 1 else "ies")
+           + f" (window {report.get('window_sec', '?')}s):")
+        for entry in active:
+            _p("  " + describe(entry))
+    resolved = (report.get("recent_resolved") or []
+                if not args.fleet else [])
+    if resolved:
+        _p("recently resolved:")
+        for entry in resolved[-5:]:
+            _p(f"  {entry.get('series', '?'):<28} "
+               f"lasted {entry.get('duration_sec', 0):.0f}s "
+               f"(cause: {(entry.get('cause') or {}).get('kind', '-')})")
+    return 1 if active else 0
+
+
 def cmd_chaos(args) -> int:
     """Inspect or toggle a live server's fault injection
     (``/admin/chaos``, resilience/chaos.py): with no mutation flags,
@@ -1456,7 +1645,7 @@ def cmd_bench_compare(args) -> int:
 
 def cmd_lint(args) -> int:
     """graftlint: the JAX/TPU-aware static analysis over the tree
-    (rules JT01-JT17 per file; --project adds the whole-program
+    (rules JT01-JT17 + JT22 per file; --project adds the whole-program
     concurrency layer JT18-JT20; tier-1 CI runs the same passes via
     tests/test_lint_clean.py)."""
     from predictionio_tpu.tools.lint import run_cli
@@ -1935,6 +2124,48 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(func=cmd_top)
 
     p = sub.add_parser(
+        "journal",
+        help="the ops journal: what the system DID and when (reloads, "
+             "canary verdicts, breaker flips, shed episodes, anomaly "
+             "onsets) — one line per event, newest last",
+    )
+    p.add_argument("--url", default=None,
+                   help="server base URL (default: this process's ring)")
+    p.add_argument("--fleet", action="store_true",
+                   help="member-merged stream via the router's "
+                        "GET /admin/fleet/journal (requires --url)")
+    p.add_argument("-n", type=int, default=200,
+                   help="events to show (default 200)")
+    p.add_argument("--kind", default=None,
+                   help="only this event kind (reload, breaker, "
+                        "canary_verdict, shed_episode, anomaly, ...)")
+    p.add_argument("--since", type=float, default=None,
+                   help="unix-seconds floor")
+    p.add_argument("--follow", "-f", action="store_true",
+                   help="keep polling for new events until interrupted")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="--follow poll interval in seconds (default 2)")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON (one object per line with --follow)")
+    p.set_defaults(func=cmd_journal)
+
+    p = sub.add_parser(
+        "anomalies",
+        help="the regression sentinel: active metric change-points "
+             "attributed to journal events; exit 1 while any is active",
+    )
+    p.add_argument("--url", default=None,
+                   help="server base URL (default: this process's "
+                        "sentinel)")
+    p.add_argument("--fleet", action="store_true",
+                   help="per-member reports + the active union via the "
+                        "router's GET /admin/fleet/anomaly (requires "
+                        "--url)")
+    p.add_argument("--json", action="store_true",
+                   help="raw sentinel report")
+    p.set_defaults(func=cmd_anomalies)
+
+    p = sub.add_parser(
         "bench-compare",
         help="compare the newest BENCH_r*.json round against a baseline; "
              "print per-metric deltas, exit 1 on regressions beyond the "
@@ -1953,7 +2184,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(func=cmd_bench_compare)
 
     p = sub.add_parser("lint", help="run graftlint (JAX/TPU-aware static "
-                                    "analysis, rules JT01-JT20) over the tree")
+                                    "analysis, rules JT01-JT22) over the tree")
     p.add_argument("paths", nargs="*", default=[],
                    help="files/dirs (default: the installed package)")
     p.add_argument("--project", action="store_true",

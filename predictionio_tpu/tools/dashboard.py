@@ -131,6 +131,10 @@ class _DashboardRequestHandler(JSONRequestHandler):
             self._send_cors(200, self.server_ref.memory_html(),
                             "text/html; charset=UTF-8")
             return
+        if path == "/anomaly":
+            self._send_cors(200, self.server_ref.anomaly_html(),
+                            "text/html; charset=UTF-8")
+            return
         if path == "/prof":
             params = parse_qs(url.query)
             slow = (params.get("slow") or ["0"])[0].lower() in ("1",
@@ -208,6 +212,7 @@ class DashboardServer(HTTPServerBase):
             '<a href="/slo">SLO burn rates</a> · '
             '<a href="/resilience">resilience</a> · '
             '<a href="/timeline">timelines</a> · '
+            '<a href="/anomaly">anomaly sentinel</a> · '
             '<a href="/quality">model quality</a> · '
             '<a href="/memory">device memory</a> · '
             '<a href="/trace">trace stitcher</a> · '
@@ -357,6 +362,122 @@ class DashboardServer(HTTPServerBase):
         ).format(interval=payload["interval_sec"], cap=payload["capacity"],
                  series_rows=series_rows,
                  stale=datapath["staleness_seconds"], run_rows=run_rows)
+
+    def anomaly_html(self) -> str:
+        """The regression sentinel as an operator panel: active
+        change-points with their causal journal attribution, each
+        series' sparkline with the anomaly onset (^) and nearby
+        journal events (|) marked under it, plus the journal tail."""
+        from predictionio_tpu.obs import anomaly, journal
+        from predictionio_tpu.obs.timeline import TIMELINE, sparkline
+
+        report = anomaly.SENTINEL.scan()  # watching the panel scans
+        payload = TIMELINE.series()
+        events = journal.JOURNAL.recent(30)
+
+        def marker_line(points, width, onset_ts, window) -> str:
+            """A second code line under a sparkline: ``^`` at the
+            anomaly onset sample, ``|`` at journal events that fall
+            inside the attribution window around it."""
+            if not points or len(points) < 2:
+                return ""
+            t0, t1 = points[0][0], points[-1][0]
+            span = max(t1 - t0, 1e-9)
+
+            def col(ts) -> int:
+                return min(width - 1,
+                           max(0, int((ts - t0) / span * (width - 1))))
+
+            line = [" "] * width
+            for event in events:
+                ets = event.get("ts")
+                if (isinstance(ets, (int, float)) and t0 <= ets <= t1
+                        and event.get("kind") not in ("anomaly",
+                                                      "anomaly_resolved")
+                        and onset_ts is not None
+                        and abs(ets - onset_ts) <= window):
+                    line[col(ets)] = "|"
+            if onset_ts is not None and t0 <= onset_ts <= t1:
+                line[col(onset_ts)] = "^"
+            return "".join(line).rstrip()
+
+        window = report.get("window_sec", 30.0)
+        active_rows = []
+        for name, entry in sorted((report.get("active") or {}).items()):
+            points = payload["series"].get(name) or []
+            values = [p[1] for p in points]
+            spark = sparkline(values, 48) if values else ""
+            marks = marker_line(points, 48, entry.get("onset_ts"),
+                                window)
+            cause = entry.get("cause") or {}
+            cause_text = (
+                "{kind} ({gap:+.1f}s)".format(
+                    kind=cause.get("kind", "?"),
+                    gap=cause.get("gap_sec", 0.0))
+                if cause else "(no journal event in window)")
+            active_rows.append(
+                "<tr><td>{name}</td><td>{mode}/{direction}</td>"
+                "<td>{z:.1f}</td><td>{baseline:.4g} → {value:.4g}</td>"
+                "<td>{cause}</td>"
+                "<td><code>{spark}<br>{marks}</code></td></tr>".format(
+                    name=html.escape(name),
+                    mode=html.escape(str(entry.get("mode", "?"))),
+                    direction=html.escape(str(entry.get("direction",
+                                                        "?"))),
+                    z=entry.get("z", 0.0),
+                    baseline=entry.get("baseline", 0.0),
+                    value=entry.get("recent", 0.0),
+                    cause=html.escape(cause_text),
+                    spark=html.escape(spark),
+                    marks=html.escape(marks).replace(" ", "&nbsp;")))
+        active_table = "".join(active_rows) or (
+            "<tr><td colspan='6'>no active anomalies — the sentinel "
+            "scans every timeline sample</td></tr>")
+        resolved_rows = "".join(
+            "<tr><td>{name}</td><td>{dur:.0f}s</td><td>{cause}</td>"
+            "</tr>".format(
+                name=html.escape(str(entry.get("series", "?"))),
+                dur=entry.get("duration_sec", 0.0),
+                cause=html.escape(str((entry.get("cause") or {}).get(
+                    "kind", "-"))))
+            for entry in reversed(report.get("recent_resolved") or [])
+        ) or "<tr><td colspan='3'>none</td></tr>"
+        journal_rows = "".join(
+            "<tr><td>{ts:.1f}</td><td>{kind}</td><td><code>{rest}"
+            "</code></td></tr>".format(
+                ts=event.get("ts", 0.0),
+                kind=html.escape(str(event.get("kind", "?"))),
+                rest=html.escape(" ".join(
+                    f"{k}={v}" for k, v in event.items()
+                    if k not in ("ts", "mono", "kind"))))
+            for event in reversed(events)
+        ) or "<tr><td colspan='3'>journal is empty</td></tr>"
+        return (
+            "<!DOCTYPE html><html><head><title>Regression sentinel"
+            "</title></head><body><h1>Regression sentinel</h1>"
+            "<p>Change-point scan over the metric timelines on the "
+            "snapshot cadence; onsets join the ops journal within "
+            "{window:g}s (PIO_ANOMALY_WINDOW_SEC). Last scan "
+            "{scan_ms:.2f}ms. "
+            '<a href="/admin/anomaly">JSON</a> · '
+            '<a href="/admin/journal">journal JSON</a> · '
+            '<a href="/timeline">timelines</a> · '
+            '<a href="/">index</a></p>'
+            "<h2>Active</h2>"
+            "<table border='1'><tr><th>Series</th><th>Mode</th>"
+            "<th>z</th><th>Baseline → now</th><th>Attributed cause</th>"
+            "<th>Sparkline (^ onset, | journal)</th></tr>"
+            "{active_table}</table>"
+            "<h2>Recently resolved</h2>"
+            "<table border='1'><tr><th>Series</th><th>Duration</th>"
+            "<th>Cause</th></tr>{resolved_rows}</table>"
+            "<h2>Journal tail</h2>"
+            "<table border='1'><tr><th>ts</th><th>Kind</th>"
+            "<th>Fields</th></tr>{journal_rows}</table>"
+            "</body></html>"
+        ).format(window=window, scan_ms=report.get("scan_ms") or 0.0,
+                 active_table=active_table, resolved_rows=resolved_rows,
+                 journal_rows=journal_rows)
 
     def quality_html(self) -> str:
         """The model-quality plane as an operator panel: drift values

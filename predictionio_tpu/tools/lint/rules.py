@@ -1710,3 +1710,119 @@ class UntracedIntraFleetCall(Rule):
                 "suppress with a justification naming why the peer is "
                 "not a fleet member",
             )
+
+
+# -- JT22 ----------------------------------------------------------------------
+
+@register
+class UnjournaledStateTransition(Rule):
+    id = "JT22"
+    name = "unjournaled-state-transition"
+    rationale = (
+        "A write to a breaker/canary/replica state attribute (the "
+        "`state`/`_state` name-tail convention) IS an operational "
+        "transition: a replica left rotation, a circuit opened, a "
+        "canary verdict landed. Unjournaled, the transition exists "
+        "only in process memory — `pio journal` cannot answer 'what "
+        "changed before the regression', the anomaly sentinel "
+        "(obs/anomaly.py) has nothing to attribute the change-point "
+        "to, and the durable record (PIO_JOURNAL_PATH) misses the one "
+        "event a post-mortem needs. Pair the write with a journal "
+        "emit (obs/journal.emit or Journal.emit) in the same scope, "
+        "or justify the suppression (e.g. a test-only reset that is "
+        "not an operational transition)."
+    )
+
+    #: the hazard lives where operational state machines flip:
+    #: the resilience layer (breakers, admission), the fleet
+    #: supervisor and the streaming updater — elsewhere a `state`
+    #: attribute is ordinary data, not an ops transition
+    def applies_to(self, abspath: str) -> bool:
+        norm = abspath.replace("\\", "/")
+        return ("/resilience/" in norm
+                or norm.endswith("/serving/fleet.py")
+                or norm.endswith("/workflow/stream.py"))
+
+    @staticmethod
+    def _is_state_attr(node: ast.AST) -> bool:
+        return (isinstance(node, ast.Attribute)
+                and (node.attr == "state"
+                     or node.attr.endswith("_state")))
+
+    @staticmethod
+    def _body_walk(fn: ast.AST) -> Iterator[ast.AST]:
+        """Walk a function's OWN body — nested defs are their own
+        scope (their journal call cannot vouch for the outer one and
+        vice versa)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name == "__init__":
+                # construction is initialization, not a transition —
+                # there is nothing to journal about an object being
+                # born in its resting state (same stance as JT18)
+                continue
+            body = list(self._body_walk(fn))
+            # the pairing tell: any journal-shaped call in the same
+            # scope (journal.emit, JOURNAL.emit, self._journal.emit, a
+            # note_* helper on the journal module) vouches for every
+            # transition the scope performs — the emit carries the
+            # scope's context, per-write pairing would be noise
+            has_journal = any(
+                isinstance(n, ast.Call)
+                and "journal" in dotted(n.func).lower()
+                for n in body)
+            if has_journal:
+                continue
+            # one-hop local taint (JT16 discipline): a state attribute
+            # read into a local and written back transformed
+            # (`s = self._state; ...; self._state = next_of(s)`) is
+            # still ONE transition — and a helper call that RECEIVES
+            # the journal module/object as an argument vouches the
+            # same way a direct emit does
+            vouched_names: Set[str] = set()
+            for node in body:
+                if isinstance(node, ast.Assign):
+                    if ("journal" in dotted(node.value).lower()
+                            if isinstance(node.value, (ast.Attribute,
+                                                       ast.Name))
+                            else False):
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                vouched_names.add(tgt.id)
+            if vouched_names and any(
+                    isinstance(n, ast.Call)
+                    and any(isinstance(a, ast.Name)
+                            and a.id in vouched_names
+                            for a in n.args)
+                    for n in body):
+                continue
+            for node in body:
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                    targets = [node.target]
+                else:
+                    continue
+                flat = []
+                for t in targets:
+                    flat.extend(t.elts if isinstance(
+                        t, (ast.Tuple, ast.List)) else [t])
+                if any(self._is_state_attr(t) for t in flat):
+                    yield Finding(
+                        self.id, ctx.path, node.lineno, node.col_offset,
+                        "state-attribute write with no journal emit in "
+                        "the same scope — an operational transition "
+                        "the ops journal (obs/journal.py) cannot see; "
+                        "emit a journal event beside it or justify a "
+                        "suppression",
+                    )

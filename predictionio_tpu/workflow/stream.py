@@ -100,7 +100,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.obs import metrics, perfacct, trace
+from predictionio_tpu.obs import journal, metrics, perfacct, trace
 
 log = logging.getLogger(__name__)
 
@@ -642,6 +642,7 @@ class StreamUpdater:
             raise StreamUnsupported(
                 f"no COMPLETED instance for engine {self.engine_id}")
         self._bind_instance(instance)
+        journal.emit("resync", instance=self.instance_id)
 
     # -- one cycle -----------------------------------------------------------
     def poll_once(self) -> Dict[str, Any]:
@@ -677,6 +678,7 @@ class StreamUpdater:
             self.cursor = new_cursor
             self._staleness_debt = True
             _FOLDS.labels("rebased").inc()
+            journal.emit("fold", outcome="rebased")
             log.warning(
                 "delta cursor rebased (compaction or truncated appends): "
                 "skipping fold; run a full retrain to reconcile")
@@ -750,8 +752,13 @@ class StreamUpdater:
         if published:
             _FOLDS.labels("ok").inc()
             _FOLD_EVENTS.inc(len(users))
+            journal.emit("fold", outcome="ok", events=len(users),
+                         seconds=round(seconds, 3),
+                         truncated=truncated or None)
         else:
             _FOLDS.labels("patch_failed").inc()
+            journal.emit("fold", outcome="patch_failed",
+                         events=len(users))
         out = {
             "events": len(users),
             "rebased": False,
@@ -868,6 +875,12 @@ class StreamUpdater:
         if merged["breached"] and not self._quality_reload_fired:
             self._quality_reload_fired = True
             quality.note_auto_reload()
+            journal.emit("drift_breach", band=merged["band"],
+                         breached=merged["breached"],
+                         recall=merged.get("recall_vs_retrain"),
+                         rmse_drift=merged.get("rmse_drift"),
+                         factor_drift=merged.get("factor_drift"))
+            journal.emit("auto_reload", reason="drift_breach")
             log.warning(
                 "model-quality drift breached the band %.2f (%s: "
                 "recall_vs_retrain=%s rmse_drift=%s factor_drift=%s) — "

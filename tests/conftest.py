@@ -32,7 +32,7 @@ def _reset_resilience():
     gauges feed admission control — a previous test's open circuit,
     active fault, or deliberately-slow traffic must never shed the next
     test's requests."""
-    from predictionio_tpu.obs import slo
+    from predictionio_tpu.obs import anomaly, journal, slo
     from predictionio_tpu.resilience import chaos, policy
 
     def reset():
@@ -40,6 +40,9 @@ def _reset_resilience():
         chaos.reset()
         slo.MONITOR.clear()
         slo.MONITOR.evaluate()  # no samples -> burn gauges back to 0
+        journal.JOURNAL.reset()
+        journal.SHED_EPISODES.reset()
+        anomaly.SENTINEL.reset()
 
     reset()
     yield

@@ -1,0 +1,271 @@
+"""Regression sentinel (obs/anomaly.py): the MAD/CUSUM detector pins
+(pure-function verdicts over fixed rings), journal attribution
+windowing, the sentinel lifecycle over a fake timeline, and the
+snapshot-listener isolation the cadence wiring rides on."""
+
+import pytest
+
+from predictionio_tpu.obs import anomaly, journal
+
+
+def pts(vals, t0=1000.0, dt=15.0):
+    return [(t0 + i * dt, float(v)) for i, v in enumerate(vals)]
+
+
+#: 24 baseline points: median 10, alternating +/-0.2 wiggle so the MAD
+#: (and the robust sigma) is nonzero and the z pins are honest
+BASE = [10.0 + (0.2 if i % 2 else -0.2) for i in range(24)]
+
+UP = {"direction": "up", "deadband": 0.10, "abs_deadband": 1.0}
+DOWN = {"direction": "down", "deadband": 0.10, "abs_deadband": 1.0}
+
+
+def detect(vals, cfg=UP, z=3.0, h=6.0, min_samples=12):
+    return anomaly.detect(pts(vals), cfg=cfg, z_threshold=z, cusum_h=h,
+                          min_samples=min_samples)
+
+
+class TestDetectorPins:
+    """The deterministic core: same ring, same verdict, pinned numbers
+    (baseline median 10, MAD-sigma 0.29652)."""
+
+    def test_step_up(self):
+        v = detect(BASE + [10.0] * 6 + [15.0] * 6)
+        assert v["mode"] == "step"
+        assert v["direction"] == "up"
+        assert v["baseline"] == 10.0
+        assert v["sigma"] == pytest.approx(0.29652)
+        assert v["recent"] == 15.0
+        assert v["z"] == pytest.approx(16.86)
+        # onset = the first sample of the trailing out-of-band run:
+        # index 30 of a 15 s cadence starting at t=1000
+        assert v["onset_ts"] == 1450.0
+
+    def test_step_down(self):
+        v = detect(BASE + [10.0] * 6 + [5.0] * 6, cfg=DOWN)
+        assert v["mode"] == "step"
+        assert v["direction"] == "down"
+        assert v["z"] == pytest.approx(-16.86)
+
+    def test_slow_drift_trips_cusum_below_z_threshold(self):
+        # +0.05/sample ramp: recent median only 1.5 sigma out (far
+        # under the z=10 gate) but the one-sided CUSUM accumulates
+        drift = [10.0 + 0.05 * k for k in range(12)]
+        v = anomaly.detect(
+            pts(BASE + drift),
+            cfg={"direction": "up", "deadband": 0.02,
+                 "abs_deadband": 0.1},
+            z_threshold=10.0, cusum_h=6.0, min_samples=12)
+        assert v["mode"] == "drift"
+        assert v["z"] == pytest.approx(1.52)
+        assert v["cusum"] == pytest.approx(6.12)
+        assert v["onset_ts"] == 1435.0
+
+    def test_deadband_holds_through_small_shift(self):
+        # +0.1 on a baseline of 10 is inside the 10% band: many sigmas
+        # (sigma 0.297) but not an incident
+        assert detect(BASE + [10.1] * 12) is None
+
+    def test_direction_config_gates_the_alarm(self):
+        vals = BASE + [10.0] * 6 + [15.0] * 6
+        assert detect(vals, cfg=DOWN) is None  # a rise is fine for p99-down
+        assert detect(vals, cfg=UP) is not None
+        both = {"direction": "both", "deadband": 0.10, "abs_deadband": 1.0}
+        assert detect(vals, cfg=both) is not None
+
+    def test_not_enough_history_is_silent(self):
+        assert detect([10.0] * 10) is None
+        assert detect([10.0] * 13) is None  # baseline ok, scan too thin
+
+    def test_one_outlier_does_not_trip_drift(self):
+        # Z_CLIP bounds a single wild point's CUSUM contribution
+        vals = BASE + [10.0] * 11 + [500.0]
+        v = detect(vals, z=100.0, h=10.0)
+        assert v is None
+
+    def test_flat_baseline_sigma_floor(self):
+        # MAD 0 must not make every wiggle infinite sigmas
+        v = anomaly.detect(pts([10.0] * 24 + [15.0] * 6),
+                           cfg=UP, z_threshold=3.0, cusum_h=6.0,
+                           min_samples=12)
+        assert v is not None
+        assert v["sigma"] == pytest.approx(0.01)  # 1e-3 * |median|
+
+
+class TestSeriesConfig:
+    def test_longest_dotted_prefix_wins(self):
+        assert anomaly.series_config(
+            "quality.rmse_drift.eng")["direction"] == "up"
+        assert anomaly.series_config(
+            "quality.recall.eng")["direction"] == "down"
+        assert anomaly.series_config("serve_p99_ms.e")["direction"] == "up"
+        assert (anomaly.series_config("never_configured")
+                is anomaly._DEFAULT_CFG)
+
+
+class TestAttribution:
+    def test_nearest_preceding_event_wins(self, monkeypatch):
+        monkeypatch.setenv("PIO_ANOMALY_WINDOW_SEC", "30")
+        events = [
+            {"ts": 960.0, "kind": "patch"},     # outside the window
+            {"ts": 985.0, "kind": "reload", "instance": "i-2"},
+            {"ts": 995.0, "kind": "breaker", "target": "t"},  # closest
+        ]
+        cause = anomaly.attribute(1000.0, events)
+        assert cause["kind"] == "breaker"
+        assert cause["gap_sec"] == pytest.approx(5.0)
+
+    def test_event_after_onset_loses_to_preceding(self, monkeypatch):
+        monkeypatch.setenv("PIO_ANOMALY_WINDOW_SEC", "30")
+        events = [
+            {"ts": 980.0, "kind": "reload"},
+            {"ts": 1001.0, "kind": "swap"},  # nearer but AFTER onset
+        ]
+        assert anomaly.attribute(1000.0, events)["kind"] == "reload"
+
+    def test_event_after_onset_can_still_name_it(self, monkeypatch):
+        monkeypatch.setenv("PIO_ANOMALY_WINDOW_SEC", "30")
+        events = [{"ts": 1003.0, "kind": "reload"}]
+        cause = anomaly.attribute(1000.0, events)
+        assert cause["kind"] == "reload"
+        assert cause["gap_sec"] == pytest.approx(-3.0)
+
+    def test_nothing_in_window_is_unattributed(self, monkeypatch):
+        monkeypatch.setenv("PIO_ANOMALY_WINDOW_SEC", "30")
+        assert anomaly.attribute(
+            1000.0, [{"ts": 900.0, "kind": "reload"}]) is None
+
+    def test_sentinel_events_never_explain_an_anomaly(self, monkeypatch):
+        monkeypatch.setenv("PIO_ANOMALY_WINDOW_SEC", "30")
+        events = [{"ts": 999.0, "kind": "anomaly", "series": "x"},
+                  {"ts": 998.0, "kind": "anomaly_resolved"}]
+        assert anomaly.attribute(1000.0, events) is None
+
+
+@pytest.fixture()
+def fake_timeline(monkeypatch):
+    """A fresh Timeline installed as the process singleton, plus a
+    helper to fill one series ring directly."""
+    import collections
+
+    from predictionio_tpu.obs import timeline
+
+    tl = timeline.Timeline()
+    monkeypatch.setattr(timeline, "TIMELINE", tl)
+
+    def fill(name, vals, t0=1000.0, dt=15.0):
+        ring = tl._series.setdefault(
+            name, collections.deque(maxlen=360))
+        ring.clear()
+        for i, v in enumerate(vals):
+            ring.append((t0 + i * dt, float(v)))
+
+    tl.fill = fill
+    return tl
+
+
+class TestSentinelLifecycle:
+    SERIES = "serve_p99_ms.eng"
+
+    def test_scan_detects_attributes_and_resolves(self, fake_timeline,
+                                                  monkeypatch):
+        monkeypatch.setenv("PIO_ANOMALY_WINDOW_SEC", "60")
+        fake_timeline.fill(self.SERIES, BASE + [10.0] * 6 + [15.0] * 6)
+        # the causal event lands just before the onset (index 30 ->
+        # ts 1450)
+        journal.JOURNAL.emit("reload", instance="i-9")
+        journal.JOURNAL._ring[-1]["ts"] = 1445.0
+        report = anomaly.SENTINEL.scan(now=1540.0)
+        assert self.SERIES in report["active"]
+        verdict = report["active"][self.SERIES]
+        assert verdict["mode"] == "step"
+        assert verdict["since"] == 1540.0
+        assert verdict["cause"]["kind"] == "reload"
+        assert verdict["cause"]["instance"] == "i-9"
+        assert verdict["cause"]["gap_sec"] == pytest.approx(5.0)
+        assert anomaly.SENTINEL.any_active()
+        assert anomaly._ACTIVE.labels(self.SERIES).value == 1.0
+        onsets = journal.JOURNAL.recent(kind="anomaly")
+        assert len(onsets) == 1
+        assert onsets[0]["series"] == self.SERIES
+        assert onsets[0]["cause_kind"] == "reload"
+
+        # a second scan with the shift still in the ring: the episode
+        # CONTINUES (no second journal event, onset/cause sticky)
+        report = anomaly.SENTINEL.scan(now=1555.0)
+        assert report["active"][self.SERIES]["since"] == 1540.0
+        assert report["active"][self.SERIES]["cause"]["kind"] == "reload"
+        assert len(journal.JOURNAL.recent(kind="anomaly")) == 1
+
+        # recovery: the ring turns over to flat again -> resolved
+        fake_timeline.fill(self.SERIES, BASE + [10.0] * 12)
+        report = anomaly.SENTINEL.scan(now=1600.0)
+        assert report["active"] == {}
+        assert not anomaly.SENTINEL.any_active()
+        assert anomaly._ACTIVE.labels(self.SERIES).value == 0.0
+        resolved = journal.JOURNAL.recent(kind="anomaly_resolved")
+        assert len(resolved) == 1
+        assert resolved[0]["duration_sec"] == pytest.approx(60.0)
+        episode = report["recent_resolved"][-1]
+        assert episode["series"] == self.SERIES
+        assert episode["resolved_ts"] == 1600.0
+        assert episode["duration_sec"] == pytest.approx(60.0)
+
+    def test_unattributed_anomaly_has_no_cause(self, fake_timeline,
+                                               monkeypatch):
+        monkeypatch.setenv("PIO_ANOMALY_WINDOW_SEC", "30")
+        fake_timeline.fill(self.SERIES, BASE + [10.0] * 6 + [15.0] * 6)
+        report = anomaly.SENTINEL.scan(now=1540.0)
+        assert "cause" not in report["active"][self.SERIES]
+        assert journal.JOURNAL.recent(kind="anomaly")[0].get(
+            "cause_kind") is None
+
+    def test_report_shape(self):
+        report = anomaly.SENTINEL.report()
+        assert set(report) == {"window_sec", "active", "recent_resolved",
+                               "scan_ms"}
+        assert report["active"] == {}
+
+
+class TestSnapshotListenerIsolation:
+    """One broken cadence listener must neither starve the others nor
+    fail silently (pio_snapshot_listener_errors_total{listener})."""
+
+    def test_broken_listener_is_counted_and_isolated(self, monkeypatch):
+        from predictionio_tpu.obs import flight
+
+        ran = []
+
+        def broken():
+            raise RuntimeError("boom")
+
+        def healthy():
+            ran.append(True)
+
+        monkeypatch.setattr(flight, "_snapshot_listeners",
+                            [("broken_fixture", broken),
+                             ("healthy_fixture", healthy)])
+        errors = flight._LISTENER_ERRORS_TOTAL.labels("broken_fixture")
+        base = errors.value
+        # interval 0: every sealed record takes a snapshot, which is
+        # the cadence the listeners ride
+        recorder = flight.FlightRecorder(snapshot_interval=0.0)
+        key = recorder.begin("0" * 32, "test", "GET", "/x")
+        recorder.finish(key, 200)
+        assert ran == [True]  # the healthy listener still ran
+        assert errors.value == base + 1
+
+    def test_add_snapshot_listener_names_and_dedupes(self, monkeypatch):
+        from predictionio_tpu.obs import flight
+
+        listeners = []
+        monkeypatch.setattr(flight, "_snapshot_listeners", listeners)
+
+        def fn():
+            pass
+
+        flight.add_snapshot_listener(fn, name="mine")
+        flight.add_snapshot_listener(fn, name="mine")  # idempotent
+        assert listeners == [("mine", fn)]
+        flight.add_snapshot_listener(lambda: None)
+        assert listeners[-1][0]  # anonymous fallback still labelled

@@ -690,3 +690,92 @@ def test_benchcmp_gates_federation_keys(tmp_path, capsys):
     assert "key.fleet_scrape_ms" in out and "REGRESSION" in out
     better = _bench_round(tmp_path, "BENCH_r03.json", 8.0, 2.0)
     assert benchcmp.run([base, better]) == 0
+
+
+# ---------------------------------------------------------------------------
+# ops-journal + anomaly federation
+# ---------------------------------------------------------------------------
+
+def _dead_member():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    return collect.Member("gone", f"http://127.0.0.1:{port}")
+
+
+def test_federate_journal_merges_and_degrades():
+    from predictionio_tpu.obs import journal
+
+    journal.emit("reload", instance="i-1")
+    journal.emit("breaker", target="t", state="open")
+    members = [collect.Member("local", None), _dead_member()]
+    report = collect.federate_journal(members, n=50)
+    by_name = {m["name"]: m for m in report["members"]}
+    assert by_name["local"]["ok"] is True
+    assert by_name["local"]["events"] == 2
+    assert by_name["gone"]["ok"] is False and by_name["gone"]["error"]
+    assert report["merged_from"] == ["local"]
+    kinds = [e["kind"] for e in report["events"]]
+    assert kinds == ["reload", "breaker"]  # wall-clock ordered
+    assert all(e["fleet_member"] == "local" for e in report["events"])
+
+
+def test_federate_journal_dedupes_shared_process_journal():
+    """Threaded replicas share one process journal: the same event
+    reported by two member views must appear once, stamped with the
+    first member that reported it."""
+    from predictionio_tpu.obs import journal
+
+    journal.emit("swap", phase="start")
+    members = [collect.Member("r0", None), collect.Member("r1", None)]
+    report = collect.federate_journal(members, n=50)
+    assert [m["events"] for m in report["members"]] == [1, 0]
+    assert len(report["events"]) == 1
+    assert report["events"][0]["fleet_member"] == "r0"
+
+
+def test_federate_journal_kind_filter_passes_through():
+    from predictionio_tpu.obs import journal
+
+    journal.emit("reload", instance="i-1")
+    journal.emit("patch", outcome="ok")
+    report = collect.federate_journal(
+        [collect.Member("local", None)], n=50, kind="patch")
+    assert [e["kind"] for e in report["events"]] == ["patch"]
+
+
+def test_federate_anomaly_unions_active_and_degrades():
+    from predictionio_tpu.obs import anomaly
+
+    verdict = {"mode": "step", "direction": "up", "z": 9.0,
+               "baseline": 10.0, "recent": 15.0, "onset_ts": 1450.0,
+               "since": 1540.0}
+    anomaly.SENTINEL._active["serve_p99_ms.e"] = dict(verdict)
+    members = [collect.Member("local", None), _dead_member()]
+    report = collect.federate_anomaly(members)
+    by_name = {m["name"]: m for m in report["members"]}
+    assert by_name["local"]["ok"] is True
+    assert by_name["local"]["active"] == 1
+    assert by_name["gone"]["ok"] is False and by_name["gone"]["error"]
+    assert report["merged_from"] == ["local"]
+    assert report["any_active"] is True
+    row = report["active"][0]
+    assert row["series"] == "serve_p99_ms.e"
+    assert row["fleet_member"] == "local"
+    assert row["mode"] == "step"
+
+
+def test_federate_anomaly_all_quiet():
+    report = collect.federate_anomaly([collect.Member("local", None)])
+    assert report["any_active"] is False
+    assert report["active"] == []
+    assert report["members"][0]["active"] == 0
+
+
+def test_benchcmp_gates_sentinel_keys():
+    from predictionio_tpu.tools import benchcmp
+
+    assert benchcmp.lower_is_better("key.journal_append_us")
+    assert benchcmp.lower_is_better("key.anomaly_scan_ms")

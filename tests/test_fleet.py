@@ -47,12 +47,15 @@ def post(url, body=b'{"mult": 2}', headers=None, timeout=15):
 
 @contextlib.contextmanager
 def running_fleet(storage, engine, n=3, probe_interval=0.05,
-                  backoff=None, **engine_kw):
+                  backoff=None, engine_name="const", **engine_kw):
     """N threaded const-engine replicas behind a router on an
-    ephemeral port; yields (fleet, router, base_url)."""
+    ephemeral port; yields (fleet, router, base_url). ``engine_name``
+    labels the serving metrics — tests that assert on cumulative
+    histograms pass a private name so earlier tests' observations
+    (chaos hangs especially) don't sit in their tail."""
     def factory(name):
-        return EngineServer(engine, "const", host="127.0.0.1", port=0,
-                            storage=storage, max_batch=8,
+        return EngineServer(engine, engine_name, host="127.0.0.1",
+                            port=0, storage=storage, max_batch=8,
                             chaos_tag=name, **engine_kw)
 
     fleet = FleetSupervisor(threaded_fleet(n, factory),
@@ -798,3 +801,110 @@ def test_stale_pooled_connection_retries_fresh_without_breaker_charge():
         stop.set()
         listener.close()
         server_thread.join(timeout=5)
+
+
+# -- acceptance: chaos -> anomaly attributed -> recovery -> durable journal ----
+
+def test_fleet_chaos_anomaly_journal_e2e(memory_storage, monkeypatch,
+                                         tmp_path, capsys):
+    """The ops-journal + sentinel acceptance loop end to end: injected
+    batcher latency on ONE replica of a 2-replica fleet raises the
+    fleet-wide serve p99, the sentinel detects the shift and attributes
+    it to the chaos journal event, ``pio anomalies`` gates 1 while
+    active and 0 after the ring turns over post-recovery, and the
+    journal file outlives the fleet (read back torn-tail-safely, the
+    restart-durability contract)."""
+    from predictionio_tpu.obs import anomaly, journal
+    from predictionio_tpu.obs import timeline as timeline_mod
+    from predictionio_tpu.tools.cli import main as cli_main
+
+    monkeypatch.setenv("PIO_HEDGE_QUANTILE", "0")  # no hedge rescue:
+    # the injected latency must land in the histogram tail
+    sink = tmp_path / "journal.jsonl"
+    monkeypatch.setenv("PIO_JOURNAL_PATH", str(sink))
+    # a fresh timeline focused on the serving p99 (the rate/staleness
+    # collectors would add unrelated series whose test-paced samples
+    # could alarm on their own); capacity 24 so the post-recovery ring
+    # turns over inside the test
+    tl = timeline_mod.Timeline(
+        interval=0.0, capacity=24,
+        collectors=[timeline_mod.quantile_collector(
+            "pio_serving_request_seconds", 0.99, "serve_p99_ms",
+            scale=1e3)])
+    monkeypatch.setattr(timeline_mod, "TIMELINE", tl)
+    # a private engine name: the shared cumulative histogram for
+    # "const" carries earlier tests' chaos hangs in its tail, which
+    # would bury this test's 250 ms injections
+    series = "serve_p99_ms.journal_e2e"
+
+    from predictionio_tpu.core import (Engine, FirstServing,
+                                       IdentityPreparator)
+    from predictionio_tpu.core.params import EngineParams
+    from predictionio_tpu.workflow.train import run_train
+
+    from tests.test_health import (ConstAlgo, ConstDataSource,
+                                   ConstParams)
+
+    engine = Engine(ConstDataSource, IdentityPreparator,
+                    {"const": ConstAlgo}, FirstServing)
+    run_train(engine, EngineParams(
+        data_source_params=("", ConstParams(value=1.0)),
+        preparator_params=("", None),
+        algorithm_params_list=[("const", ConstParams(value=2.0))],
+        serving_params=("", None)),
+        engine_id="journal_e2e", storage=memory_storage)
+    with running_fleet(memory_storage, engine, n=2,
+                       engine_name="journal_e2e") as (fleet, router,
+                                                      base):
+        for _ in range(16):
+            status, body, _ = post(base + "/queries.json")
+            assert status == 200, body
+            tl.sample(now=time.time())
+        report = anomaly.SENTINEL.scan(now=time.time())
+        assert series not in report["active"], report  # calm baseline
+
+        chaos.configure("batcher@r1:latency:250ms")  # journals "chaos"
+        for _ in range(8):
+            status, body, _ = post(base + "/queries.json", timeout=30)
+            assert status == 200, body
+            tl.sample(now=time.time())
+        report = anomaly.SENTINEL.scan(now=time.time())
+        assert series in report["active"], report
+        verdict = report["active"][series]
+        assert verdict["direction"] == "up"
+        assert verdict["cause"]["kind"] == "chaos", verdict
+        assert counter_value("pio_anomaly_active", series) == 1.0
+        assert cli_main(["anomalies"]) == 1
+        out = capsys.readouterr().out
+        assert series in out and "chaos" in out
+
+        chaos.clear()
+        for _ in range(30):
+            status, body, _ = post(base + "/queries.json")
+            assert status == 200, body
+            tl.sample(now=time.time())
+        report = anomaly.SENTINEL.scan(now=time.time())
+        assert series not in report["active"], report
+        assert counter_value("pio_anomaly_active", series) == 0.0
+        assert cli_main(["anomalies"]) == 0
+        assert "no active anomalies" in capsys.readouterr().out
+
+    assert journal.JOURNAL.flush(timeout=10.0)
+    events, corrupt = journal.read_back(str(sink))
+    assert corrupt == 0
+    kinds = [e["kind"] for e in events]
+    for expected in ("replica_state", "chaos", "anomaly",
+                     "anomaly_resolved"):
+        assert expected in kinds, kinds
+    onset = next(e for e in events if e["kind"] == "anomaly")
+    assert onset["series"] == series
+    assert onset["cause_kind"] == "chaos"
+    # a restarted process (fresh Journal over the same path) appends to
+    # the same history
+    fresh = journal.Journal()
+    fresh.emit("reload", instance="post-restart")
+    assert fresh.flush(timeout=10.0)
+    events2, _ = journal.read_back(str(sink))
+    assert len(events2) == len(events) + 1
+    assert events2[-1]["instance"] == "post-restart"
+    fresh.reset()

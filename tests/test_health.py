@@ -471,10 +471,9 @@ def test_latency_regression_fires_fast_burn_alert():
 
 
 def test_slo_monitor_rides_flight_snapshot_cadence():
-    assert any(
-        getattr(fn, "__name__", "") == "<lambda>"
-        for fn in flight._snapshot_listeners
-    ), "SLO sampler not registered on the flight snapshot cadence"
+    assert "slo" in {
+        name for name, _fn in flight._snapshot_listeners
+    }, "SLO sampler not registered on the flight snapshot cadence"
 
 
 def test_admin_slo_endpoint_and_cli(memory_storage, capsys):

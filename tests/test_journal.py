@@ -1,0 +1,288 @@
+"""Ops journal (obs/journal.py): ring semantics, the disk writer's
+durability contract (flush barrier, size-capped rotation, torn-tail
+read-back), shed-episode aggregation, and the /admin/journal page."""
+
+import json
+import threading
+
+import pytest
+
+from predictionio_tpu.obs import journal
+
+
+class TestRing:
+    def test_emit_stamps_and_keeps_fields(self):
+        event = journal.emit("reload", instance="i-1", forced=None,
+                             prev="i-0")
+        assert event["kind"] == "reload"
+        assert event["instance"] == "i-1"
+        assert event["prev"] == "i-0"
+        assert "forced" not in event  # None fields are elided
+        assert isinstance(event["ts"], float)
+        assert isinstance(event["mono"], float)
+        got = journal.JOURNAL.recent()
+        assert got and got[-1] == event
+
+    def test_recent_filters_kind_since_and_n(self):
+        journal.emit("reload", instance="a")
+        journal.emit("breaker", target="t", state="open")
+        journal.emit("reload", instance="b")
+        reloads = journal.JOURNAL.recent(kind="reload")
+        assert [e["instance"] for e in reloads] == ["a", "b"]
+        assert journal.JOURNAL.recent(n=1, kind="reload")[0][
+            "instance"] == "b"
+        assert journal.JOURNAL.recent(n=0) == []
+        cutoff = reloads[-1]["ts"]
+        assert all(e["ts"] >= cutoff
+                   for e in journal.JOURNAL.recent(since=cutoff))
+
+    def test_ring_is_bounded_by_env(self, monkeypatch):
+        monkeypatch.setenv("PIO_JOURNAL_RING", "16")
+        for i in range(40):
+            journal.emit("patch", seq=i)
+        got = journal.JOURNAL.recent()
+        assert len(got) == 16
+        assert got[-1]["seq"] == 39  # newest kept, oldest dropped
+
+    def test_trace_id_joins_when_active(self):
+        from predictionio_tpu.obs import trace
+
+        trace_id = trace.new_trace_id()
+        token = trace.activate(trace_id)
+        try:
+            event = journal.emit("breaker", target="x", state="open")
+        finally:
+            trace.deactivate(token)
+        assert event.get("trace") == trace_id
+        assert "trace" not in journal.emit("breaker", target="x",
+                                           state="closed")
+
+    def test_page_shape(self, monkeypatch, tmp_path):
+        sink = str(tmp_path / "j.jsonl")
+        monkeypatch.setenv("PIO_JOURNAL_PATH", sink)
+        journal.emit("swap", phase="start")
+        page = journal.JOURNAL.page(n=10)
+        assert set(page) == {"capacity", "path", "dropped_total",
+                             "events"}
+        assert page["path"] == sink
+        assert page["events"][-1]["kind"] == "swap"
+
+
+class TestWriter:
+    def test_flush_is_a_durability_barrier(self, monkeypatch, tmp_path):
+        sink = tmp_path / "j.jsonl"
+        monkeypatch.setenv("PIO_JOURNAL_PATH", str(sink))
+        for i in range(50):
+            journal.emit("fold", outcome="ok", events=i)
+        assert journal.JOURNAL.flush(timeout=10.0)
+        lines = sink.read_text().splitlines()
+        assert len(lines) == 50
+        assert json.loads(lines[-1])["events"] == 49
+
+    def test_no_sink_means_no_file(self, monkeypatch, tmp_path):
+        monkeypatch.delenv("PIO_JOURNAL_PATH", raising=False)
+        journal.emit("reload", instance="ring-only")
+        assert journal.JOURNAL.flush(timeout=1.0)  # nothing pending
+        assert list(tmp_path.iterdir()) == []
+
+    def test_rotation_keeps_current_plus_one_roll(self, monkeypatch,
+                                                  tmp_path):
+        sink = tmp_path / "j.jsonl"
+        monkeypatch.setenv("PIO_JOURNAL_PATH", str(sink))
+        monkeypatch.setenv("PIO_JOURNAL_MAX_BYTES", "400")
+        for i in range(60):
+            journal.emit("patch", seq=i)
+            # serialize so tell() sees each append before the next cap
+            # check — the cap is a per-line decision on the writer
+            assert journal.JOURNAL.flush(timeout=10.0)
+        assert sink.exists()
+        rolled = tmp_path / "j.jsonl.1"
+        assert rolled.exists()
+        assert sink.stat().st_size <= 400 + 200  # cap + one line slack
+        # exactly one roll file ever: .1 is replaced, .2 never exists
+        assert sorted(p.name for p in tmp_path.iterdir()) == [
+            "j.jsonl", "j.jsonl.1"]
+        # read_back stitches roll + current in order; rotation DROPS
+        # history beyond the two files, never corrupts what remains
+        events, corrupt = journal.read_back(str(sink))
+        assert corrupt == 0
+        seqs = [e["seq"] for e in events]
+        assert seqs == sorted(seqs)
+        assert seqs[-1] == 59
+
+    def test_restart_durability(self, monkeypatch, tmp_path):
+        """A new process (fresh Journal over the same path) appends;
+        read_back returns both generations."""
+        sink = tmp_path / "j.jsonl"
+        monkeypatch.setenv("PIO_JOURNAL_PATH", str(sink))
+        journal.emit("reload", instance="gen-1")
+        assert journal.JOURNAL.flush(timeout=10.0)
+        fresh = journal.Journal()  # the restarted process
+        fresh.emit("reload", instance="gen-2")
+        assert fresh.flush(timeout=10.0)
+        events, corrupt = journal.read_back(str(sink))
+        assert corrupt == 0
+        assert [e["instance"] for e in events
+                if e["kind"] == "reload"] == ["gen-1", "gen-2"]
+        fresh.reset()
+
+    def test_read_back_skips_torn_tail(self, monkeypatch, tmp_path):
+        sink = tmp_path / "j.jsonl"
+        monkeypatch.setenv("PIO_JOURNAL_PATH", str(sink))
+        journal.emit("swap", phase="start")
+        journal.emit("swap", phase="end", outcome="ok")
+        assert journal.JOURNAL.flush(timeout=10.0)
+        with open(sink, "a", encoding="utf-8") as f:
+            f.write('{"ts": 1.0, "kind": "swa')  # killed mid-append
+        events, corrupt = journal.read_back(str(sink))
+        assert corrupt == 1
+        assert [e["kind"] for e in events] == ["swap", "swap"]
+
+    def test_read_back_counts_non_dict_lines(self, tmp_path):
+        sink = tmp_path / "j.jsonl"
+        sink.write_text('{"ts": 1.0, "kind": "reload"}\n[1, 2]\n\n')
+        events, corrupt = journal.read_back(str(sink))
+        assert len(events) == 1 and corrupt == 1
+
+    def test_writer_survives_unwritable_sink(self, monkeypatch,
+                                             tmp_path):
+        base = journal._DROPPED_TOTAL.value
+        monkeypatch.setenv("PIO_JOURNAL_PATH",
+                           str(tmp_path / "no-such-dir" / "j.jsonl"))
+        journal.emit("reload", instance="doomed")
+        assert journal.JOURNAL.flush(timeout=10.0)  # drains via drop
+        assert journal._DROPPED_TOTAL.value > base
+        # the writer thread is still alive for a good sink
+        good = tmp_path / "j.jsonl"
+        monkeypatch.setenv("PIO_JOURNAL_PATH", str(good))
+        journal.emit("reload", instance="landed")
+        assert journal.JOURNAL.flush(timeout=10.0)
+        events, _ = journal.read_back(str(good))
+        assert events[-1]["instance"] == "landed"
+
+    def test_emit_is_fire_and_forget_under_concurrency(self,
+                                                       monkeypatch,
+                                                       tmp_path):
+        sink = tmp_path / "j.jsonl"
+        monkeypatch.setenv("PIO_JOURNAL_PATH", str(sink))
+
+        def hammer(tid):
+            for i in range(100):
+                journal.emit("breaker", target=f"t{tid}", state="open",
+                             seq=i)
+
+        threads = [threading.Thread(target=hammer, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert journal.JOURNAL.flush(timeout=10.0)
+        events, corrupt = journal.read_back(str(sink))
+        assert corrupt == 0
+        assert len(events) == 400
+
+
+class TestShedEpisodes:
+    def test_episode_opens_once_and_closes_after_idle(self):
+        eps = journal.SHED_EPISODES
+        eps.note_shed("slo_burn", now_mono=100.0, server="eng")
+        eps.note_shed("slo_burn", now_mono=101.0, server="eng")
+        eps.note_shed("slo_burn", now_mono=102.0, server="eng")
+        starts = journal.JOURNAL.recent(kind="shed_episode")
+        assert len(starts) == 1  # one start, not one per 429
+        assert starts[0]["phase"] == "start"
+        assert starts[0]["reason"] == "slo_burn"
+        assert starts[0]["server"] == "eng"
+        assert not eps.maybe_close(now_mono=103.0)  # still inside idle
+        assert eps.maybe_close(now_mono=102.0 + eps.idle_sec() + 0.1)
+        events = journal.JOURNAL.recent(kind="shed_episode")
+        assert events[-1]["phase"] == "end"
+        assert events[-1]["sheds"] == 3
+        assert events[-1]["duration_sec"] == pytest.approx(2.0)
+
+    def test_closed_episode_reopens_on_next_shed(self):
+        eps = journal.SHED_EPISODES
+        eps.note_shed("queue_full", now_mono=10.0)
+        assert eps.maybe_close(now_mono=10.0 + eps.idle_sec() + 1.0)
+        eps.note_shed("queue_full", now_mono=50.0)
+        phases = [e["phase"] for e in
+                  journal.JOURNAL.recent(kind="shed_episode")]
+        assert phases == ["start", "end", "start"]
+
+    def test_maybe_close_noop_when_inactive(self):
+        assert not journal.SHED_EPISODES.maybe_close(now_mono=1.0)
+        assert journal.JOURNAL.recent(kind="shed_episode") == []
+
+
+class TestHTTPSurface:
+    """GET /admin/journal + /admin/anomaly on a live server, and the
+    fleet variants' 404 contract off-fleet."""
+
+    @pytest.fixture()
+    def server(self, memory_storage):
+        from predictionio_tpu.serving.event_server import EventServer
+
+        server = EventServer(storage=memory_storage, host="127.0.0.1",
+                             port=0).start()
+        try:
+            yield f"http://127.0.0.1:{server.port}"
+        finally:
+            server.stop()
+
+    @staticmethod
+    def _get(url):
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            return e.code, json.loads(body) if body else {}
+
+    def test_admin_journal_page_and_filters(self, server):
+        journal.emit("reload", instance="i-1")
+        journal.emit("breaker", target="t", state="open")
+        status, page = self._get(server + "/admin/journal")
+        assert status == 200
+        assert [e["kind"] for e in page["events"]] == ["reload",
+                                                       "breaker"]
+        status, page = self._get(server + "/admin/journal?kind=reload")
+        assert status == 200
+        assert [e["kind"] for e in page["events"]] == ["reload"]
+        status, page = self._get(server + "/admin/journal?n=1")
+        assert status == 200 and len(page["events"]) == 1
+        status, body = self._get(server + "/admin/journal?n=zap")
+        assert status == 400 and "bad n/since" in body["message"]
+        status, body = self._get(server + "/admin/journal?since=zap")
+        assert status == 400
+
+    def test_admin_anomaly_scans_and_reports(self, server):
+        status, report = self._get(server + "/admin/anomaly")
+        assert status == 200
+        assert set(report) == {"window_sec", "active", "recent_resolved",
+                               "scan_ms"}
+        assert report["active"] == {}
+
+    def test_fleet_variants_404_off_fleet(self, server, monkeypatch):
+        monkeypatch.delenv("PIO_OBS_MEMBERS", raising=False)
+        for path in ("/admin/fleet/journal", "/admin/fleet/anomaly"):
+            status, body = self._get(server + path)
+            assert status == 404
+            assert "no fleet supervised" in body["message"]
+
+    def test_fleet_journal_via_obs_members(self, server, monkeypatch):
+        # PIO_OBS_MEMBERS pointing at ourselves: the single-member merge
+        monkeypatch.setenv("PIO_OBS_MEMBERS", f"self={server}")
+        journal.emit("swap", phase="start")
+        status, merged = self._get(server + "/admin/fleet/journal")
+        assert status == 200
+        assert merged["merged_from"] == ["self"]
+        assert merged["events"][-1]["kind"] == "swap"
+        assert merged["events"][-1]["fleet_member"] == "self"
+        status, fa = self._get(server + "/admin/fleet/anomaly")
+        assert status == 200
+        assert fa["any_active"] is False
+        assert fa["members"][0]["ok"] is True

@@ -56,7 +56,8 @@ def rule_ids(findings):
 def test_all_rules_registered():
     assert {"JT01", "JT02", "JT03", "JT04", "JT05", "JT06",
             "JT07", "JT08", "JT09", "JT10", "JT11", "JT12",
-            "JT13", "JT14", "JT15", "JT16", "JT17"} <= set(RULES)
+            "JT13", "JT14", "JT15", "JT16", "JT17",
+            "JT22"} <= set(RULES)
     # the whole-program concurrency layer registers separately: project
     # rules never run in per-file mode
     assert {"JT18", "JT19", "JT20", "JT21"} == set(PROJECT_RULES)
@@ -2015,3 +2016,99 @@ def test_project_cli_json_shape(tmp_path):
     assert finding["rule"] == "JT19"
     assert finding["path"].endswith("mod.py")
     assert isinstance(finding["line"], int)
+
+
+# -- JT22: unjournaled state transitions ---------------------------------------
+
+
+class TestJT22UnjournaledStateTransition:
+    def test_flags_state_write_without_journal(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            class Breaker:
+                def trip(self):
+                    self._state = "open"
+        """, relpath="resilience/policy.py")
+        assert "JT22" in rule_ids(findings)
+
+    def test_flags_bare_state_tail_on_other_object(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            class Supervisor:
+                def evict(self, replica):
+                    replica.state = "evicted"
+        """, relpath="serving/fleet.py")
+        assert "JT22" in rule_ids(findings)
+
+    def test_journal_emit_in_scope_vouches(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            from predictionio_tpu.obs import journal
+
+            class Breaker:
+                def trip(self):
+                    self._state = "open"
+                    journal.emit("breaker", state="open")
+        """, relpath="resilience/policy.py")
+        assert "JT22" not in rule_ids(findings)
+
+    def test_journal_object_method_vouches(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            class Episodes:
+                def open(self):
+                    self._episode_state = "active"
+                    self._journal.emit("shed_episode", phase="start")
+        """, relpath="resilience/admission.py")
+        assert "JT22" not in rule_ids(findings)
+
+    def test_init_writes_exempt(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            class Replica:
+                def __init__(self):
+                    self.state = "stopped"
+        """, relpath="serving/fleet.py")
+        assert "JT22" not in rule_ids(findings)
+
+    def test_out_of_scope_paths_exempt(self, tmp_path):
+        # a `state` attribute outside resilience//fleet//stream is
+        # ordinary data, not an ops transition
+        findings = lint_src(tmp_path, """
+            class Parser:
+                def advance(self):
+                    self.state = "in_block"
+        """, relpath="tools/parser.py")
+        assert "JT22" not in rule_ids(findings)
+
+    def test_suppression_with_justification(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            class Breaker:
+                def reset(self):
+                    self._state = "closed"  # graftlint: disable=JT22 — test-only reset, not an operational transition
+        """, relpath="resilience/policy.py")
+        assert "JT22" not in rule_ids(findings)
+        assert "GL00" not in rule_ids(findings)
+
+    def test_nested_def_does_not_vouch_outer_scope(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            class Supervisor:
+                def swap(self, replica):
+                    def note():
+                        journal.emit("swap", phase="start")
+                    replica.state = "draining"
+        """, relpath="serving/fleet.py")
+        assert "JT22" in rule_ids(findings)
+
+    def test_tree_is_clean(self):
+        # every transition seam the ops journal covers must STAY
+        # journaled: the packaged resilience/fleet/stream modules carry
+        # no unsuppressed JT22 findings
+        import subprocess
+        import sys as _sys
+
+        proc = subprocess.run(
+            [_sys.executable, "-m", "predictionio_tpu.tools.lint",
+             "--json",
+             str(REPO_ROOT / "predictionio_tpu" / "resilience"),
+             str(REPO_ROOT / "predictionio_tpu" / "serving"),
+             str(REPO_ROOT / "predictionio_tpu" / "workflow")],
+            capture_output=True, text=True, cwd=str(REPO_ROOT))
+        doc = json.loads(proc.stdout)
+        assert [f for f in doc["findings"]
+                if f["rule"] == "JT22"] == []

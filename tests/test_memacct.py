@@ -530,7 +530,7 @@ def test_snapshot_cadence_refreshes_gauges(monkeypatch):
     assert memacct.refresh() >= 0  # the listener flight invokes
     assert refresh_headroom() == 5000 - 1234
     # and the listener is actually registered on the cadence
-    assert memacct.refresh in flight._snapshot_listeners
+    assert ("memacct", memacct.refresh) in flight._snapshot_listeners
 
 
 def refresh_headroom() -> float:

@@ -675,7 +675,7 @@ def test_snapshot_cadence_evaluates_slos():
     fires alert transitions (the webhook) on an unattended server."""
     import predictionio_tpu.obs.flight as flight_mod
 
-    for fn in flight_mod._snapshot_listeners:
+    for _name, fn in flight_mod._snapshot_listeners:
         fn()
     family = metrics.REGISTRY.get("pio_slo_burn_rate")
     labels = {values for values, _ in family.children()}

@@ -580,3 +580,156 @@ class TestBenchCompare:
         out = capsys.readouterr().out
         assert "BENCH_r98.json has no extractable metrics" in out
         assert "BENCH_r02.json vs BENCH_r01.json" in out
+
+    @staticmethod
+    def _sentinel_round(tmp_path, name, append_us, scan_ms):
+        import json as _json
+
+        doc = {"n": 1, "cmd": "python bench.py", "rc": 0,
+               "tail": "(fixture)",
+               "parsed": {"metric": "m", "value": 1.0,
+                          "key": {"journal_append_us": append_us,
+                                  "anomaly_scan_ms": scan_ms}}}
+        path = tmp_path / name
+        path.write_text(_json.dumps(doc))
+        return str(path)
+
+    def test_sentinel_keys_gated_lower_better(self):
+        from predictionio_tpu.tools import benchcmp
+
+        assert benchcmp.lower_is_better("key.journal_append_us")
+        assert benchcmp.lower_is_better("key.anomaly_scan_ms")
+
+    def test_journal_append_regression_exits_1(self, tmp_path, capsys):
+        from predictionio_tpu.tools import benchcmp
+
+        base = self._sentinel_round(tmp_path, "BENCH_r01.json", 8.0, 14.0)
+        slow = self._sentinel_round(tmp_path, "BENCH_r02.json", 20.0, 14.5)
+        rc = benchcmp.run([base, slow], tolerance_pct=10.0)
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "key.journal_append_us" in out
+        assert "REGRESSION" in out
+        # scan drifted +3.6%: inside tolerance, no verdict printed
+        assert "key.anomaly_scan_ms:" not in out
+
+    def test_anomaly_scan_regression_exits_1(self, tmp_path, capsys):
+        from predictionio_tpu.tools import benchcmp
+
+        base = self._sentinel_round(tmp_path, "BENCH_r01.json", 8.0, 14.0)
+        slow = self._sentinel_round(tmp_path, "BENCH_r02.json", 8.1, 40.0)
+        rc = benchcmp.run([base, slow], tolerance_pct=10.0)
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "key.anomaly_scan_ms" in out
+        assert "REGRESSION" in out
+
+    def test_sentinel_keys_dropping_is_improvement(self, tmp_path, capsys):
+        from predictionio_tpu.tools import benchcmp
+
+        base = self._sentinel_round(tmp_path, "BENCH_r01.json", 8.0, 14.0)
+        fast = self._sentinel_round(tmp_path, "BENCH_r02.json", 4.0, 7.0)
+        rc = benchcmp.run([base, fast], tolerance_pct=10.0)
+        assert rc == 0
+        assert "IMPROVED" in capsys.readouterr().out
+
+
+class TestJournalCLI:
+    """`pio journal` over this process's ring (no --url)."""
+
+    def test_empty_journal(self, capsys):
+        assert cli_main(["journal"]) == 0
+        assert "(journal is empty)" in capsys.readouterr().out
+
+    def test_human_lines_and_kind_filter(self, capsys):
+        from predictionio_tpu.obs import journal
+
+        journal.emit("reload", instance="i-7")
+        journal.emit("breaker", target="svc", state="open", failures=3)
+        assert cli_main(["journal"]) == 0
+        out = capsys.readouterr().out
+        assert "reload" in out and "instance=i-7" in out
+        assert "breaker" in out and "state=open" in out
+        assert cli_main(["journal", "--kind", "breaker"]) == 0
+        out = capsys.readouterr().out
+        assert "breaker" in out and "reload" not in out
+
+    def test_json_page_shape(self, capsys):
+        from predictionio_tpu.obs import journal
+
+        journal.emit("swap", phase="start")
+        assert cli_main(["journal", "--json"]) == 0
+        page = json.loads(capsys.readouterr().out)
+        assert set(page) == {"capacity", "path", "dropped_total",
+                             "events"}
+        assert page["events"][-1]["kind"] == "swap"
+
+    def test_fleet_without_url_is_an_error(self, capsys):
+        assert cli_main(["journal", "--fleet"]) == 1
+        assert "--fleet needs --url" in capsys.readouterr().err
+
+    def test_format_event_renders_member_and_trace(self):
+        from predictionio_tpu.tools.cli import format_journal_event
+
+        line = format_journal_event(
+            {"ts": 1754500000.0, "mono": 1.0, "kind": "reload",
+             "fleet_member": "r1", "trace": "a" * 32,
+             "instance": "i-1"})
+        assert "[r1]" in line
+        assert "trace=" + "a" * 8 in line and "a" * 9 not in line
+        assert "instance=i-1" in line
+
+
+class TestAnomaliesCLI:
+    """`pio anomalies`: exit 1 while anything is active, 0 when quiet;
+    --json is the pinned machine contract."""
+
+    def _arm(self, cause=True):
+        from predictionio_tpu.obs import anomaly
+
+        verdict = {"mode": "step", "direction": "up", "baseline": 10.0,
+                   "sigma": 0.3, "recent": 15.0, "delta": 5.0,
+                   "z": 16.9, "cusum": 45.0, "onset_ts": 1450.0,
+                   "since": 1540.0}
+        if cause:
+            verdict["cause"] = {"kind": "reload", "ts": 1445.0,
+                                "instance": "i-9", "gap_sec": 5.0}
+        anomaly.SENTINEL._active["serve_p99_ms.e"] = verdict
+
+    def test_quiet_exits_0(self, capsys):
+        assert cli_main(["anomalies"]) == 0
+        assert "no active anomalies" in capsys.readouterr().out
+
+    def test_active_exits_1_with_attribution(self, capsys):
+        self._arm()
+        assert cli_main(["anomalies"]) == 1
+        out = capsys.readouterr().out
+        assert "1 ACTIVE anomaly" in out
+        assert "serve_p99_ms.e" in out
+        assert "step/up" in out
+        assert "z=16.9" in out
+        assert "<- reload" in out and "instance=i-9" in out
+
+    def test_json_shape_pin(self, capsys):
+        """The machine contract CI scripts consume: top-level keys,
+        the active block keyed by series, exit code semantics."""
+        self._arm()
+        assert cli_main(["anomalies", "--json"]) == 1
+        report = json.loads(capsys.readouterr().out)
+        assert set(report) == {"window_sec", "active",
+                               "recent_resolved", "scan_ms"}
+        entry = report["active"]["serve_p99_ms.e"]
+        assert {"mode", "direction", "baseline", "recent", "z",
+                "onset_ts", "since", "cause"} <= set(entry)
+        assert entry["cause"]["kind"] == "reload"
+        # quiet process -> same shape, exit 0
+        from predictionio_tpu.obs import anomaly
+
+        anomaly.SENTINEL.reset()
+        assert cli_main(["anomalies", "--json"]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["active"] == {}
+
+    def test_fleet_without_url_is_an_error(self, capsys):
+        assert cli_main(["anomalies", "--fleet"]) == 1
+        assert "--fleet needs --url" in capsys.readouterr().err
