@@ -524,9 +524,14 @@ class EventLogEventStore(S.EventStore):
             shutil.rmtree(self._dir(app_id, channel_id), ignore_errors=True)
 
     def insert(self, event: Event, app_id, channel_id=None) -> str:
-        return self.insert_batch([event], app_id, channel_id)[0]
+        # observation stays OFF: the event server's 201 lane already
+        # observed this event at full fidelity (and single DAO writes
+        # below the server are not observed by contract)
+        return self.insert_batch([event], app_id, channel_id,
+                                 _observe=False)[0]
 
-    def insert_batch(self, events, app_id, channel_id=None) -> List[str]:
+    def insert_batch(self, events, app_id, channel_id=None, *,
+                     _observe: bool = True) -> List[str]:
         """Row-lane bulk append, vectorized (the r03 30x gap fix): one
         Python pass collects per-field byte streams, numpy assembles
         the offset tables, and ONE native call (el_append_rows) packs
@@ -618,9 +623,17 @@ class EventLogEventStore(S.EventStore):
         if rc != n:
             raise S.StorageError(f"append failed ({rc} of {n} written)")
         # freshness clock: these rows now wait for a model publish
-        from predictionio_tpu.obs import perfacct
+        from predictionio_tpu.obs import dataobs, perfacct
 
         perfacct.note_ingest()
+        if _observe and dataobs.DATAOBS.enabled():
+            # enqueue-only (the worker sketches): the lane hands over
+            # the byte streams it already built, plus the extra-record
+            # lengths as the payload-size proxy
+            dataobs.DATAOBS.observe_batch(
+                app_id, ev_p, entity_ids=ei_p, target_ids=ti_p,
+                payload_lens=np.diff(ex_o.astype(np.int64)),
+                events=events)
         return out_ids
 
     def insert_json_batch(
@@ -694,9 +707,15 @@ class EventLogEventStore(S.EventStore):
             for i in range(n)
         ]
         if any(c == 0 for c in codes):
-            from predictionio_tpu.obs import perfacct
+            from predictionio_tpu.obs import dataobs, perfacct
 
             perfacct.note_ingest()
+            if dataobs.DATAOBS.enabled():
+                # the native lane surfaces names only (ids never
+                # become Python objects); count the accepted rows
+                dataobs.DATAOBS.observe_batch(
+                    app_id,
+                    [nm for nm, c in zip(names, codes) if c == 0])
         return ids, codes, names, etypes
 
     def get(self, event_id, app_id, channel_id=None) -> Optional[Event]:
@@ -1121,9 +1140,11 @@ class EventLogEventStore(S.EventStore):
                 )
             total += m
         if total:
-            from predictionio_tpu.obs import perfacct
+            from predictionio_tpu.obs import dataobs, perfacct
 
             perfacct.note_ingest()
+            if dataobs.DATAOBS.enabled():
+                dataobs.DATAOBS.observe_columnar(app_id, cols)
         return total
 
     def data_fingerprint(self, app_id, channel_id=None) -> str:

@@ -244,9 +244,10 @@ class SqliteEventStore(S.EventStore):
         if stamped:
             # freshness clock (obs/perfacct.py): like every other bulk
             # storage writer, once per committed batch
-            from predictionio_tpu.obs import perfacct
+            from predictionio_tpu.obs import dataobs, perfacct
 
             perfacct.note_ingest()
+            dataobs.DATAOBS.observe_events(app_id, stamped)
         return [e.event_id for e in stamped]
 
     def _row_to_event(self, row: sqlite3.Row) -> Event:

@@ -447,9 +447,10 @@ class EventStore(abc.ABC):
             # freshness clock (obs/perfacct.py): one note per accepted
             # batch — pio_model_staleness_seconds measures how long
             # these rows wait for a servable model
-            from predictionio_tpu.obs import perfacct
+            from predictionio_tpu.obs import dataobs, perfacct
 
             perfacct.note_ingest()
+            dataobs.DATAOBS.observe_events(app_id, events)
         return ids
 
     @abc.abstractmethod

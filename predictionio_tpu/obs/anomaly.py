@@ -103,6 +103,15 @@ SERIES_CONFIG: Dict[str, Dict[str, Any]] = {
              "abs_deadband": 0.005},
     "inflight": {"direction": "up", "deadband": 0.50,
                  "abs_deadband": 2.0},
+    # the data plane (obs/dataobs.py): an eps collapse or surge both
+    # matter; skew and unknown-ratio regress UPWARD only (a hot-key
+    # storm, a model gone stale for live traffic)
+    "data.eps": {"direction": "both", "deadband": 0.25,
+                 "abs_deadband": 1.0},
+    "data.skew": {"direction": "up", "deadband": 0.15,
+                  "abs_deadband": 0.1},
+    "data.unknown_ratio": {"direction": "up", "deadband": 0.10,
+                           "abs_deadband": 0.02},
 }
 
 _DEFAULT_CFG: Dict[str, Any] = {"direction": "both", "deadband": 0.10,

@@ -1062,3 +1062,68 @@ def federate_anomaly(members: List[Member]) -> Dict[str, Any]:
             "merged_from": [r["name"] for r in member_reports if r["ok"]],
             "active": active,
             "any_active": bool(active)}
+
+
+def _fetch_data(member: Member, timeout: float
+                ) -> Tuple[Optional[Dict[str, Any]], Optional[str]]:
+    from predictionio_tpu.obs import dataobs as dataobs_mod
+
+    if member.url is None:
+        return dataobs_mod.DATAOBS.report(), None
+    body, error = _fetch(f"{member.url}/admin/data", timeout)
+    if error is not None:
+        return None, error
+    try:
+        return json.loads(body or b"{}"), None
+    except ValueError as e:
+        return None, f"unparseable data payload: {e}"
+
+
+def federate_data(members: List[Member]) -> Dict[str, Any]:
+    """Per-member data-plane reports (``GET /admin/fleet/data``) plus
+    fleet-merged headline numbers: counters sum, eps sums (each member
+    ingests its own stream), skew and unknown-ratio take the fleet max
+    (a hot key on ONE replica is a hot key), and schema changes union
+    member-stamped. Dead members degrade the merge (their ``ok:
+    false`` row still shows), never fail it."""
+    timeout = collect_timeout()
+    member_reports: List[Dict[str, Any]] = []
+    totals = {"events_total": 0, "tail_events_total": 0,
+              "bytes_total": 0, "eps": 0.0}
+    skew = 0.0
+    unknown = 0.0
+    changes: List[Dict[str, Any]] = []
+    breach_active: Dict[str, bool] = {}
+    for member, payload, error in _fan_out(
+            members, lambda m: _fetch_data(m, timeout)):
+        report = {"name": member.name, "url": member.url,
+                  "role": member.role, "ok": error is None}
+        if error is not None:
+            report["error"] = error
+        else:
+            report["report"] = payload
+            for key in ("events_total", "tail_events_total",
+                        "bytes_total"):
+                totals[key] += int(payload.get(key) or 0)
+            totals["eps"] += float(payload.get("eps") or 0.0)
+            entities = payload.get("entities") or {}
+            skew = max(skew, float(entities.get("skew") or 0.0))
+            unknown = max(unknown,
+                          float(payload.get("unknown_ratio") or 0.0))
+            schema = payload.get("schema") or {}
+            for change in schema.get("changes") or []:
+                stamped = dict(change)
+                stamped["fleet_member"] = member.name
+                changes.append(stamped)
+            for kind, on in (payload.get("breach_active") or {}).items():
+                breach_active[kind] = breach_active.get(kind, False) or on
+        member_reports.append(report)
+    changes.sort(key=lambda c: (c.get("ts") or 0.0))
+    totals["eps"] = round(totals["eps"], 3)
+    return {"members": member_reports,
+            "merged_from": [r["name"] for r in member_reports if r["ok"]],
+            "totals": totals,
+            "skew": round(skew, 4),
+            "unknown_ratio": round(unknown, 4),
+            "schema_changes": changes,
+            "breach_active": breach_active}

@@ -39,7 +39,10 @@ filter on): ``reload``, ``patch``, ``fold``, ``resync``,
 ``canary_rollback``, ``swap``, ``replica_state``, ``breaker``,
 ``slo_alert``, ``watchdog_stall``, ``shed_episode``,
 ``preflight_refused``, ``drift_breach``, ``auto_reload``, ``chaos``,
-``anomaly``, ``anomaly_resolved``.
+``anomaly``, ``anomaly_resolved``, ``schema_change`` (the event
+stream's live schema drifted from the trained-against profile —
+obs/dataobs.py), ``data_breach`` (entity-skew / unknown-entity
+threshold crossed).
 
 Config (env, read per call so tests can monkeypatch):
   PIO_JOURNAL_PATH        JSONL sink (unset = ring only, no disk)

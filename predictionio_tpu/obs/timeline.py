@@ -177,6 +177,19 @@ def contprof_collector() -> Collector:
     return collect
 
 
+def dataobs_collector() -> Collector:
+    """The data plane's series (obs/dataobs.py): ingest events/sec,
+    fitted entity Zipf skew and the unknown-entity coverage ratio —
+    the sample instant also refreshes the gauges for /metrics."""
+
+    def collect(now: float) -> Dict[str, float]:
+        from predictionio_tpu.obs import dataobs
+
+        return dataobs.timeline_points(now)
+
+    return collect
+
+
 def default_collectors() -> List[Collector]:
     return [
         gauge_collector("pio_train_mfu", "mfu"),
@@ -195,6 +208,7 @@ def default_collectors() -> List[Collector]:
                         "quality.recall"),
         gauge_collector("pio_model_quality_rmse_drift",
                         "quality.rmse_drift"),
+        dataobs_collector(),
     ]
 
 

@@ -40,7 +40,8 @@ from urllib.parse import urlparse
 
 from predictionio_tpu.core.engine import Engine
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.obs import flight, health, journal, metrics, slo as slo_mod, trace
+from predictionio_tpu.obs import (dataobs, flight, health, journal, metrics,
+                                  slo as slo_mod, trace)
 from predictionio_tpu.parallel.mesh import MeshContext
 from predictionio_tpu.resilience import chaos
 from predictionio_tpu.resilience.admission import AdmissionController
@@ -765,6 +766,7 @@ class EngineServer(HTTPServerBase):
                 flight.note_stage("dispatch", time.perf_counter() - t_disp)
         elapsed = time.perf_counter() - t0
         self.stats.record(elapsed)
+        self._note_query_coverage(payload)
         if self.feedback_url and self.feedback_access_key:
             # prId lets follow-up events join back to this prediction
             # (ref: CreateServer feedback loop assigns prId :488-550)
@@ -779,6 +781,44 @@ class EngineServer(HTTPServerBase):
                 daemon=True,
             ).start()
         return result
+
+    def _note_query_coverage(self, payload: Any) -> None:
+        """Unknown-entity accounting at the query-decode seam
+        (obs/dataobs.py): how many user/item references this query
+        named, and how many the SERVED model has never seen — the
+        "is the model stale for the traffic we actually get" signal.
+        Best-effort: accounting must never break serving."""
+        try:
+            if not isinstance(payload, dict) or not dataobs.DATAOBS.enabled():
+                return
+            users = [payload["user"]] if payload.get("user") is not None \
+                else []
+            items = list(payload.get("items") or [])
+            if payload.get("item") is not None:
+                items.append(payload["item"])
+            if not users and not items:
+                return
+            with self._deployment_lock:
+                models = list(self.deployment.models)
+            user_maps = [m.user_ids for m in models
+                         if getattr(m, "user_ids", None) is not None]
+            item_maps = [m.item_ids for m in models
+                         if getattr(m, "item_ids", None) is not None]
+            refs = unknown = 0
+            if users and user_maps:
+                refs += len(users)
+                unknown += sum(
+                    1 for u in users
+                    if not any(str(u) in ids for ids in user_maps))
+            if items and item_maps:
+                refs += len(items)
+                unknown += sum(
+                    1 for i in items
+                    if not any(str(i) in ids for ids in item_maps))
+            if refs:
+                dataobs.DATAOBS.note_query(refs, unknown)
+        except Exception:  # noqa: BLE001
+            log.debug("query coverage accounting failed", exc_info=True)
 
     @staticmethod
     def _post_json(url: str, payload: Any, what: str) -> None:

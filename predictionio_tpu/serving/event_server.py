@@ -37,7 +37,7 @@ from urllib.parse import parse_qs, urlparse
 from predictionio_tpu.data.backends.eventlog import _ROW_ERRORS, JsonRowsUnsupported
 from predictionio_tpu.data.event import Event, EventValidationError, validate_event, _parse_time
 from predictionio_tpu.data.storage import UNSET, Storage, StorageError, get_storage
-from predictionio_tpu.obs import flight, perfacct
+from predictionio_tpu.obs import dataobs, flight, perfacct
 from predictionio_tpu.obs import logging as obs_logging
 from predictionio_tpu.serving.http import (HTTPServerBase,
                                            JSONRequestHandler,
@@ -92,7 +92,8 @@ class EventServerCore:
         return AuthData(app_id=key.appid, channel_id=channel_id, events=list(key.events))
 
     # -- event CRUD ---------------------------------------------------------
-    def create_event(self, auth: AuthData, payload: dict) -> Tuple[int, dict]:
+    def create_event(self, auth: AuthData, payload: dict,
+                     payload_bytes: Optional[int] = None) -> Tuple[int, dict]:
         if not isinstance(payload, dict):
             self.stats.update(auth.app_id, 400, "", "")
             return 400, {"message": "event must be a JSON object"}
@@ -115,6 +116,11 @@ class EventServerCore:
         # freshness clock (obs/perfacct.py): the single-event front-door
         # lane notes here — bulk lanes note inside their storage writers
         perfacct.note_ingest()
+        # data plane (obs/dataobs.py): the 201 lane observes at full
+        # fidelity — count, entities, schema, payload bytes; the
+        # storage insert below the server stays observation-off
+        dataobs.DATAOBS.observe_event(auth.app_id, event,
+                                      payload_bytes=payload_bytes)
         return 201, {"eventId": event_id}
 
     def create_events_batch(self, auth: AuthData, raw_body: bytes) -> Tuple[int, Any]:
@@ -315,12 +321,14 @@ class _EventRequestHandler(JSONRequestHandler):
             if path == "/events.json":
                 auth = self._auth(params)
                 if method == "POST":
+                    body = self._read_body()
                     try:
-                        payload = self._read_json()
+                        payload = json.loads(body or b"{}")
                     except json.JSONDecodeError as e:
                         self._send(400, {"message": f"invalid JSON: {e}"})
                         return
-                    self._send(*self.core.create_event(auth, payload))
+                    self._send(*self.core.create_event(
+                        auth, payload, payload_bytes=len(body)))
                 elif method == "GET":
                     self._send(*self.core.query_events(auth, params))
                 else:

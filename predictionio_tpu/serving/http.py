@@ -66,6 +66,15 @@ Every server also inherits the shared operator surface from the
   GET  /admin/fleet/anomaly per-member sentinel      }
                          reports + active union      }
                          (404 w/o a fleet)           }
+  GET  /admin/data       data-plane report (?top=):  }
+                         ingest rates, entity heavy  }
+                         hitters + Zipf skew, HLL    }
+                         cardinality, quantiles,     }
+                         schema drift, unknown-      }
+                         entity coverage             }
+  GET  /admin/fleet/data per-member data reports +   }
+                         merged totals (404 w/o a    }
+                         fleet)                      }
 
 ``/healthz``, ``/readyz`` and ``/metrics`` stay unauthenticated — a
 liveness prober or scraper holds no operator secrets; the ``/admin/*``
@@ -570,6 +579,38 @@ def _serve_fleet_anomaly(handler) -> None:
     handler._send(200, collect.federate_anomaly(members))
 
 
+def _serve_admin_data(handler, query: str) -> None:
+    """``GET /admin/data``: the data plane's report (obs/dataobs.py) —
+    ingest rates per (app, event), entity heavy hitters with the
+    fitted Zipf skew, HLL cardinalities, payload/value/inter-arrival
+    quantiles, the live-vs-frozen schema diff and the unknown-entity
+    coverage ratio. ``?top=`` sizes the heavy-hitter table."""
+    from predictionio_tpu.obs import dataobs
+
+    params = parse_qs(query)
+    try:
+        top = int((params.get("top") or ["20"])[0])
+    except ValueError as e:
+        handler._send(400, {"message": f"bad top: {e}"})
+        return
+    handler._send(200, dataobs.DATAOBS.report(top_n=top))
+
+
+def _serve_fleet_data(handler) -> None:
+    """``GET /admin/fleet/data``: every member's data-plane report side
+    by side plus fleet-merged totals (summed counters, max skew, the
+    union of schema changes); a dead member degrades, never fails."""
+    from predictionio_tpu.obs import collect
+
+    members = _fleet_federation_members(handler)
+    if members is None:
+        handler._send(404, {"message": "no fleet supervised by this "
+                                       "server and no PIO_OBS_MEMBERS "
+                                       "configured"})
+        return
+    handler._send(200, collect.federate_data(members))
+
+
 def _serve_admin_fleet(handler) -> None:
     """``GET /admin/fleet``: the replica fleet's snapshot (states,
     versions, restart counts, swap progress). ``POST /admin/fleet``:
@@ -686,6 +727,12 @@ def _instrument(fn):
                 return
             if self.command == "GET" and path == "/admin/fleet/anomaly":
                 _serve_fleet_anomaly(self)
+                return
+            if self.command == "GET" and path == "/admin/data":
+                _serve_admin_data(self, parsed.query)
+                return
+            if self.command == "GET" and path == "/admin/fleet/data":
+                _serve_fleet_data(self)
                 return
             if path == "/admin/fleet":
                 _serve_admin_fleet(self)

@@ -51,6 +51,11 @@ Console.scala:128-735 command surface; bin/pio:17-42 wrapper):
   anomalies             (the regression sentinel, obs/anomaly.py:
                          active change-points with causal attribution
                          to the journal — exit 1 while any is active)
+  data                  (the data & ingest plane, obs/dataobs.py:
+                         rates, entity heavy hitters + Zipf skew,
+                         cardinality, schema drift, unknown-entity
+                         coverage — /admin/data, member-merged with
+                         --fleet)
 
 Run as ``python -m predictionio_tpu.tools.cli <command> ...``.
 """
@@ -1108,6 +1113,105 @@ def cmd_anomalies(args) -> int:
     return 1 if active else 0
 
 
+def cmd_data(args) -> int:
+    """The data & ingest observability plane (obs/dataobs.py): ingest
+    rates per (app, event), entity heavy hitters with the fitted Zipf
+    skew, HLL cardinalities, payload/value/inter-arrival quantiles,
+    schema drift vs the trained-against profile and the unknown-entity
+    coverage ratio. Reads ``GET /admin/data`` (or the member-merged
+    ``GET /admin/fleet/data`` with --fleet) when --url is given, else
+    this process's plane."""
+    if args.url:
+        path = "/admin/fleet/data" if args.fleet else "/admin/data"
+        report = _fetch_admin_json(args.url.rstrip("/") + path)
+    elif args.fleet:
+        raise CommandError("--fleet needs --url (the router assembles "
+                           "the member merge)")
+    else:
+        from predictionio_tpu.obs import dataobs
+
+        report = dataobs.DATAOBS.report(top_n=args.top)
+    if args.json:
+        json.dump(report, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+        return 0
+
+    def render_one(rep: dict, indent: str = "") -> None:
+        _p(f"{indent}events {int(rep.get('events_total') or 0)} "
+           f"({rep.get('eps', 0.0):g}/s)  "
+           f"tail {int(rep.get('tail_events_total') or 0)}  "
+           f"bytes {int(rep.get('bytes_total') or 0)}")
+        entities = rep.get("entities") or {}
+        card = entities.get("cardinality") or {}
+        _p(f"{indent}entity skew {entities.get('skew', 0.0):g}  "
+           f"cardinality " +
+           " ".join(f"{k}={v}" for k, v in sorted(card.items())))
+        _p(f"{indent}unknown-entity ratio "
+           f"{rep.get('unknown_ratio', 0.0):g} "
+           f"(over {int(rep.get('queries_seen') or 0)} query refs)")
+        breaches = rep.get("breach_active") or {}
+        if breaches:
+            _p(f"{indent}ACTIVE BREACH: "
+               + ", ".join(sorted(k for k, v in breaches.items() if v)))
+        rates = rep.get("rates") or []
+        if rates:
+            _p(f"{indent}rates:")
+            for row in rates[:10]:
+                _p(f"{indent}  app {row.get('app'):>6} "
+                   f"{row.get('event', '?'):<20} {row.get('count')}")
+        top = entities.get("top") or []
+        if top:
+            _p(f"{indent}hot entities:")
+            for row in top[:10]:
+                _p(f"{indent}  {row.get('id', '?'):<24} "
+                   f"{row.get('count')} (±{row.get('err', 0)})")
+        quant = rep.get("quantiles") or {}
+        for name, summ in sorted(quant.items()):
+            if summ and summ.get("n"):
+                _p(f"{indent}{name}: p50 {summ.get('p50')} "
+                   f"p90 {summ.get('p90')} p99 {summ.get('p99')} "
+                   f"(n={summ.get('n')})")
+        schema = rep.get("schema") or {}
+        changes = schema.get("changes") or []
+        if changes:
+            _p(f"{indent}schema changes "
+               f"({schema.get('changes_total', len(changes))} total, "
+               f"frozen at instance "
+               f"{schema.get('frozen_instance') or '-'}):")
+            for ch in changes[-10:]:
+                member = ch.get("fleet_member")
+                _p(f"{indent}  "
+                   + (f"[{member}] " if member else "")
+                   + f"{ch.get('event', '?')}.{ch.get('field', '?')} "
+                   f"{ch.get('change', '?')} "
+                   + " ".join(f"{k}={ch[k]}" for k in
+                              ("old_type", "new_type") if ch.get(k)))
+
+    if args.fleet:
+        for member in report.get("members") or []:
+            state = ("ok" if member.get("ok")
+                     else f"ERROR: {member.get('error')}")
+            _p(f"member {member.get('name', '?'):<12} {state}")
+        _p("")
+        totals = report.get("totals") or {}
+        merged = {
+            "events_total": totals.get("events_total"),
+            "eps": totals.get("eps"),
+            "tail_events_total": totals.get("tail_events_total"),
+            "bytes_total": totals.get("bytes_total"),
+            "entities": {"skew": report.get("skew", 0.0)},
+            "unknown_ratio": report.get("unknown_ratio", 0.0),
+            "breach_active": report.get("breach_active") or {},
+            "schema": {"changes": report.get("schema_changes") or [],
+                       "changes_total":
+                           len(report.get("schema_changes") or [])},
+        }
+        render_one(merged)
+    else:
+        render_one(report)
+    return 0
+
+
 def cmd_chaos(args) -> int:
     """Inspect or toggle a live server's fault injection
     (``/admin/chaos``, resilience/chaos.py): with no mutation flags,
@@ -1484,6 +1588,20 @@ def _render_top_frame(payload: dict) -> str:
                      f"{values[-1]:>12.4g}  "
                      f"(min {min(values):.4g} max {max(values):.4g}, "
                      f"n={len(values)})")
+    def latest(name):
+        points = series.get(name) or []
+        return points[-1][1] if points else None
+
+    eps = latest("data.eps")
+    unknown = latest("data.unknown_ratio")
+    skew = latest("data.skew")
+    if any(v is not None for v in (eps, unknown, skew)):
+        lines.append("")
+        lines.append(
+            "ingest: {} ev/s  unknown-entity {}  skew {}".format(
+                "–" if eps is None else f"{eps:.4g}",
+                "–" if unknown is None else f"{unknown:.2%}",
+                "–" if skew is None else f"{skew:.3g}"))
     datapath = payload.get("datapath") or {}
     if datapath:
         lines.append("")
@@ -1554,6 +1672,30 @@ def _render_fleet_frame(report: dict, history: Optional[dict] = None) -> str:
             "–" if burn is None else f"{burn:g}",
             slo.get("threshold_ms", 0.0), slo.get("objective", 0.0),
             int(slo.get("good") or 0), int(slo.get("total") or 0)))
+    # the ingest row (obs/dataobs.py gauges): counters sum across the
+    # merge; skew/unknown take the fleet max — a hot key or a stale
+    # model on ONE replica is the fleet's problem
+    ingest_events = sum(v for k, v in samples.items()
+                        if k.startswith("pio_data_events_total"))
+    fleet_skew = max((v for k, v in samples.items()
+                      if k.startswith("pio_data_entity_skew")),
+                     default=None)
+    fleet_unknown = max(
+        (v for k, v in samples.items()
+         if k.startswith("pio_query_unknown_entity_ratio")),
+        default=None)
+    if ingest_events or fleet_skew is not None \
+            or fleet_unknown is not None:
+        if history is not None:
+            history.setdefault("fleet.ingest_events", []).append(
+                ingest_events)
+            del history["fleet.ingest_events"][:-120]
+        lines.append(
+            "fleet ingest: events {:.0f}  unknown-entity {}  "
+            "skew {}".format(
+                ingest_events,
+                "–" if fleet_unknown is None else f"{fleet_unknown:.2%}",
+                "–" if fleet_skew is None else f"{fleet_skew:.3g}"))
     if history:
         width = max(len(n) for n in history)
         for name in sorted(history):
@@ -1645,7 +1787,7 @@ def cmd_bench_compare(args) -> int:
 
 def cmd_lint(args) -> int:
     """graftlint: the JAX/TPU-aware static analysis over the tree
-    (rules JT01-JT17 + JT22 per file; --project adds the whole-program
+    (rules JT01-JT17 + JT22-JT23 per file; --project adds the whole-program
     concurrency layer JT18-JT20; tier-1 CI runs the same passes via
     tests/test_lint_clean.py)."""
     from predictionio_tpu.tools.lint import run_cli
@@ -2166,6 +2308,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(func=cmd_anomalies)
 
     p = sub.add_parser(
+        "data",
+        help="the data & ingest observability plane: ingest rates, "
+             "entity heavy hitters + Zipf skew, cardinality, schema "
+             "drift, unknown-entity coverage",
+    )
+    p.add_argument("--url", default=None,
+                   help="server base URL (default: this process's "
+                        "data plane)")
+    p.add_argument("--fleet", action="store_true",
+                   help="member-merged report via the router's "
+                        "GET /admin/fleet/data (requires --url)")
+    p.add_argument("--top", type=int, default=20,
+                   help="heavy-hitter rows to show (default 20)")
+    p.add_argument("--json", action="store_true",
+                   help="raw data-plane report")
+    p.set_defaults(func=cmd_data)
+
+    p = sub.add_parser(
         "bench-compare",
         help="compare the newest BENCH_r*.json round against a baseline; "
              "print per-metric deltas, exit 1 on regressions beyond the "
@@ -2184,7 +2344,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(func=cmd_bench_compare)
 
     p = sub.add_parser("lint", help="run graftlint (JAX/TPU-aware static "
-                                    "analysis, rules JT01-JT22) over the tree")
+                                    "analysis, rules JT01-JT23) over the tree")
     p.add_argument("paths", nargs="*", default=[],
                    help="files/dirs (default: the installed package)")
     p.add_argument("--project", action="store_true",

@@ -36,6 +36,12 @@ reference — operator views of this process's diagnostics:
                            series, the latest replay comparison
                            report, and the canary verdict. JSON at
                            /admin/quality.
+  GET /data             -> HTML panel of the data & ingest plane
+                           (obs/dataobs.py): ingest rates, entity
+                           heavy hitters + Zipf skew, cardinality,
+                           quantile sketches, schema drift and the
+                           unknown-entity coverage ratio. JSON at
+                           /admin/data.
   GET /memory           -> HTML panel of the device-memory
                            accounting plane (obs/memacct.py):
                            headroom + basis, the per-model HBM
@@ -120,6 +126,10 @@ class _DashboardRequestHandler(JSONRequestHandler):
             return
         if path == "/quality":
             self._send_cors(200, self.server_ref.quality_html(),
+                            "text/html; charset=UTF-8")
+            return
+        if path == "/data":
+            self._send_cors(200, self.server_ref.data_html(),
                             "text/html; charset=UTF-8")
             return
         if path == "/trace":
@@ -214,6 +224,7 @@ class DashboardServer(HTTPServerBase):
             '<a href="/timeline">timelines</a> · '
             '<a href="/anomaly">anomaly sentinel</a> · '
             '<a href="/quality">model quality</a> · '
+            '<a href="/data">data &amp; ingest</a> · '
             '<a href="/memory">device memory</a> · '
             '<a href="/trace">trace stitcher</a> · '
             '<a href="/prof">profiler flame</a> · '
@@ -574,6 +585,95 @@ class DashboardServer(HTTPServerBase):
             "<h2>Canary</h2>"
             f"{canary_html}"
             '<p><a href="/admin/quality">JSON</a> · '
+            '<a href="/">index</a></p></body></html>'
+        )
+
+    def data_html(self) -> str:
+        """The data & ingest plane as an operator panel
+        (obs/dataobs.py): ingest rates per (app, event), entity heavy
+        hitters with the fitted Zipf skew, HLL cardinalities, the
+        payload/value/inter-arrival quantiles, the live-vs-frozen
+        schema diff and the unknown-entity coverage ratio — plus the
+        ``data.*`` timeline sparklines."""
+        from predictionio_tpu.obs import dataobs
+        from predictionio_tpu.obs.timeline import TIMELINE, sparkline
+
+        report = dataobs.DATAOBS.report()
+        TIMELINE.sample()
+        series = TIMELINE.series()["series"]
+        spark_rows = "".join(
+            "<tr><td>{name}</td><td><code>{spark}</code></td>"
+            "<td>{last:.4g}</td></tr>".format(
+                name=html.escape(name),
+                spark=html.escape(
+                    sparkline([p[1] for p in series[name]], 48)),
+                last=series[name][-1][1])
+            for name in sorted(series)
+            if name.startswith("data.") and series[name])
+        entities = report.get("entities") or {}
+        breaches = [k for k, v in
+                    (report.get("breach_active") or {}).items() if v]
+        breach_html = (
+            "<p><b style='color:#c0392b'>ACTIVE BREACH: "
+            + html.escape(", ".join(sorted(breaches))) + "</b></p>"
+            if breaches else "")
+        rate_rows = "".join(
+            f"<tr><td>{html.escape(str(r.get('app')))}</td>"
+            f"<td>{html.escape(str(r.get('event')))}</td>"
+            f"<td>{r.get('count')}</td></tr>"
+            for r in (report.get("rates") or [])[:20])
+        hot_rows = "".join(
+            f"<tr><td><code>{html.escape(str(r.get('id')))}</code></td>"
+            f"<td>{r.get('count')}</td><td>±{r.get('err')}</td></tr>"
+            for r in entities.get("top") or [])
+        card = entities.get("cardinality") or {}
+        quant = report.get("quantiles") or {}
+        quant_rows = "".join(
+            f"<tr><td>{html.escape(name)}</td><td>{s.get('p50')}</td>"
+            f"<td>{s.get('p90')}</td><td>{s.get('p99')}</td>"
+            f"<td>{s.get('n')}</td></tr>"
+            for name, s in sorted(quant.items()) if s and s.get("n"))
+        schema = report.get("schema") or {}
+        change_rows = "".join(
+            f"<tr><td>{html.escape(str(c.get('event')))}</td>"
+            f"<td>{html.escape(str(c.get('field')))}</td>"
+            f"<td>{html.escape(str(c.get('change')))}</td>"
+            f"<td>{html.escape(str(c.get('old_type') or '–'))}</td>"
+            f"<td>{html.escape(str(c.get('new_type') or '–'))}</td></tr>"
+            for c in (schema.get("changes") or [])[-20:])
+        frozen = (f"frozen at instance <code>"
+                  f"{html.escape(str(schema.get('frozen_instance'))[:16])}"
+                  "</code>" if schema.get("frozen_instance")
+                  else "no frozen profile yet (a COMPLETED train "
+                       "freezes one)")
+        return (
+            "<!DOCTYPE html><html><head><title>Data plane</title>"
+            "</head><body><h1>Data &amp; ingest</h1>"
+            f"{breach_html}"
+            f"<p>events {report.get('events_total')} "
+            f"({report.get('eps')}/s), tail "
+            f"{report.get('tail_events_total')}, bytes "
+            f"{report.get('bytes_total')} — entity skew "
+            f"<b>{entities.get('skew')}</b>, unknown-entity ratio "
+            f"<b>{report.get('unknown_ratio')}</b> over "
+            f"{report.get('queries_seen')} query refs; cardinality "
+            + " ".join(f"{html.escape(k)}={v}"
+                       for k, v in sorted(card.items()))
+            + "</p>"
+            "<table border='1'><tr><th>Series</th><th>Sparkline</th>"
+            f"<th>Last</th></tr>{spark_rows}</table>"
+            "<h2>Rates</h2><table border='1'><tr><th>app</th>"
+            f"<th>event</th><th>count</th></tr>{rate_rows}</table>"
+            "<h2>Hot entities</h2><table border='1'><tr><th>id</th>"
+            f"<th>count</th><th>err</th></tr>{hot_rows}</table>"
+            "<h2>Quantiles</h2><table border='1'><tr><th>sketch</th>"
+            "<th>p50</th><th>p90</th><th>p99</th><th>n</th></tr>"
+            f"{quant_rows}</table>"
+            f"<h2>Schema drift</h2><p>{frozen}</p>"
+            "<table border='1'><tr><th>event</th><th>field</th>"
+            "<th>change</th><th>old</th><th>new</th></tr>"
+            f"{change_rows}</table>"
+            '<p><a href="/admin/data">JSON</a> · '
             '<a href="/">index</a></p></body></html>'
         )
 

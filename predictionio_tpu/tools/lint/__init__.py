@@ -32,7 +32,7 @@ from predictionio_tpu.tools.lint.engine import (
     register,
     run_cli,
 )
-from predictionio_tpu.tools.lint import rules  # noqa: F401 — registers JT01-JT17, JT22
+from predictionio_tpu.tools.lint import rules  # noqa: F401 — registers JT01-JT17, JT22-JT23
 from predictionio_tpu.tools.lint.project import PROJECT_RULES, register_project
 from predictionio_tpu.tools.lint import concurrency  # noqa: F401 — registers JT18-JT21
 

@@ -352,8 +352,8 @@ def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="python -m predictionio_tpu.tools.lint",
         description="graftlint — JAX/TPU-aware static analysis "
-                    "(per-file rules JT01-JT17 + JT22, whole-program rules "
-                    "JT18-JT21 with --project; see --list-rules)",
+                    "(per-file rules JT01-JT17 + JT22-JT23, whole-program "
+                    "rules JT18-JT21 with --project; see --list-rules)",
     )
     parser.add_argument("paths", nargs="*", default=[],
                         help="files or directories to lint (default: the "

@@ -1,4 +1,4 @@
-"""graftlint rules JT01-JT17: the TPU hazards this codebase has hit.
+"""graftlint rules JT01-JT17 + JT22-JT23: hazards this codebase has hit.
 
 Each rule encodes a failure class with a concrete precedent in this
 tree's history (the bf16-Gramian divergence behind JT03 is recorded in
@@ -1826,3 +1826,126 @@ class UnjournaledStateTransition(Rule):
                         "emit a journal event beside it or justify a "
                         "suppression",
                     )
+
+
+# -- JT23 ----------------------------------------------------------------------
+
+@register
+class UnboundedPerKeyDictGrowth(Rule):
+    id = "JT23"
+    name = "unbounded-per-key-dict-growth"
+    rationale = (
+        "A dict on `self` indexed by a request- or event-derived key "
+        "(user/entity/item ids, trace ids — the JT11 taint "
+        "vocabulary) grows one entry per distinct value: on a serving "
+        "or observability path that is a slow memory leak sized by "
+        "the traffic's key cardinality, and the process OOMs on "
+        "exactly the workloads worth serving (a million-user Zipf "
+        "stream). Track per-key state with a bounded sketch "
+        "(obs/dataobs.py: count-min, space-saving, HLL, fixed-budget "
+        "quantiles) or cap the table with explicit eviction and an "
+        "`(other)` overflow row (the contprof endpoint-cap "
+        "discipline); evidence of either in the same scope vouches "
+        "the write."
+    )
+
+    #: the hazard lives where per-request/per-event keys flow:
+    #: serving/ handles the traffic, obs/ accounts for it — elsewhere
+    #: a keyed dict is ordinary data plumbing, not a traffic-sized
+    #: table
+    def applies_to(self, abspath: str) -> bool:
+        norm = abspath.replace("\\", "/")
+        return "/serving/" in norm or "/obs/" in norm
+
+    #: JT11's taint vocabulary: identifier tails that are per-request
+    #: by construction in this tree
+    _TAINT = UnboundedMetricLabelCardinality()
+
+    def _tainted(self, node: ast.AST) -> Optional[str]:
+        """The request-derived identifier a dict KEY expression
+        derives from, or None. A tuple key is tainted if any component
+        is (``(app_id, entity_id)`` grows like entity_id does)."""
+        if isinstance(node, ast.Tuple):
+            for elt in node.elts:
+                found = self._tainted(elt)
+                if found:
+                    return found
+            return None
+        return self._TAINT._suspect_name(node)
+
+    @staticmethod
+    def _is_self_dict(node: ast.AST) -> bool:
+        """``self.<attr>[...]`` — the subscripted object is an
+        attribute on self (a local alias is out of scope for a
+        per-file rule; the attribute form is the idiom that leaks)."""
+        return (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self")
+
+    @staticmethod
+    def _scope_has_bound(body: List[ast.AST]) -> bool:
+        """Eviction/bound evidence that vouches every keyed write in
+        the scope: a len() comparison (cap check), a .pop/.popitem/
+        .clear/.popleft call, a del statement, an explicit `(other)`
+        overflow row, or a call into an evict/compact/trim/prune
+        helper."""
+        for node in body:
+            if isinstance(node, ast.Delete):
+                return True
+            if isinstance(node, ast.Compare):
+                sides = [node.left] + list(node.comparators)
+                if any(isinstance(s, ast.Call)
+                       and dotted(s.func) == "len" for s in sides):
+                    return True
+            if isinstance(node, ast.Call):
+                tail = dotted(node.func).rsplit(".", 1)[-1].lower()
+                if tail in ("pop", "popitem", "clear", "popleft"):
+                    return True
+                if any(word in tail for word in
+                       ("evict", "compact", "trim", "prune")):
+                    return True
+            if isinstance(node, ast.Constant) and node.value == "(other)":
+                return True
+        return False
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            body = list(UnjournaledStateTransition._body_walk(fn))
+            writes: List[Tuple[ast.AST, str]] = []
+            for node in body:
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AugAssign):
+                    targets = [node.target]
+                else:
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr == "setdefault"
+                            and self._is_self_dict(node.func.value)
+                            and node.args):
+                        found = self._tainted(node.args[0])
+                        if found:
+                            writes.append((node, found))
+                    continue
+                for t in targets:
+                    if (isinstance(t, ast.Subscript)
+                            and self._is_self_dict(t.value)):
+                        found = self._tainted(t.slice)
+                        if found:
+                            writes.append((t, found))
+            if not writes:
+                continue
+            if self._scope_has_bound(body):
+                continue
+            for node, found in writes:
+                yield Finding(
+                    self.id, ctx.path, node.lineno, node.col_offset,
+                    f"per-key dict write on self keyed by "
+                    f"request-derived `{found}` with no bound or "
+                    "eviction in scope — one entry per distinct key is "
+                    "a traffic-sized leak; use a bounded sketch "
+                    "(obs/dataobs.py) or cap the table with eviction "
+                    "and an `(other)` overflow row",
+                )

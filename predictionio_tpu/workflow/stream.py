@@ -100,7 +100,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.obs import journal, metrics, perfacct, trace
+from predictionio_tpu.obs import dataobs, journal, metrics, perfacct, trace
 
 log = logging.getLogger(__name__)
 
@@ -686,6 +686,11 @@ class StreamUpdater:
                     "seconds": time.perf_counter() - t0}
         prev_cursor = self.cursor
         self.cursor = new_cursor
+        if len(cols):
+            # data plane: the tail refreshes entity/name sketches in
+            # THIS process (skew, cardinality) — never the ingest
+            # counters, which the insert lane already moved
+            dataobs.DATAOBS.observe_tail(self._app_id, cols)
         max_delta = metrics.env_int("PIO_STREAM_MAX_DELTA", 200_000)
         n = len(cols)
         truncated = n > max_delta

@@ -25,7 +25,8 @@ from predictionio_tpu.core.params import EngineParams, params_to_dict
 from predictionio_tpu.core.persistent_model import PersistentModel, manifest_for
 from predictionio_tpu.data.metadata import EngineInstance, Model
 from predictionio_tpu.data.storage import Storage, get_storage
-from predictionio_tpu.obs import health, jaxmon, memacct, perfacct, profiler
+from predictionio_tpu.obs import (dataobs, health, jaxmon, memacct, perfacct,
+                                  profiler)
 from predictionio_tpu.parallel.mesh import MeshContext
 from predictionio_tpu.workflow.config import WorkflowParams
 
@@ -261,6 +262,10 @@ def run_train(
         # pio_model_staleness_seconds drops to the age of whatever
         # arrived during the train (0 when nothing did)
         perfacct.LEDGER.note_publish()
+        # data plane: the live schema profile becomes the
+        # trained-against baseline — drift after THIS point is what
+        # schema_change events report
+        dataobs.DATAOBS.freeze_schemas(instance.id)
         # one structured line with the events->model stage split (the
         # zero-copy lane's read/bin/transfer sub-stages land here, so
         # a `pio train` log answers "where did the minutes go" without

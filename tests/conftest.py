@@ -32,7 +32,7 @@ def _reset_resilience():
     gauges feed admission control — a previous test's open circuit,
     active fault, or deliberately-slow traffic must never shed the next
     test's requests."""
-    from predictionio_tpu.obs import anomaly, journal, slo
+    from predictionio_tpu.obs import anomaly, dataobs, journal, slo
     from predictionio_tpu.resilience import chaos, policy
 
     def reset():
@@ -43,6 +43,7 @@ def _reset_resilience():
         journal.JOURNAL.reset()
         journal.SHED_EPISODES.reset()
         anomaly.SENTINEL.reset()
+        dataobs.DATAOBS.reset()
 
     reset()
     yield

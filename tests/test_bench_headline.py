@@ -250,3 +250,57 @@ def test_lint_stage_key_lands_and_gates_lower_better(tmp_path):
     assert len(json.dumps(line).encode()) <= bench.MAX_HEADLINE_BYTES
     assert benchcmp.lower_is_better("key.lint_project_ms")
     assert not benchcmp.is_config_key("key.lint_project_ms")
+
+
+def test_dataobs_stage_keys_land_and_gate(tmp_path):
+    """stage_dataobs' two numbers are first-class gated metrics:
+    key.dataobs_update_us (the per-event sketch cost) and
+    key.dataobs_overhead_pct (the hook's tax on the insert_batch bulk
+    lane) land in the headline, bench-compare directions both
+    lower-better, and a blown overhead gate (>3%) zeroes the headline
+    value like any other hard gate."""
+    from predictionio_tpu.tools import benchcmp
+
+    detail = _representative_detail()
+    detail["dataobs_update_us"] = 0.55
+    detail["dataobs_overhead_pct"] = 0.25
+    detail["dataobs_gate_passed"] = True
+    line = bench.emit_headline(detail, detail_path=str(tmp_path / "d.json"))
+    assert line["key"]["dataobs_update_us"] == 0.55
+    assert line["key"]["dataobs_overhead_pct"] == 0.25
+    assert line["gates"]["dataobs_overhead"] is True
+    assert len(json.dumps(line).encode()) <= bench.MAX_HEADLINE_BYTES
+    assert benchcmp.lower_is_better("key.dataobs_update_us")
+    assert benchcmp.lower_is_better("key.dataobs_overhead_pct")
+    assert not benchcmp.is_config_key("key.dataobs_update_us")
+
+    detail = _representative_detail()
+    detail["dataobs_update_us"] = 2.0
+    detail["dataobs_overhead_pct"] = 4.8
+    detail["dataobs_gate_passed"] = False
+    line = bench.emit_headline(detail, detail_path=str(tmp_path / "d.json"))
+    assert line["value"] == 0.0
+    assert line["gates"]["dataobs_overhead"] is False
+
+
+def test_benchcmp_dataobs_regression_exits_1(tmp_path, capsys):
+    """A sketch-cost regression between rounds fails pio bench-compare
+    with exit 1 (the CI contract), exactly like the serving metrics."""
+    from predictionio_tpu.tools import benchcmp
+
+    def round_file(name, update_us, overhead_pct):
+        p = tmp_path / name
+        p.write_text(json.dumps({"parsed": {
+            "metric": "m", "value": 1.0,
+            "key": {"dataobs_update_us": update_us,
+                    "dataobs_overhead_pct": overhead_pct},
+        }}))
+        return str(p)
+
+    base = round_file("BENCH_r01.json", 0.55, 0.25)
+    worse = round_file("BENCH_r02.json", 1.60, 0.25)
+    assert benchcmp.run([base, worse]) == 1
+    out = capsys.readouterr().out
+    assert "key.dataobs_update_us" in out and "REGRESSION" in out
+    better = round_file("BENCH_r03.json", 0.50, 0.20)
+    assert benchcmp.run([base, better]) == 0

@@ -57,7 +57,7 @@ def test_all_rules_registered():
     assert {"JT01", "JT02", "JT03", "JT04", "JT05", "JT06",
             "JT07", "JT08", "JT09", "JT10", "JT11", "JT12",
             "JT13", "JT14", "JT15", "JT16", "JT17",
-            "JT22"} <= set(RULES)
+            "JT22", "JT23"} <= set(RULES)
     # the whole-program concurrency layer registers separately: project
     # rules never run in per-file mode
     assert {"JT18", "JT19", "JT20", "JT21"} == set(PROJECT_RULES)
@@ -2112,3 +2112,104 @@ class TestJT22UnjournaledStateTransition:
         doc = json.loads(proc.stdout)
         assert [f for f in doc["findings"]
                 if f["rule"] == "JT22"] == []
+
+
+# -- JT23: unbounded per-key dict growth ---------------------------------------
+
+
+class TestJT23UnboundedPerKeyDictGrowth:
+    def test_flags_tainted_key_write_without_bound(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            class Tracker:
+                def observe(self, user_id):
+                    self._counts[user_id] = self._counts.get(user_id, 0) + 1
+        """, relpath="serving/tracker.py")
+        assert "JT23" in rule_ids(findings)
+
+    def test_flags_tuple_key_with_tainted_component(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            class Tracker:
+                def observe(self, app_id, entity_id):
+                    self._seen[(app_id, entity_id)] += 1
+        """, relpath="obs/tracker.py")
+        assert "JT23" in rule_ids(findings)
+
+    def test_flags_setdefault_on_tainted_key(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            class Tracker:
+                def observe(self, session_id):
+                    self._tbl.setdefault(session_id, []).append(1)
+        """, relpath="serving/tracker.py")
+        assert "JT23" in rule_ids(findings)
+
+    def test_len_cap_check_vouches(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            class Tracker:
+                def observe(self, user_id):
+                    if len(self._counts) >= 1024:
+                        return
+                    self._counts[user_id] = 1
+        """, relpath="serving/tracker.py")
+        assert "JT23" not in rule_ids(findings)
+
+    def test_pop_eviction_vouches(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            class Tracker:
+                def observe(self, user_id):
+                    self._counts[user_id] = 1
+                    if self._full():
+                        self._counts.pop(next(iter(self._counts)))
+        """, relpath="obs/tracker.py")
+        assert "JT23" not in rule_ids(findings)
+
+    def test_other_overflow_row_vouches(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            class Tracker:
+                def observe(self, user_id):
+                    key = user_id if self._admit(user_id) else "(other)"
+                    self._counts[key] = 1
+        """, relpath="serving/tracker.py")
+        assert "JT23" not in rule_ids(findings)
+
+    def test_untainted_key_exempt(self, tmp_path):
+        # a small closed key domain (event kind, status code) is not a
+        # traffic-sized table
+        findings = lint_src(tmp_path, """
+            class Tracker:
+                def observe(self, kind):
+                    self._by_kind[kind] = 1
+        """, relpath="serving/tracker.py")
+        assert "JT23" not in rule_ids(findings)
+
+    def test_out_of_scope_paths_exempt(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            class Loader:
+                def index(self, user_id):
+                    self._rows[user_id] = 1
+        """, relpath="data/loader.py")
+        assert "JT23" not in rule_ids(findings)
+
+    def test_suppression_with_justification(self, tmp_path):
+        findings = lint_src(tmp_path, """
+            class Tracker:
+                def observe(self, user_id):
+                    self._counts[user_id] = 1  # graftlint: disable=JT23 — test fixture, bounded by caller
+        """, relpath="serving/tracker.py")
+        assert "JT23" not in rule_ids(findings)
+        assert "GL00" not in rule_ids(findings)
+
+    def test_tree_is_clean(self):
+        # serving/ and obs/ must keep per-key state in bounded sketches
+        # (obs/dataobs.py) or capped tables — no unsuppressed JT23
+        import subprocess
+        import sys as _sys
+
+        proc = subprocess.run(
+            [_sys.executable, "-m", "predictionio_tpu.tools.lint",
+             "--json",
+             str(REPO_ROOT / "predictionio_tpu" / "serving"),
+             str(REPO_ROOT / "predictionio_tpu" / "obs")],
+            capture_output=True, text=True, cwd=str(REPO_ROOT))
+        doc = json.loads(proc.stdout)
+        assert [f for f in doc["findings"]
+                if f["rule"] == "JT23"] == []
